@@ -1,0 +1,215 @@
+"""Sliding-window counters as dense tensors — the LeapArray analog.
+
+Reference design (``sentinel-core/.../slots/statistic/base/LeapArray.java``):
+a circular array of B time buckets of length ``win`` ms; bucket index for time
+t is ``(t / win) % B``; a bucket is deprecated when ``t - windowStart > B*win``
+(``isWindowDeprecated``); ``currentWindow`` lazily CAS-creates/resets buckets
+on touch (``LeapArray.java:128-225``); reads skip deprecated buckets
+(``values()``, ``LeapArray.java:304-369``).
+
+TPU-native rewrite: one tensor per concern instead of one LeapArray object per
+resource —
+
+* ``counters: int32[R, B, E]``  — all resources × buckets × events,
+* ``stamps:   int32[R, B]``     — the *window index* (``t // win``) written last,
+* ``rt_sum:   float32[R, B]``   — response-time sum (float: the ENTRY_NODE
+  aggregate row would overflow int32 at high throughput),
+* ``min_rt:   int32[R, B]``     — per-bucket min RT (scatter-min).
+
+Bucket validity is purely functional and **wraparound-safe**: bucket b of row
+r is live at window index ``now_idx`` iff ``0 <= now_idx - stamp < B``, with
+the subtraction done in int32 two's-complement (a written stamp always
+satisfies ``stamp % B == b``, so positional equality is implied). Lazy reset
+becomes a branchless masked multiply *before* the scatter-add — idempotent
+under duplicate rows in one batch, which is what makes batched semantics exact
+(SURVEY §7 hard-part 2): all events in a device step share one ``now``, so the
+reset decision is identical for every duplicate.
+
+Time discipline (important): window indices are computed **on the host** from
+exact Python ints (``WindowSpec.index_of``) and passed to device code as int32
+scalars. Epoch-milliseconds never enter device arithmetic — ``epoch_ms//500``
+already exceeds int32, and JAX without x64 silently truncates int64, so doing
+the division device-side is a correctness trap. Device-side comparisons only
+ever use int32 *differences*, which are exact as long as true gaps are under
+2^31 windows (~6.8 years at the smallest 100 ms window).
+
+All functions are pure (state in / state out) and jit-safe with static shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+
+from sentinel_tpu.stats import events as ev
+
+INT32_MAX = jnp.iinfo(jnp.int32).max
+# Stamp value meaning "never written": far enough behind any real index that
+# (now - stamp) is huge-positive for the first ~6.8 years, and the wraparound
+# beyond that still reads as dead for any B < 2^30.
+NEVER = jnp.int32(-(2 ** 30))
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowSpec:
+    """Static geometry (hashable → usable as a jit static arg).
+
+    Reference defaults: the "second" window is sampleCount=2 × 500 ms
+    (``SampleCountProperty``/``IntervalProperty``), the "minute" window is
+    60 × 1000 ms (``StatisticNode.java:97-111``).
+    """
+
+    buckets: int
+    win_ms: int
+    track_rt: bool = True
+
+    @property
+    def interval_ms(self) -> int:
+        return self.buckets * self.win_ms
+
+    def index_of(self, now_ms: int) -> int:
+        """HOST-side: exact window index of absolute time ``now_ms``.
+
+        Result is reduced mod 2^32 into int32 range; all device comparisons
+        are difference-based so the reduction is harmless.
+        """
+        idx = now_ms // self.win_ms
+        return int((idx + 2 ** 31) % 2 ** 32 - 2 ** 31)
+
+
+SECOND_SPEC = WindowSpec(buckets=2, win_ms=500)
+MINUTE_SPEC = WindowSpec(buckets=60, win_ms=1000, track_rt=False)
+
+
+class WindowState(NamedTuple):
+    counters: jnp.ndarray          # int32[R, B, E]
+    stamps: jnp.ndarray            # int32[R, B]
+    rt_sum: jnp.ndarray            # float32[R, B] (or [R, 0] when untracked)
+    min_rt: jnp.ndarray            # int32[R, B]   (or [R, 0] when untracked)
+
+
+def init_window(spec: WindowSpec, rows: int, num_events: int = ev.NUM_EVENTS) -> WindowState:
+    b_rt = spec.buckets if spec.track_rt else 0
+    return WindowState(
+        counters=jnp.zeros((rows, spec.buckets, num_events), jnp.int32),
+        stamps=jnp.full((rows, spec.buckets), NEVER, jnp.int32),
+        rt_sum=jnp.zeros((rows, b_rt), jnp.float32),
+        min_rt=jnp.full((rows, b_rt), INT32_MAX, jnp.int32),
+    )
+
+
+def valid_mask(spec: WindowSpec, stamps: jnp.ndarray, now_idx: jnp.ndarray) -> jnp.ndarray:
+    """Live-bucket mask, same shape as ``stamps`` (wraparound-safe diffs)."""
+    delta = now_idx - stamps  # int32 two's-complement difference
+    return (delta >= 0) & (delta < spec.buckets)
+
+
+def window_sum_rows(spec: WindowSpec, state: WindowState, rows: jnp.ndarray,
+                    event: int, now_idx: jnp.ndarray) -> jnp.ndarray:
+    """Sum of ``event`` over live buckets for each row in ``rows`` → int32[N]."""
+    sub = state.counters[rows, :, event]                 # [N, B]
+    mask = valid_mask(spec, state.stamps[rows], now_idx)  # [N, B]
+    return jnp.sum(jnp.where(mask, sub, 0), axis=1)
+
+
+def window_sum_all(spec: WindowSpec, state: WindowState, event: int,
+                   now_idx: jnp.ndarray) -> jnp.ndarray:
+    """Sum of ``event`` over live buckets for every row → int32[R]."""
+    mask = valid_mask(spec, state.stamps, now_idx)        # [R, B]
+    return jnp.sum(jnp.where(mask, state.counters[:, :, event], 0), axis=1)
+
+
+def rolling_totals(spec: WindowSpec, state: WindowState, now_idx: jnp.ndarray) -> jnp.ndarray:
+    """All events, all rows → int32[R, E]; one pass for metric reporting."""
+    mask = valid_mask(spec, state.stamps, now_idx)        # [R, B]
+    return jnp.sum(jnp.where(mask[:, :, None], state.counters, 0), axis=1)
+
+
+def rt_totals(spec: WindowSpec, state: WindowState, now_idx: jnp.ndarray) -> jnp.ndarray:
+    """RT sum over live buckets for every row → float32[R]."""
+    if not spec.track_rt:
+        raise ValueError("rt untracked for this window spec")
+    mask = valid_mask(spec, state.stamps, now_idx)
+    return jnp.sum(jnp.where(mask, state.rt_sum, 0.0), axis=1)
+
+
+def refresh_rows(spec: WindowSpec, state: WindowState, rows: jnp.ndarray,
+                 now_idx: jnp.ndarray) -> WindowState:
+    """Lazy-reset the *current* bucket of each touched row.
+
+    The branchless equivalent of ``LeapArray.currentWindow`` case 3
+    (deprecated → tryLock + reset): where the stamp differs from ``now_idx``
+    the bucket restarts from zero; multiply by {0,1} then stamp-set are both
+    idempotent for duplicate rows in one batch.
+    """
+    k = _bucket_of(spec, now_idx)
+    keep = (state.stamps[rows, k] == now_idx).astype(jnp.int32)   # [N]
+    counters = state.counters.at[rows, k, :].multiply(keep[:, None], mode="drop")
+    stamps = state.stamps.at[rows, k].set(now_idx, mode="drop")
+    rt_sum, min_rt = state.rt_sum, state.min_rt
+    if spec.track_rt:
+        rt_sum = rt_sum.at[rows, k].multiply(keep.astype(jnp.float32), mode="drop")
+        min_rt = min_rt.at[rows, k].set(
+            jnp.where(keep == 1, state.min_rt[rows, k], INT32_MAX), mode="drop")
+    return WindowState(counters, stamps, rt_sum, min_rt)
+
+
+def _bucket_of(spec: WindowSpec, now_idx: jnp.ndarray) -> jnp.ndarray:
+    # Python-style mod keeps the bucket position consistent across the int32
+    # wrap for power-of-two-free B too: jnp '%' already yields non-negative
+    # for positive divisor with floor semantics.
+    return now_idx % spec.buckets
+
+
+def add_rows(spec: WindowSpec, state: WindowState, rows: jnp.ndarray,
+             event: int, amounts: jnp.ndarray, now_idx: jnp.ndarray,
+             rt_ms: Optional[jnp.ndarray] = None) -> WindowState:
+    """Scatter-add ``amounts`` of ``event`` into the current bucket of ``rows``.
+
+    Caller must have run :func:`refresh_rows` for these rows at this
+    ``now_idx`` first (the pipeline refreshes once per step). Padding rows must
+    use row id >= R (dropped by ``mode='drop'``); negative ids wrap in JAX and
+    must not be used as padding.
+    """
+    k = _bucket_of(spec, now_idx)
+    counters = state.counters.at[rows, k, event].add(amounts, mode="drop")
+    rt_sum, min_rt = state.rt_sum, state.min_rt
+    if spec.track_rt and rt_ms is not None:
+        rt_sum = rt_sum.at[rows, k].add(rt_ms.astype(jnp.float32), mode="drop")
+        min_rt = min_rt.at[rows, k].min(rt_ms, mode="drop")
+    return WindowState(counters, state.stamps, rt_sum, min_rt)
+
+
+def add_rows_multi(spec: WindowSpec, state: WindowState, rows: jnp.ndarray,
+                   event_ids: jnp.ndarray, amounts: jnp.ndarray,
+                   now_idx: jnp.ndarray) -> WindowState:
+    """Scatter-add with per-element event ids (fused multi-event record)."""
+    k = _bucket_of(spec, now_idx)
+    counters = state.counters.at[rows, k, event_ids].add(amounts, mode="drop")
+    return state._replace(counters=counters)
+
+
+def invalidate_rows(spec: WindowSpec, state: WindowState, rows: jnp.ndarray) -> WindowState:
+    """Forget all history of ``rows`` (registry eviction → row reuse).
+
+    Stamps go to NEVER so every bucket reads as deprecated; counters/rt need
+    no touch (refresh_rows zeroes them on next write). Without this, a row
+    recycled to a new resource would inherit the evicted resource's live
+    counts and could be instantly flow-blocked on another resource's traffic.
+    """
+    stamps = state.stamps.at[rows, :].set(NEVER, mode="drop")
+    return state._replace(stamps=stamps)
+
+
+def min_rt_rows(spec: WindowSpec, state: WindowState, rows: jnp.ndarray,
+                now_idx: jnp.ndarray, default_rt: int) -> jnp.ndarray:
+    """Min RT over live buckets per row (reference ``ArrayMetric.minRt`` —
+    returns ``statisticMaxRt`` when nothing recorded)."""
+    if not spec.track_rt:
+        raise ValueError("rt untracked for this window spec")
+    mask = valid_mask(spec, state.stamps[rows], now_idx)
+    vals = jnp.where(mask, state.min_rt[rows], INT32_MAX)
+    m = jnp.min(vals, axis=1)
+    return jnp.where(m == INT32_MAX, default_rt, m).astype(jnp.int32)

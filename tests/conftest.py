@@ -1,0 +1,35 @@
+"""Test harness: force an 8-device virtual CPU platform BEFORE jax import.
+
+Mirrors the reference's test strategy (SURVEY §4): deterministic virtual time
+via ManualClock, and distributed-checker tests without hardware via
+``--xla_force_host_platform_device_count=8`` (the analog of the reference's
+single-JVM cluster-checker tests).
+"""
+
+import os
+
+# The build image's sitecustomize registers the `axon` TPU-tunnel backend and
+# imports jax AT INTERPRETER BOOT, pinning JAX_PLATFORMS=axon — env edits here
+# are too late, and initializing the axon backend hangs when the tunnel is
+# down. `jax.config.update` after import is the reliable override; XLA_FLAGS
+# still works because the CPU client isn't created until first use.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from sentinel_tpu.core.clock import ManualClock, set_global_clock  # noqa: E402
+
+
+@pytest.fixture
+def clock():
+    """Virtual clock installed globally for the test (AbstractTimeBasedTest)."""
+    c = ManualClock(start_ms=10_000_000)
+    prev = set_global_clock(c)
+    yield c
+    set_global_clock(prev)

@@ -1,0 +1,106 @@
+import pytest
+
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.core.config import SentinelConfig, load_config
+from sentinel_tpu.core.errors import (
+    BlockReason, FlowException, DegradeException, block_exception_for,
+    is_block_exception,
+)
+from sentinel_tpu.core.property import SentinelProperty
+from sentinel_tpu.core.registry import ENTRY_NODE_ROW, OriginRegistry, Registry, ResourceRegistry
+
+
+def test_manual_clock():
+    c = ManualClock(start_ms=1000)
+    assert c.now_ms() == 1000
+    c.advance_ms(250)
+    assert c.now_ms() == 1250
+    c.sleep_ms(750)  # advances instead of blocking
+    assert c.now_ms() == 2000
+
+
+def test_block_exception_mapping():
+    e = block_exception_for(BlockReason.FLOW, "res", origin="app1", wait_ms=5)
+    assert isinstance(e, FlowException)
+    assert e.resource == "res" and e.origin == "app1" and e.wait_ms == 5
+    assert is_block_exception(e)
+    assert isinstance(block_exception_for(BlockReason.DEGRADE, "r"), DegradeException)
+    assert not is_block_exception(ValueError("x"))
+
+
+def test_property_listener_fire_on_register_and_change():
+    p = SentinelProperty([1, 2])
+    seen = []
+    p.add_listener(seen.append)
+    assert seen == [[1, 2]]  # configLoad on register
+    assert p.update_value([3]) is True
+    assert p.update_value([3]) is False  # no change, no fire
+    assert seen == [[1, 2], [3]]
+
+
+def test_registry_alloc_and_reserved_row():
+    r = ResourceRegistry(capacity=8)
+    assert r.lookup("__entry_node__") == ENTRY_NODE_ROW
+    a = r.get_or_create("a")
+    b = r.get_or_create("b")
+    assert a != b and a != ENTRY_NODE_ROW
+    assert r.get_or_create("a") == a
+    assert r.name_of(b) == "b"
+
+
+def test_registry_eviction_lru():
+    r = Registry(capacity=3, reserved=("pinned0",))
+    a = r.get_or_create("a")
+    b = r.get_or_create("b")
+    r.get_or_create("a")  # touch a → b is LRU
+    c = r.get_or_create("c")  # evicts b
+    assert c == b
+    assert r.lookup("b") is None
+    assert r.lookup("a") == a
+
+
+def test_registry_pinned_not_evicted():
+    r = Registry(capacity=2, reserved=())
+    r.pin("keep")
+    r.get_or_create("x")
+    y = r.get_or_create("y")  # must evict x, not keep
+    assert r.lookup("keep") is not None
+    assert r.lookup("x") is None
+    assert y is not None
+
+
+def test_origin_registry_default_empty():
+    o = OriginRegistry(capacity=4)
+    assert o.lookup("") == 0
+
+
+def test_config_env_override(monkeypatch):
+    monkeypatch.setenv("SENTINEL_TPU_MAX_RESOURCES", "1234")
+    monkeypatch.setenv("SENTINEL_TPU_MINUTE_ENABLED", "false")
+    cfg = load_config(app_name="t")
+    assert cfg.max_resources == 1234
+    assert cfg.minute_enabled is False
+    assert cfg.app_name == "t"
+    assert SentinelConfig().cluster_port == 18730
+
+
+def test_registry_drain_evicted():
+    r = Registry(capacity=2)
+    r.get_or_create("a")
+    r.get_or_create("b")
+    rid_c = r.get_or_create("c")  # evicts a
+    assert r.drain_evicted() == [rid_c]
+    assert r.drain_evicted() == []
+
+
+def test_registry_reserved_generator_consumed_once():
+    r = Registry(capacity=4, reserved=(n for n in ("x", "y")))
+    assert r.lookup("x") == 0 and r.lookup("y") == 1
+
+
+def test_config_rejects_bad_kwargs():
+    with pytest.raises(TypeError):
+        load_config(max_resources=object())
+    with pytest.raises(TypeError):
+        load_config(not_a_field=1)
+    assert load_config(max_resources="4096").max_resources == 4096

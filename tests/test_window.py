@@ -1,0 +1,147 @@
+"""Window-tensor tests — parity targets: LeapArrayTest / BucketLeapArrayTest /
+ArrayMetricTest semantics (reference sentinel-core test tier 1)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from sentinel_tpu.stats import events as ev
+from sentinel_tpu.stats.window import (
+    SECOND_SPEC, WindowSpec, add_rows, init_window, invalidate_rows,
+    min_rt_rows, refresh_rows, rolling_totals, rt_totals, valid_mask,
+    window_sum_all, window_sum_rows,
+)
+
+
+def _add(spec, st, row, event, n, now_ms, rt=None):
+    idx = spec.index_of(now_ms)
+    rows = jnp.array([row], jnp.int32)
+    st = refresh_rows(spec, st, rows, idx)
+    rt_arr = None if rt is None else jnp.array([rt], jnp.int32)
+    return add_rows(spec, st, rows, event, jnp.array([n], jnp.int32), idx, rt_ms=rt_arr)
+
+
+def _sum(spec, st, row, event, now_ms):
+    return int(window_sum_rows(spec, st, jnp.array([row], jnp.int32), event,
+                               spec.index_of(now_ms))[0])
+
+
+def test_single_bucket_add_and_sum():
+    spec = SECOND_SPEC  # 2 × 500ms
+    st = init_window(spec, rows=4)
+    st = _add(spec, st, 1, ev.PASS, 3, now_ms=1000)
+    assert _sum(spec, st, 1, ev.PASS, 1000) == 3
+    assert _sum(spec, st, 0, ev.PASS, 1000) == 0
+
+
+def test_window_rolls_across_buckets():
+    spec = SECOND_SPEC
+    st = init_window(spec, rows=2)
+    st = _add(spec, st, 0, ev.PASS, 5, now_ms=1000)   # window idx 2 (k=0)
+    st = _add(spec, st, 0, ev.PASS, 7, now_ms=1500)   # window idx 3 (k=1)
+    assert _sum(spec, st, 0, ev.PASS, 1500) == 12
+    # at t=2000 the 1000-bucket is exactly interval-old → deprecated
+    assert _sum(spec, st, 0, ev.PASS, 2000) == 7
+    assert _sum(spec, st, 0, ev.PASS, 2500) == 0
+
+
+def test_epoch_scale_timestamps():
+    """Regression: real wall-clock epoch ms (~1.78e12) must work; window index
+    math happens host-side in Python ints (device int32 would overflow)."""
+    spec = SECOND_SPEC
+    st = init_window(spec, rows=2)
+    t0 = 1_785_324_450_225  # actual epoch ms from the build machine
+    st = _add(spec, st, 0, ev.PASS, 4, now_ms=t0)
+    st = _add(spec, st, 0, ev.PASS, 6, now_ms=t0 + 499)
+    assert _sum(spec, st, 0, ev.PASS, t0 + 499) == 10
+    assert _sum(spec, st, 0, ev.PASS, t0 + 2000) == 0
+
+
+def test_lazy_reset_on_reuse():
+    spec = SECOND_SPEC
+    st = init_window(spec, rows=1)
+    st = _add(spec, st, 0, ev.PASS, 5, now_ms=1000)
+    st = _add(spec, st, 0, ev.PASS, 2, now_ms=2000)  # same physical bucket
+    assert _sum(spec, st, 0, ev.PASS, 2000) == 2
+
+
+def test_duplicate_rows_in_one_batch_reset_idempotent():
+    spec = SECOND_SPEC
+    st = init_window(spec, rows=2)
+    st = _add(spec, st, 0, ev.PASS, 5, now_ms=1000)
+    idx = spec.index_of(2000)
+    rows = jnp.array([0, 0, 0], jnp.int32)
+    st = refresh_rows(spec, st, rows, idx)  # stale bucket zeroed exactly once
+    st = add_rows(spec, st, rows, ev.PASS, jnp.array([1, 1, 1], jnp.int32), idx)
+    assert _sum(spec, st, 0, ev.PASS, 2000) == 3
+
+
+def test_padding_rows_dropped():
+    spec = SECOND_SPEC
+    st = init_window(spec, rows=2)
+    idx = spec.index_of(1000)
+    rows = jnp.array([0, 2, 5], jnp.int32)  # row ids >= R are padding
+    st = refresh_rows(spec, st, rows, idx)
+    st = add_rows(spec, st, rows, ev.PASS, jnp.array([1, 9, 9], jnp.int32), idx)
+    assert int(jnp.sum(st.counters[:, :, ev.PASS])) == 1
+
+
+def test_min_rt_and_rt_sum():
+    spec = SECOND_SPEC
+    st = init_window(spec, rows=2)
+    st = _add(spec, st, 0, ev.SUCCESS, 1, now_ms=1000, rt=40)
+    st = _add(spec, st, 0, ev.SUCCESS, 1, now_ms=1200, rt=15)
+    rows = jnp.array([0, 1], jnp.int32)
+    idx = spec.index_of(1200)
+    m = min_rt_rows(spec, st, rows, idx, default_rt=5000)
+    assert int(m[0]) == 15
+    assert int(m[1]) == 5000  # untouched row → statisticMaxRt default
+    rt = rt_totals(spec, st, idx)
+    assert float(rt[0]) == 55.0
+    # after the window passes, both reset
+    st = _add(spec, st, 0, ev.SUCCESS, 1, now_ms=3000, rt=99)
+    idx3 = spec.index_of(3000)
+    assert int(min_rt_rows(spec, st, rows, idx3, default_rt=5000)[0]) == 99
+    assert float(rt_totals(spec, st, idx3)[0]) == 99.0
+
+
+def test_minute_window_spec():
+    spec = WindowSpec(buckets=60, win_ms=1000, track_rt=False)
+    st = init_window(spec, rows=1)
+    st = _add(spec, st, 0, ev.PASS, 1, now_ms=5_000)
+    st = _add(spec, st, 0, ev.PASS, 1, now_ms=30_000)
+    assert _sum(spec, st, 0, ev.PASS, 35_000) == 2
+    # 5s bucket dies at t=65s (60s interval), 30s bucket survives
+    assert _sum(spec, st, 0, ev.PASS, 65_500) == 1
+
+
+def test_rolling_totals_and_all_rows():
+    spec = SECOND_SPEC
+    st = init_window(spec, rows=3)
+    st = _add(spec, st, 1, ev.PASS, 4, now_ms=1000)
+    st = _add(spec, st, 2, ev.BLOCK, 2, now_ms=1000)
+    idx = spec.index_of(1200)
+    tot = rolling_totals(spec, st, idx)
+    assert tot.shape == (3, ev.NUM_EVENTS)
+    assert int(tot[1, ev.PASS]) == 4 and int(tot[2, ev.BLOCK]) == 2
+    np.testing.assert_array_equal(
+        np.asarray(window_sum_all(spec, st, ev.PASS, idx)), [0, 4, 0])
+
+
+def test_valid_mask_never_written():
+    spec = SECOND_SPEC
+    st = init_window(spec, rows=1)
+    assert not bool(valid_mask(spec, st.stamps, spec.index_of(0)).any())
+    # ...and at epoch-scale time too
+    assert not bool(valid_mask(spec, st.stamps, spec.index_of(1_785_324_450_225)).any())
+
+
+def test_invalidate_rows_forgets_history():
+    """Regression: recycled registry rows must not inherit old counters."""
+    spec = SECOND_SPEC
+    st = init_window(spec, rows=2)
+    st = _add(spec, st, 1, ev.PASS, 50, now_ms=1000)
+    st = invalidate_rows(spec, st, jnp.array([1], jnp.int32))
+    assert _sum(spec, st, 1, ev.PASS, 1000) == 0
+    # row is immediately usable for a fresh resource
+    st = _add(spec, st, 1, ev.PASS, 2, now_ms=1100)
+    assert _sum(spec, st, 1, ev.PASS, 1100) == 2
