@@ -1,0 +1,69 @@
+"""sentinel-tpu: TPU-native flow control, circuit breaking and adaptive
+protection — the capabilities of Alibaba Sentinel, rebuilt on JAX/XLA.
+
+Quick start (reference README parity)::
+
+    import sentinel_tpu as stpu
+
+    sph = stpu.Sentinel()
+    sph.load_flow_rules([stpu.FlowRule(resource="HelloWorld", count=20)])
+
+    try:
+        with sph.entry("HelloWorld"):
+            do_something()
+    except stpu.BlockException:
+        do_fallback()
+"""
+
+from sentinel_tpu.core.clock import Clock, ManualClock, SystemClock, set_global_clock
+from sentinel_tpu.core.config import SentinelConfig, load_config
+from sentinel_tpu.core.context import ContextScope, enter_context, exit_context
+from sentinel_tpu.core.errors import (
+    AuthorityException,
+    BlockException,
+    BlockReason,
+    DegradeException,
+    FlowException,
+    ParamFlowException,
+    SystemBlockException,
+)
+from sentinel_tpu.rules.authority import STRATEGY_BLACK, STRATEGY_WHITE, AuthorityRule
+from sentinel_tpu.rules.degrade import (
+    GRADE_EXCEPTION_COUNT,
+    GRADE_EXCEPTION_RATIO,
+    GRADE_RT,
+    DegradeRule,
+)
+from sentinel_tpu.rules.flow import (
+    BEHAVIOR_DEFAULT,
+    BEHAVIOR_RATE_LIMITER,
+    BEHAVIOR_WARM_UP,
+    BEHAVIOR_WARM_UP_RATE_LIMITER,
+    GRADE_QPS,
+    GRADE_THREAD,
+    STRATEGY_CHAIN,
+    STRATEGY_DIRECT,
+    STRATEGY_RELATE,
+    FlowRule,
+)
+from sentinel_tpu.rules.system import SystemRule
+from sentinel_tpu.runtime import ENTRY_TYPE_IN, ENTRY_TYPE_OUT, Entry, Sentinel
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Sentinel", "Entry", "ENTRY_TYPE_IN", "ENTRY_TYPE_OUT",
+    "FlowRule", "DegradeRule", "SystemRule", "AuthorityRule",
+    "BlockException", "FlowException", "DegradeException",
+    "SystemBlockException", "AuthorityException", "ParamFlowException",
+    "BlockReason",
+    "GRADE_QPS", "GRADE_THREAD", "GRADE_RT", "GRADE_EXCEPTION_RATIO",
+    "GRADE_EXCEPTION_COUNT",
+    "BEHAVIOR_DEFAULT", "BEHAVIOR_WARM_UP", "BEHAVIOR_RATE_LIMITER",
+    "BEHAVIOR_WARM_UP_RATE_LIMITER",
+    "STRATEGY_DIRECT", "STRATEGY_RELATE", "STRATEGY_CHAIN",
+    "STRATEGY_WHITE", "STRATEGY_BLACK",
+    "Clock", "ManualClock", "SystemClock", "set_global_clock",
+    "ContextScope", "enter_context", "exit_context",
+    "SentinelConfig", "load_config",
+]

@@ -1,0 +1,62 @@
+"""Per-thread call context (ContextUtil analog).
+
+Reference: ``sentinel-core/.../context/ContextUtil.java`` — a ThreadLocal
+holding the context name (entrance) and origin (caller app); adapters call
+``ContextUtil.enter(contextName, origin)`` before ``SphU.entry``. The context
+name keys CHAIN-strategy flow rules and the entrance-node aggregation; the
+origin keys authority checks and origin-specific flow rules.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+DEFAULT_CONTEXT_NAME = "sentinel_default_context"
+
+
+@dataclasses.dataclass
+class Context:
+    name: str = DEFAULT_CONTEXT_NAME
+    origin: str = ""
+
+
+_tls = threading.local()
+
+
+def current_context() -> Context:
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None:
+        ctx = Context()
+        _tls.ctx = ctx
+    return ctx
+
+
+def enter_context(name: str, origin: str = "") -> Context:
+    """Reference ``ContextUtil.enter`` (names beyond the registry capacity
+    degrade to the shared default context at lookup time, not here)."""
+    ctx = Context(name=name or DEFAULT_CONTEXT_NAME, origin=origin or "")
+    _tls.ctx = ctx
+    return ctx
+
+
+def exit_context() -> None:
+    _tls.ctx = None
+
+
+class ContextScope:
+    """``with ContextScope("entrance", origin="app-a"): ...``"""
+
+    def __init__(self, name: str, origin: str = ""):
+        self._name = name
+        self._origin = origin
+        self._prev: Optional[Context] = None
+
+    def __enter__(self) -> Context:
+        self._prev = getattr(_tls, "ctx", None)
+        return enter_context(self._name, self._origin)
+
+    def __exit__(self, *exc) -> None:
+        _tls.ctx = self._prev
+        return None

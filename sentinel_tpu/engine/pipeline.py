@@ -1,0 +1,306 @@
+"""The decision pipeline: slot chain as one fused jitted step.
+
+Reference architecture (``sentinel-core``, SURVEY §3.1): every entry walks
+``NodeSelectorSlot → ClusterBuilderSlot → LogSlot → StatisticSlot →
+AuthoritySlot → SystemSlot → [ParamFlowSlot] → FlowSlot → DegradeSlot``, where
+``StatisticSlot`` fires the rule slots FIRST and records pass/block *after*
+the decision returns (``StatisticSlot.java:54-131``) — statistics are
+post-decision, and that ordering is preserved here.
+
+TPU-native shape: the whole chain is two pure functions over dense state —
+
+* :func:`decide_entries` — batch of entry events → verdicts + updated state;
+* :func:`record_exits`  — batch of completions → updated state (RT/success/
+  exception recording + circuit-breaker feed, ``StatisticSlot.exit`` +
+  ``DegradeSlot.exit``).
+
+Node-tree equivalents are *views* over rows (SURVEY §7 phase 1): the global
+per-resource row is the ClusterNode, hashed (resource × origin) and
+(resource × context) rows in the ``alt`` table are origin-/chain-DefaultNodes,
+and row 0 aggregates all inbound traffic (ENTRY_NODE). Gating masks cascade
+through the slots so an event blocked upstream never consumes downstream
+quota (a blocked-by-authority request can't eat flow tokens or a breaker
+probe), and blocked events don't record pass counts — decision-before-
+statistics, like the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sentinel_tpu.core.errors import BlockReason
+from sentinel_tpu.core.registry import ENTRY_NODE_ROW
+from sentinel_tpu.rules import authority as auth_mod
+from sentinel_tpu.rules import degrade as deg_mod
+from sentinel_tpu.rules import flow as flow_mod
+from sentinel_tpu.rules import system as sys_mod
+from sentinel_tpu.stats import events as ev
+from sentinel_tpu.stats.window import (
+    WindowSpec, WindowState, add_rows, init_window, invalidate_rows,
+    refresh_rows,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineSpec:
+    """Static engine geometry (hashable; closed over by the jitted steps)."""
+
+    rows: int                 # R — main resource rows (row 0 = ENTRY_NODE)
+    alt_rows: int             # RA — hashed (resource×origin/context) rows
+    second: WindowSpec
+    minute: Optional[WindowSpec]
+    statistic_max_rt: int
+
+
+class SentinelState(NamedTuple):
+    """All mutable device state, one pytree."""
+
+    second: WindowState           # [R]
+    minute: WindowState           # [R] (rows=1 when minute disabled)
+    alt_second: WindowState       # [RA]
+    threads: jnp.ndarray          # int32[R]
+    alt_threads: jnp.ndarray      # int32[RA]
+    flow_dyn: flow_mod.FlowDynState
+    breakers: deg_mod.BreakerState
+
+
+class RuleSet(NamedTuple):
+    """All compiled rule tables; swapped atomically on rule reload."""
+
+    flow_table: flow_mod.FlowRuleTable
+    flow_idx: jnp.ndarray
+    deg_table: deg_mod.DegradeRuleTable
+    deg_idx: jnp.ndarray
+    auth_table: auth_mod.AuthorityRuleTable
+    auth_idx: jnp.ndarray
+    sys_thresholds: sys_mod.SystemThresholds
+
+
+class EntryBatch(NamedTuple):
+    """Device-side entry events (padded to static size; padding: rows >= R,
+    valid False)."""
+
+    rows: jnp.ndarray           # int32[B]
+    origin_ids: jnp.ndarray     # int32[B] (0 = none)
+    origin_rows: jnp.ndarray    # int32[B] (>= RA = none)
+    context_ids: jnp.ndarray    # int32[B]
+    chain_rows: jnp.ndarray     # int32[B] (>= RA = none)
+    acquire: jnp.ndarray        # int32[B]
+    is_in: jnp.ndarray          # bool[B]
+    prioritized: jnp.ndarray    # bool[B]
+    valid: jnp.ndarray          # bool[B]
+
+
+class ExitBatch(NamedTuple):
+    rows: jnp.ndarray           # int32[B]
+    origin_rows: jnp.ndarray    # int32[B]
+    chain_rows: jnp.ndarray     # int32[B]
+    acquire: jnp.ndarray        # int32[B]
+    rt_ms: jnp.ndarray          # int32[B]
+    error: jnp.ndarray          # bool[B]
+    is_in: jnp.ndarray          # bool[B]
+    valid: jnp.ndarray          # bool[B]
+
+
+class Verdicts(NamedTuple):
+    allow: jnp.ndarray          # bool[B]
+    reason: jnp.ndarray         # int8[B] (BlockReason codes)
+    wait_ms: jnp.ndarray        # int32[B]
+
+
+def init_state(spec: EngineSpec, nf: int, nd: int) -> SentinelState:
+    minute_rows = spec.rows if spec.minute else 1
+    minute_spec = spec.minute or WindowSpec(1, 1000, track_rt=False)
+    return SentinelState(
+        second=init_window(spec.second, spec.rows),
+        minute=init_window(minute_spec, minute_rows),
+        alt_second=init_window(spec.second, spec.alt_rows),
+        threads=jnp.zeros((spec.rows,), jnp.int32),
+        alt_threads=jnp.zeros((spec.alt_rows,), jnp.int32),
+        flow_dyn=flow_mod.init_flow_dyn(nf),
+        breakers=deg_mod.init_breaker_state(nd),
+    )
+
+
+def decide_entries(
+    spec: EngineSpec,
+    rules: RuleSet,
+    state: SentinelState,
+    batch: EntryBatch,
+    now_idx_s: jnp.ndarray,
+    now_idx_m: jnp.ndarray,
+    rel_now_ms: jnp.ndarray,
+    load1: jnp.ndarray,
+    cpu_usage: jnp.ndarray,
+) -> Tuple[SentinelState, Verdicts]:
+    """One device step: decide a batch, then record post-decision statistics."""
+    R = spec.rows
+    RA = spec.alt_rows
+
+    # ---- slot cascade (each gate only sees events still alive) ----
+    live = batch.valid
+
+    auth_ok = auth_mod.authority_check(
+        rules.auth_table, rules.auth_idx, batch.rows, batch.origin_ids, live)
+    live1 = live & auth_ok
+
+    # unset thresholds fold to a huge sentinel, so the check is a no-op pass
+    # when no system rules are loaded (no branch: avoids retracing)
+    sys_ok = sys_mod.system_check(
+        rules.sys_thresholds, spec.second, state.second, state.threads,
+        batch.is_in, batch.acquire, live1, now_idx_s, load1, cpu_usage,
+        spec.statistic_max_rt)
+    live2 = live1 & sys_ok
+
+    fview = flow_mod.FlowBatchView(
+        rows=batch.rows, origin_ids=batch.origin_ids,
+        origin_rows=batch.origin_rows, context_ids=batch.context_ids,
+        chain_rows=batch.chain_rows, acquire=batch.acquire, valid=live2)
+    flow_dyn, flow_ok, wait_ms = flow_mod.flow_check(
+        rules.flow_table, state.flow_dyn, rules.flow_idx, spec.second,
+        state.second, state.alt_second, state.threads, state.alt_threads,
+        fview, now_idx_s, rel_now_ms,
+        minute_spec=spec.minute,
+        main_minute=state.minute if spec.minute else None,
+        now_idx_m=now_idx_m)
+    live3 = live2 & flow_ok
+
+    breakers, deg_ok = deg_mod.degrade_entry_check(
+        rules.deg_table, state.breakers, rules.deg_idx, batch.rows, live3,
+        rel_now_ms)
+
+    allow = live & auth_ok & sys_ok & flow_ok & deg_ok
+    reason = jnp.zeros(batch.rows.shape, jnp.int8)
+    reason = jnp.where(~deg_ok, jnp.int8(BlockReason.DEGRADE), reason)
+    reason = jnp.where(~flow_ok, jnp.int8(BlockReason.FLOW), reason)
+    reason = jnp.where(~sys_ok, jnp.int8(BlockReason.SYSTEM), reason)
+    reason = jnp.where(~auth_ok, jnp.int8(BlockReason.AUTHORITY), reason)
+    reason = jnp.where(~batch.valid, jnp.int8(BlockReason.NONE), reason)
+    wait_ms = jnp.where(allow, wait_ms, 0)
+
+    # ---- StatisticSlot.entry (post-decision recording) ----
+    passed = allow & batch.valid
+    blocked = ~allow & batch.valid
+    pad_r = jnp.int32(R)
+    pad_a = jnp.int32(RA)
+
+    # target rows: event row, ENTRY row (IN only), origin row, chain row
+    main_rows = jnp.where(batch.valid, batch.rows, pad_r)
+    entry_rows = jnp.where(batch.valid & batch.is_in,
+                           jnp.int32(ENTRY_NODE_ROW), pad_r)
+    alt_o = jnp.where(batch.valid, batch.origin_rows, pad_a)
+    alt_c = jnp.where(batch.valid, batch.chain_rows, pad_a)
+
+    main_targets = jnp.concatenate([main_rows, entry_rows])
+    alt_targets = jnp.concatenate([alt_o, alt_c])
+    pass2 = jnp.concatenate([passed, passed])
+    acq2 = jnp.concatenate([batch.acquire, batch.acquire])
+    pass_amt = jnp.where(pass2, acq2, 0)
+    block_amt = jnp.where(jnp.concatenate([blocked, blocked]), acq2, 0)
+
+    second = refresh_rows(spec.second, state.second, main_targets, now_idx_s)
+    second = add_rows(spec.second, second, main_targets, ev.PASS, pass_amt, now_idx_s)
+    second = add_rows(spec.second, second, main_targets, ev.BLOCK, block_amt, now_idx_s)
+
+    alt_second = refresh_rows(spec.second, state.alt_second, alt_targets, now_idx_s)
+    alt_second = add_rows(spec.second, alt_second, alt_targets, ev.PASS, pass_amt, now_idx_s)
+    alt_second = add_rows(spec.second, alt_second, alt_targets, ev.BLOCK, block_amt, now_idx_s)
+
+    minute = state.minute
+    if spec.minute:
+        minute = refresh_rows(spec.minute, state.minute, main_targets, now_idx_m)
+        minute = add_rows(spec.minute, minute, main_targets, ev.PASS, pass_amt, now_idx_m)
+        minute = add_rows(spec.minute, minute, main_targets, ev.BLOCK, block_amt, now_idx_m)
+
+    thr_amt = jnp.where(pass2, 1, 0)  # +1 per entry (reference curThreadNum)
+    threads = state.threads.at[jnp.where(pass2, main_targets, pad_r)].add(
+        thr_amt, mode="drop")
+    alt_threads = state.alt_threads.at[jnp.where(pass2, alt_targets, pad_a)].add(
+        thr_amt, mode="drop")
+
+    new_state = SentinelState(
+        second=second, minute=minute, alt_second=alt_second,
+        threads=threads, alt_threads=alt_threads,
+        flow_dyn=flow_dyn, breakers=breakers)
+    return new_state, Verdicts(allow=allow, reason=reason, wait_ms=wait_ms)
+
+
+def record_exits(
+    spec: EngineSpec,
+    rules: RuleSet,
+    state: SentinelState,
+    batch: ExitBatch,
+    now_idx_s: jnp.ndarray,
+    now_idx_m: jnp.ndarray,
+    rel_now_ms: jnp.ndarray,
+) -> SentinelState:
+    """Completion step: ``StatisticSlot.exit`` (rt/success/exception, thread
+    decrement, for node + origin + chain + ENTRY) then ``DegradeSlot.exit``
+    (breaker feed)."""
+    R = spec.rows
+    RA = spec.alt_rows
+    pad_r = jnp.int32(R)
+    pad_a = jnp.int32(RA)
+
+    main_rows = jnp.where(batch.valid, batch.rows, pad_r)
+    entry_rows = jnp.where(batch.valid & batch.is_in,
+                           jnp.int32(ENTRY_NODE_ROW), pad_r)
+    alt_o = jnp.where(batch.valid, batch.origin_rows, pad_a)
+    alt_c = jnp.where(batch.valid, batch.chain_rows, pad_a)
+
+    main_targets = jnp.concatenate([main_rows, entry_rows])
+    alt_targets = jnp.concatenate([alt_o, alt_c])
+    valid2 = jnp.concatenate([batch.valid, batch.valid])
+    acq2 = jnp.where(valid2, jnp.concatenate([batch.acquire, batch.acquire]), 0)
+    rt2 = jnp.concatenate([batch.rt_ms, batch.rt_ms])
+    err2 = jnp.where(jnp.concatenate([batch.error, batch.error]), acq2, 0)
+    succ_amt = jnp.where(valid2, acq2, 0)
+
+    second = refresh_rows(spec.second, state.second, main_targets, now_idx_s)
+    second = add_rows(spec.second, second, main_targets, ev.SUCCESS, succ_amt,
+                      now_idx_s, rt_ms=rt2)
+    second = add_rows(spec.second, second, main_targets, ev.EXCEPTION, err2, now_idx_s)
+
+    alt_second = refresh_rows(spec.second, state.alt_second, alt_targets, now_idx_s)
+    alt_second = add_rows(spec.second, alt_second, alt_targets, ev.SUCCESS,
+                          succ_amt, now_idx_s, rt_ms=rt2)
+    alt_second = add_rows(spec.second, alt_second, alt_targets, ev.EXCEPTION,
+                          err2, now_idx_s)
+
+    minute = state.minute
+    if spec.minute:
+        minute = refresh_rows(spec.minute, state.minute, main_targets, now_idx_m)
+        minute = add_rows(spec.minute, minute, main_targets, ev.SUCCESS,
+                          succ_amt, now_idx_m)
+        minute = add_rows(spec.minute, minute, main_targets, ev.EXCEPTION,
+                          err2, now_idx_m)
+
+    dec = jnp.where(valid2, 1, 0)
+    threads = state.threads.at[main_targets].add(-dec, mode="drop")
+    threads = jnp.maximum(threads, 0)
+    alt_threads = state.alt_threads.at[alt_targets].add(-dec, mode="drop")
+    alt_threads = jnp.maximum(alt_threads, 0)
+
+    breakers = deg_mod.degrade_exit_feed(
+        rules.deg_table, state.breakers, rules.deg_idx, batch.rows,
+        batch.rt_ms, batch.error, batch.valid, rel_now_ms)
+
+    return SentinelState(
+        second=second, minute=minute, alt_second=alt_second,
+        threads=threads, alt_threads=alt_threads,
+        flow_dyn=state.flow_dyn, breakers=breakers)
+
+
+def invalidate_resource_rows(spec: EngineSpec, state: SentinelState,
+                             rows: jnp.ndarray) -> SentinelState:
+    """Forget recycled rows' stats (registry eviction hygiene)."""
+    second = invalidate_rows(spec.second, state.second, rows)
+    minute = state.minute
+    if spec.minute:
+        minute = invalidate_rows(spec.minute, state.minute, rows)
+    threads = state.threads.at[rows].set(0, mode="drop")
+    return state._replace(second=second, minute=minute, threads=threads)

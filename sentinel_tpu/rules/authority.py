@@ -1,0 +1,109 @@
+"""Origin authority rules (AuthoritySlot).
+
+Reference (``sentinel-core/.../slots/block/authority/AuthorityRuleChecker``):
+``limitApp`` is a comma-separated origin list; WHITE passes only origins in
+the list, BLACK blocks origins in the list; an empty event origin always
+passes. Exact string matching (no prefixes), so origins intern cleanly into
+registry ids and membership becomes an integer set probe.
+
+TPU-native shape: per-rule padded id lists ``origin_ids[NA, M]`` (-1 pad);
+membership = ``any(origin == ids)`` over the gathered rule rows. One rule per
+(resource) is typical; Ka=2 slots supported like the other rule kinds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+STRATEGY_WHITE = 0
+STRATEGY_BLACK = 1
+
+MAX_ORIGINS_PER_RULE = 16
+
+
+@dataclasses.dataclass
+class AuthorityRule:
+    resource: str
+    limit_app: str               # comma-separated origins
+    strategy: int = STRATEGY_WHITE
+
+    def is_valid(self) -> bool:
+        return bool(self.resource) and bool(self.limit_app.strip()) and \
+            self.strategy in (STRATEGY_WHITE, STRATEGY_BLACK)
+
+
+class AuthorityRuleTable(NamedTuple):
+    active: jnp.ndarray        # bool[NA+1]
+    strategy: jnp.ndarray      # int32[NA+1]
+    origin_ids: jnp.ndarray    # int32[NA+1, M], -1 padded
+
+
+class CompiledAuthorityRules(NamedTuple):
+    table: AuthorityRuleTable
+    rule_idx: jnp.ndarray      # int32[R, Ka]
+    rules: Tuple[AuthorityRule, ...]
+    num_active: int
+
+
+def compile_authority_rules(rules: Sequence[AuthorityRule], *, resource_registry,
+                            origin_registry, capacity: int, k_per_resource: int,
+                            num_rows: int) -> CompiledAuthorityRules:
+    valid = [r for r in rules if r.is_valid()]
+    if len(valid) > capacity:
+        raise ValueError(f"too many authority rules: {len(valid)} > {capacity}")
+    na = capacity
+    active = np.zeros(na + 1, np.bool_)
+    strategy = np.zeros(na + 1, np.int32)
+    origin_ids = np.full((na + 1, MAX_ORIGINS_PER_RULE), -1, np.int32)
+    rule_idx = np.full((num_rows, k_per_resource), na, np.int32)
+    slots_used = {}
+    for j, r in enumerate(valid):
+        row = resource_registry.pin(r.resource)
+        k = slots_used.get(row, 0)
+        if k >= k_per_resource:
+            raise ValueError(
+                f"more than {k_per_resource} authority rules for {r.resource!r}")
+        slots_used[row] = k + 1
+        rule_idx[row, k] = j
+        active[j] = True
+        strategy[j] = r.strategy
+        origins = [o.strip() for o in r.limit_app.split(",") if o.strip()]
+        if len(origins) > MAX_ORIGINS_PER_RULE:
+            raise ValueError(
+                f"authority rule for {r.resource!r} lists {len(origins)} origins "
+                f"(max {MAX_ORIGINS_PER_RULE})")
+        for m, o in enumerate(origins):
+            origin_ids[j, m] = origin_registry.pin(o)
+    table = AuthorityRuleTable(
+        active=jnp.asarray(active), strategy=jnp.asarray(strategy),
+        origin_ids=jnp.asarray(origin_ids))
+    return CompiledAuthorityRules(table=table, rule_idx=jnp.asarray(rule_idx),
+                                  rules=tuple(valid), num_active=len(valid))
+
+
+def authority_check(
+    table: AuthorityRuleTable, rule_idx: jnp.ndarray,
+    rows: jnp.ndarray, origin_ids: jnp.ndarray, valid: jnp.ndarray,
+) -> jnp.ndarray:
+    """→ allow bool[B] (False = AuthorityException)."""
+    B = rows.shape[0]
+    Ka = rule_idx.shape[1]
+    NA = table.active.shape[0] - 1
+    R = rule_idx.shape[0]
+
+    safe_rows = jnp.minimum(rows, R - 1)
+    rules_bk = jnp.where((rows < R)[:, None], rule_idx[safe_rows], NA)  # [B,Ka]
+    act = table.active[rules_bk]
+    member = jnp.any(
+        table.origin_ids[rules_bk] == origin_ids[:, None, None], axis=2)  # [B,Ka]
+    white_ok = member
+    black_ok = ~member
+    rule_ok = jnp.where(table.strategy[rules_bk] == STRATEGY_WHITE,
+                        white_ok, black_ok)
+    # empty origin (id 0) always passes (AuthorityRuleChecker early return)
+    rule_ok = rule_ok | (origin_ids == 0)[:, None] | ~act
+    return jnp.all(rule_ok, axis=1) | ~valid

@@ -1,0 +1,273 @@
+"""Circuit breakers: vectorized DegradeSlot.
+
+Reference (``sentinel-core/.../slots/block/degrade/``):
+
+* ``DegradeSlot`` — entry: every breaker for the resource must ``tryPass``;
+  exit: if the entry wasn't blocked, ``onRequestComplete`` feeds each breaker.
+* ``AbstractCircuitBreaker`` — CLOSED/OPEN/HALF_OPEN CAS state machine; OPEN
+  → HALF_OPEN probe after ``timeWindow`` s (one winner passes); probe failure
+  re-opens, success closes.
+* ``ResponseTimeCircuitBreaker`` — slow-ratio over a single-bucket LeapArray
+  of ``statIntervalMs`` (``new LeapArray<SlowRequestCounter>(1, intervalMs)``);
+  trips when ``slow/total > slowRatioThreshold`` and ``total >=
+  minRequestAmount``. ``count`` is the max allowed RT.
+* ``ExceptionCircuitBreaker`` — ERROR_RATIO / ERROR_COUNT over the same
+  single-bucket window shape.
+
+TPU-native shape: one struct-of-arrays breaker state; the per-rule
+"single-bucket LeapArray" is a (stamp, slow, total) triple with per-rule
+window length — lazy reset by window-index comparison, wraparound-safe int32
+rel-ms. Probe admission in a batch picks the segment-first event (the CAS
+winner analog).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from sentinel_tpu.ops import segments as seg
+
+# Grades (reference RuleConstant.DEGRADE_GRADE_*)
+GRADE_RT = 0
+GRADE_EXCEPTION_RATIO = 1
+GRADE_EXCEPTION_COUNT = 2
+
+STATE_CLOSED = 0
+STATE_OPEN = 1
+STATE_HALF_OPEN = 2
+
+
+@dataclasses.dataclass
+class DegradeRule:
+    """Host-facing rule (reference ``DegradeRule.java`` field parity)."""
+
+    resource: str
+    grade: int
+    count: float                 # RT: max allowed rt ms; RATIO: [0,1]; COUNT: n
+    time_window: int             # seconds to stay OPEN
+    min_request_amount: int = 5
+    stat_interval_ms: int = 1000
+    slow_ratio_threshold: float = 1.0
+
+    def is_valid(self) -> bool:
+        if not self.resource or self.count < 0 or self.time_window <= 0:
+            return False
+        if self.grade not in (GRADE_RT, GRADE_EXCEPTION_RATIO, GRADE_EXCEPTION_COUNT):
+            return False
+        if self.grade == GRADE_EXCEPTION_RATIO and self.count > 1.0:
+            return False
+        if self.min_request_amount <= 0 or self.stat_interval_ms <= 0:
+            return False
+        if self.grade == GRADE_RT and not (0.0 <= self.slow_ratio_threshold <= 1.0):
+            return False
+        return True
+
+
+class DegradeRuleTable(NamedTuple):
+    """Static device arrays, ND+1 rows (sentinel last)."""
+
+    active: jnp.ndarray              # bool
+    grade: jnp.ndarray               # int32
+    count: jnp.ndarray               # float32
+    retry_timeout_ms: jnp.ndarray    # int32 (time_window * 1000)
+    min_request: jnp.ndarray         # int32
+    interval_ms: jnp.ndarray         # int32
+    ratio_threshold: jnp.ndarray     # float32 (slow ratio or error ratio or count)
+
+
+class BreakerState(NamedTuple):
+    """Mutable device state."""
+
+    state: jnp.ndarray               # int32[ND+1] STATE_*
+    next_retry_ms: jnp.ndarray       # int32[ND+1] rel-ms
+    win_stamp: jnp.ndarray           # int32[ND+1] window index of the bucket
+    bad: jnp.ndarray                 # int32[ND+1] slow or error count
+    total: jnp.ndarray               # int32[ND+1] completed count
+
+
+class CompiledDegradeRules(NamedTuple):
+    table: DegradeRuleTable
+    rule_idx: jnp.ndarray            # int32[R, Kd]
+    rules: Tuple[DegradeRule, ...]
+    num_active: int
+
+
+def init_breaker_state(nd: int) -> BreakerState:
+    return BreakerState(
+        state=jnp.zeros((nd + 1,), jnp.int32),
+        next_retry_ms=jnp.full((nd + 1,), -(2 ** 30), jnp.int32),
+        win_stamp=jnp.full((nd + 1,), -(2 ** 30), jnp.int32),
+        bad=jnp.zeros((nd + 1,), jnp.int32),
+        total=jnp.zeros((nd + 1,), jnp.int32),
+    )
+
+
+def compile_degrade_rules(rules: Sequence[DegradeRule], *, resource_registry,
+                          capacity: int, k_per_resource: int,
+                          num_rows: int) -> CompiledDegradeRules:
+    valid = [r for r in rules if r.is_valid()]
+    if len(valid) > capacity:
+        raise ValueError(f"too many degrade rules: {len(valid)} > {capacity}")
+    nd = capacity
+    active = np.zeros(nd + 1, np.bool_)
+    grade = np.zeros(nd + 1, np.int32)
+    count = np.zeros(nd + 1, np.float32)
+    retry = np.full(nd + 1, 1, np.int32)
+    minreq = np.full(nd + 1, 1, np.int32)
+    interval = np.full(nd + 1, 1000, np.int32)
+    ratio = np.zeros(nd + 1, np.float32)
+    rule_idx = np.full((num_rows, k_per_resource), nd, np.int32)
+    slots_used = {}
+    for j, r in enumerate(valid):
+        row = resource_registry.pin(r.resource)
+        k = slots_used.get(row, 0)
+        if k >= k_per_resource:
+            raise ValueError(
+                f"more than {k_per_resource} degrade rules for {r.resource!r}")
+        slots_used[row] = k + 1
+        rule_idx[row, k] = j
+        active[j] = True
+        grade[j] = r.grade
+        count[j] = r.count
+        retry[j] = r.time_window * 1000
+        minreq[j] = r.min_request_amount
+        interval[j] = r.stat_interval_ms
+        if r.grade == GRADE_RT:
+            ratio[j] = r.slow_ratio_threshold
+        elif r.grade == GRADE_EXCEPTION_RATIO:
+            ratio[j] = r.count
+        else:
+            ratio[j] = r.count  # absolute error count
+    table = DegradeRuleTable(
+        active=jnp.asarray(active), grade=jnp.asarray(grade),
+        count=jnp.asarray(count), retry_timeout_ms=jnp.asarray(retry),
+        min_request=jnp.asarray(minreq), interval_ms=jnp.asarray(interval),
+        ratio_threshold=jnp.asarray(ratio),
+    )
+    return CompiledDegradeRules(table=table, rule_idx=jnp.asarray(rule_idx),
+                                rules=tuple(valid), num_active=len(valid))
+
+
+def degrade_entry_check(
+    table: DegradeRuleTable, st: BreakerState, rule_idx: jnp.ndarray,
+    rows: jnp.ndarray, valid: jnp.ndarray, rel_now_ms: jnp.ndarray,
+) -> Tuple[BreakerState, jnp.ndarray]:
+    """→ (state', allow bool[B]).
+
+    CLOSED passes; OPEN passes one probe per rule once the retry window
+    elapsed (transitioning to HALF_OPEN); HALF_OPEN blocks (the in-flight
+    probe owns it). Mirrors ``AbstractCircuitBreaker.tryPass`` +
+    ``fromOpenToHalfOpen`` with segment-first as the CAS winner.
+    """
+    B = rows.shape[0]
+    Kd = rule_idx.shape[1]
+    ND = table.active.shape[0] - 1
+    R = rule_idx.shape[0]
+
+    safe_rows = jnp.minimum(rows, R - 1)
+    rules_bk = jnp.where((rows < R)[:, None], rule_idx[safe_rows], ND)
+    rj = rules_bk.reshape(-1)
+    valid_bk = jnp.repeat(valid, Kd) & table.active[rj]
+    rj_seg = jnp.where(valid_bk, rj, ND)
+
+    order = seg.sort_by_keys(rj_seg, jnp.zeros_like(rj_seg))
+    rj_s = rj_seg[order]
+    starts = seg.segment_starts(rj_s, jnp.zeros_like(rj_s))
+
+    state_s = st.state[rj_s]
+    retry_due = (rel_now_ms - st.next_retry_ms[rj_s]) >= 0
+    open_probe = (state_s == STATE_OPEN) & retry_due & starts
+    pass_s = (state_s == STATE_CLOSED) | open_probe | (rj_s == ND)
+
+    pair_pass = seg.unsort(order, pass_s.astype(jnp.int32)).astype(jnp.bool_)
+    allow = jnp.all(pair_pass.reshape(B, Kd), axis=1)
+
+    # OPEN→HALF_OPEN only for rules whose probe event is actually admitted by
+    # ALL breakers of its resource. Transitioning unconditionally would strand
+    # a rule in HALF_OPEN with no in-flight probe to resolve it when a sibling
+    # breaker blocks the event (reference parity: fromOpenToHalfOpen reverts
+    # via entry.whenTerminate when the entry is blocked downstream).
+    event_of_s = order // Kd  # sorted position → originating event index
+    probe_event_ok = allow[event_of_s]
+    probe_rules = jnp.where(open_probe & probe_event_ok, rj_s, ND)
+    new_state = st.state.at[probe_rules].set(STATE_HALF_OPEN, mode="drop")
+    new_state = new_state.at[ND].set(STATE_CLOSED)  # keep sentinel inert
+    st = st._replace(state=new_state)
+
+    return st, allow | ~valid
+
+
+def degrade_exit_feed(
+    table: DegradeRuleTable, st: BreakerState, rule_idx: jnp.ndarray,
+    rows: jnp.ndarray, rt_ms: jnp.ndarray, error: jnp.ndarray,
+    valid: jnp.ndarray, rel_now_ms: jnp.ndarray,
+) -> BreakerState:
+    """Completion feed (``DegradeSlot.exit`` → ``onRequestComplete``).
+
+    Records (total, slow-or-error) into each rule's single bucket with lazy
+    per-rule window reset, resolves HALF_OPEN probes, and trips CLOSED
+    breakers whose window crossed the threshold.
+    """
+    Kd = rule_idx.shape[1]
+    ND = table.active.shape[0] - 1
+    R = rule_idx.shape[0]
+
+    safe_rows = jnp.minimum(rows, R - 1)
+    rules_bk = jnp.where((rows < R)[:, None], rule_idx[safe_rows], ND)
+    rj = rules_bk.reshape(-1)
+    valid_bk = jnp.repeat(valid, Kd) & table.active[rj] & (rj != ND)
+    rj_safe = jnp.where(valid_bk, rj, ND)
+
+    rt_bk = jnp.repeat(rt_ms, Kd)
+    err_bk = jnp.repeat(error, Kd)
+    is_rt = table.grade[rj_safe] == GRADE_RT
+    bad_bk = jnp.where(is_rt, rt_bk.astype(jnp.float32) > table.count[rj_safe],
+                       err_bk).astype(jnp.int32)
+
+    # --- HALF_OPEN probe resolution (before window bookkeeping) ---
+    order = seg.sort_by_keys(rj_safe, jnp.zeros_like(rj_safe))
+    rj_s = rj_safe[order]
+    starts = seg.segment_starts(rj_s, jnp.zeros_like(rj_s))
+    probe = starts & (st.state[rj_s] == STATE_HALF_OPEN) & (rj_s != ND)
+    probe_ok = probe & (bad_bk[order] == 0)
+    probe_fail = probe & (bad_bk[order] != 0)
+    ok_rules = jnp.where(probe_ok, rj_s, ND)
+    fail_rules = jnp.where(probe_fail, rj_s, ND)
+    state = st.state.at[ok_rules].set(STATE_CLOSED, mode="drop")
+    state = state.at[fail_rules].set(STATE_OPEN, mode="drop")
+    next_retry = st.next_retry_ms.at[fail_rules].set(
+        rel_now_ms + table.retry_timeout_ms[fail_rules], mode="drop")
+    # closing resets the stat window (reference resetStat on close)
+    win_stamp = st.win_stamp.at[ok_rules].set(-(2 ** 30), mode="drop")
+    state = state.at[ND].set(STATE_CLOSED)
+    st = st._replace(state=state, next_retry_ms=next_retry, win_stamp=win_stamp)
+
+    # --- single-bucket lazy reset + scatter-add ---
+    widx = rel_now_ms // jnp.maximum(table.interval_ms[rj_safe], 1)   # [BK]
+    keep = (st.win_stamp[rj_safe] == widx).astype(jnp.int32)
+    bad0 = st.bad.at[rj_safe].multiply(keep, mode="drop")
+    total0 = st.total.at[rj_safe].multiply(keep, mode="drop")
+    stamp = st.win_stamp.at[rj_safe].set(widx, mode="drop")
+    ones = valid_bk.astype(jnp.int32)
+    bad1 = bad0.at[rj_safe].add(bad_bk * ones, mode="drop")
+    total1 = total0.at[rj_safe].add(ones, mode="drop")
+    st = st._replace(bad=bad1, total=total1, win_stamp=stamp)
+
+    # --- trip CLOSED breakers (vector over rules) ---
+    grade = table.grade
+    totals = st.total.astype(jnp.float32)
+    bads = st.bad.astype(jnp.float32)
+    enough = st.total >= table.min_request
+    ratio = bads / jnp.maximum(totals, 1.0)
+    trip_ratio = enough & (ratio > table.ratio_threshold)
+    # RT grade: reference also trips when ratio threshold >= 1 means never
+    trip_count = bads >= table.ratio_threshold
+    trip = jnp.where(grade == GRADE_EXCEPTION_COUNT, enough & trip_count, trip_ratio)
+    trip = trip & (st.state == STATE_CLOSED) & table.active
+    state = jnp.where(trip, STATE_OPEN, st.state)
+    next_retry = jnp.where(trip, rel_now_ms + table.retry_timeout_ms, st.next_retry_ms)
+    return st._replace(state=state, next_retry_ms=next_retry.astype(jnp.int32))

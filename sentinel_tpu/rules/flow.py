@@ -1,0 +1,465 @@
+"""Flow rules: vectorized FlowSlot / FlowRuleChecker / traffic-shaping controllers.
+
+Reference semantics being reproduced (all paths under
+``sentinel-core/.../slots/block/flow/``):
+
+* ``FlowRuleChecker.checkFlow:44-80`` — every rule configured for the resource
+  must pass; rules not applicable to the event's origin pass trivially (null
+  node selection).
+* ``FlowRuleChecker.selectNodeByRequesterAndStrategy:129-161`` — the *stat row*
+  a rule reads is a function of (limitApp, strategy): global resource row,
+  per-origin row, related resource's row, or per-context (CHAIN) row.
+* ``DefaultController.canPass:50-76`` — reject when
+  ``current + prefix + acquire > count`` (QPS grade reads rolling-second pass;
+  THREAD grade reads live concurrency).
+* ``RateLimiterController:30-90`` — leaky-bucket pacing on a per-rule
+  ``latestPassedTime``; wait ≤ maxQueueingTimeMs else block.
+* ``WarmUpController:66-190`` — Guava-style token ramp: warningToken /
+  maxToken / slope; above the warning line the admitted QPS shrinks to
+  ``1/(aboveToken·slope + 1/count)``; tokens refill once per second using the
+  previous second's pass count.
+
+TPU-native shape: rules compile (host-side numpy, at rule-load time — the
+analog of ``FlowRuleUtil.buildFlowRuleMap``) into a struct-of-arrays
+``FlowRuleTable`` plus a per-resource gather table ``rule_idx[R, K]``; the
+check is one jitted function over (batch × K) rule applications using the
+segment machinery in ``ops/segments.py`` for exact greedy FIFO admission
+within the batch. Divergence from the reference is *bounded batching skew*
+only, licensed by the reference's own tolerated check-then-act races
+(``FlowRuleChecker.java:89``, ``DefaultController.java:87``).
+
+Blocking behaviors return ``wait_ms`` verdicts instead of sleeping the caller
+(the reference's cluster protocol already works this way — ``TokenResult
+.waitInMs`` — generalized here to local mode; the host SDK sleeps).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from sentinel_tpu.ops import segments as seg
+from sentinel_tpu.stats import events as ev
+from sentinel_tpu.stats.window import (
+    WindowSpec, WindowState, prev_window_sum_rows, window_sum_rows,
+)
+
+# Grades (reference RuleConstant.FLOW_GRADE_*)
+GRADE_THREAD = 0
+GRADE_QPS = 1
+# Strategies (RuleConstant.STRATEGY_*)
+STRATEGY_DIRECT = 0
+STRATEGY_RELATE = 1
+STRATEGY_CHAIN = 2
+# Control behaviors (RuleConstant.CONTROL_BEHAVIOR_*)
+BEHAVIOR_DEFAULT = 0
+BEHAVIOR_WARM_UP = 1
+BEHAVIOR_RATE_LIMITER = 2
+BEHAVIOR_WARM_UP_RATE_LIMITER = 3
+
+# limit_origin sentinel codes (limitApp strings "default"/"other")
+LIMIT_DEFAULT = -1
+LIMIT_OTHER = -2
+
+# Stat-row selection kinds (compiled from limitApp × strategy)
+SEL_MAIN = 0    # resource's global row            (default + DIRECT)
+SEL_ORIGIN = 1  # event's per-origin row           (specific origin / other)
+SEL_REF = 2     # related resource's global row    (RELATE)
+SEL_CHAIN = 3   # event's per-context row          (CHAIN, context == refResource)
+
+
+@dataclasses.dataclass
+class FlowRule:
+    """Host-facing rule object (reference ``FlowRule.java`` field parity)."""
+
+    resource: str
+    count: float
+    grade: int = GRADE_QPS
+    limit_app: str = "default"
+    strategy: int = STRATEGY_DIRECT
+    ref_resource: str = ""
+    control_behavior: int = BEHAVIOR_DEFAULT
+    warm_up_period_sec: int = 10
+    max_queueing_time_ms: int = 500
+    cluster_mode: bool = False
+    cluster_flow_id: int = 0
+    cluster_threshold_type: int = 0      # 0 AVG_LOCAL, 1 GLOBAL
+    cluster_fallback_to_local: bool = True
+
+    def is_valid(self) -> bool:
+        if not self.resource or self.count < 0:
+            return False
+        if self.grade not in (GRADE_THREAD, GRADE_QPS):
+            return False
+        if self.strategy in (STRATEGY_RELATE, STRATEGY_CHAIN) and not self.ref_resource:
+            return False
+        if self.control_behavior == BEHAVIOR_WARM_UP and self.warm_up_period_sec <= 0:
+            return False
+        return True
+
+
+class FlowRuleTable(NamedTuple):
+    """Static (per rule-load) device arrays, NF+1 rows; last row = inactive
+    sentinel so padded gathers are harmless."""
+
+    active: jnp.ndarray          # bool[NF+1]
+    grade: jnp.ndarray           # int32
+    count: jnp.ndarray           # float32
+    behavior: jnp.ndarray        # int32
+    sel_kind: jnp.ndarray        # int32 (SEL_*)
+    ref_row: jnp.ndarray         # int32 — main-table row for SEL_REF
+    ref_context: jnp.ndarray     # int32 — required context id for SEL_CHAIN
+    limit_origin: jnp.ndarray    # int32 — LIMIT_DEFAULT/LIMIT_OTHER/origin id
+    max_queue_ms: jnp.ndarray    # int32
+    # warm-up precomputed constants (WarmUpController ctor math)
+    warning_token: jnp.ndarray   # float32
+    max_token: jnp.ndarray       # float32
+    slope: jnp.ndarray           # float32
+    cold_factor: jnp.ndarray     # float32
+    sync_row: jnp.ndarray        # int32 — main-table row used for token sync
+    cluster_mode: jnp.ndarray    # bool
+
+
+class FlowDynState(NamedTuple):
+    """Per-rule mutable shaping state (device)."""
+
+    latest_passed_ms: jnp.ndarray   # int32[NF+1] — rel-ms pacing clock
+    stored_tokens: jnp.ndarray      # float32[NF+1]
+    last_filled_sec: jnp.ndarray    # int32[NF+1] — rel seconds
+
+
+class CompiledFlowRules(NamedTuple):
+    """Host-side compile output."""
+
+    table: FlowRuleTable
+    rule_idx: jnp.ndarray           # int32[R, K] → table row, NF = none
+    rules: Tuple[FlowRule, ...]     # original objects, index-aligned with table
+    num_active: int
+
+
+def init_flow_dyn(nf: int) -> FlowDynState:
+    return FlowDynState(
+        latest_passed_ms=jnp.full((nf + 1,), -(2 ** 30), jnp.int32),
+        stored_tokens=jnp.zeros((nf + 1,), jnp.float32),
+        last_filled_sec=jnp.full((nf + 1,), -(2 ** 30), jnp.int32),
+    )
+
+
+def compile_flow_rules(rules: Sequence[FlowRule], *, resource_registry,
+                       context_registry, capacity: int, k_per_resource: int,
+                       num_rows: int, cold_factor: float = 3.0,
+                       origin_registry=None) -> CompiledFlowRules:
+    """Validate + vectorize rules (the ``FlowRuleUtil`` analog).
+
+    Origin-specific ``limit_app`` strings are interned through
+    ``origin_registry`` (pinned so ids stay stable while referenced).
+    Resources named by rules are pinned in the resource registry.
+    Invalid rules are skipped (reference logs and skips); rules beyond
+    ``capacity`` or more than ``k_per_resource`` per resource raise — unlike
+    the reference's silent 6000-chain cap, overflow here is loud.
+    """
+    valid = [r for r in rules if r.is_valid()]
+    if len(valid) > capacity:
+        raise ValueError(f"too many flow rules: {len(valid)} > capacity {capacity}")
+
+    nf = capacity
+    active = np.zeros(nf + 1, np.bool_)
+    grade = np.zeros(nf + 1, np.int32)
+    count = np.zeros(nf + 1, np.float32)
+    behavior = np.zeros(nf + 1, np.int32)
+    sel_kind = np.zeros(nf + 1, np.int32)
+    ref_row = np.zeros(nf + 1, np.int32)
+    ref_context = np.full(nf + 1, -1, np.int32)
+    limit_origin = np.full(nf + 1, LIMIT_DEFAULT, np.int32)
+    max_queue_ms = np.zeros(nf + 1, np.int32)
+    warning_token = np.zeros(nf + 1, np.float32)
+    max_token = np.zeros(nf + 1, np.float32)
+    slope = np.zeros(nf + 1, np.float32)
+    cold_f = np.full(nf + 1, cold_factor, np.float32)
+    sync_row = np.full(nf + 1, num_rows, np.int32)
+    cluster_mode = np.zeros(nf + 1, np.bool_)
+
+    rule_idx = np.full((num_rows, k_per_resource), nf, np.int32)
+    slots_used = {}
+
+    for j, r in enumerate(valid):
+        row = resource_registry.pin(r.resource)
+        k = slots_used.get(row, 0)
+        if k >= k_per_resource:
+            raise ValueError(
+                f"more than {k_per_resource} flow rules for resource {r.resource!r}; "
+                f"raise max_rules_per_resource")
+        slots_used[row] = k + 1
+        rule_idx[row, k] = j
+
+        active[j] = True
+        grade[j] = r.grade
+        count[j] = r.count
+        behavior[j] = r.control_behavior
+        max_queue_ms[j] = r.max_queueing_time_ms
+        cluster_mode[j] = r.cluster_mode
+        sync_row[j] = row
+
+        la = r.limit_app or "default"
+        if la == "default":
+            limit_origin[j] = LIMIT_DEFAULT
+        elif la == "other":
+            limit_origin[j] = LIMIT_OTHER
+        else:
+            if origin_registry is None:
+                raise ValueError("origin-specific rule needs an origin registry")
+            limit_origin[j] = origin_registry.pin(la)
+
+        if r.strategy == STRATEGY_RELATE:
+            sel_kind[j] = SEL_REF
+            ref_row[j] = resource_registry.pin(r.ref_resource)
+            sync_row[j] = ref_row[j]
+        elif r.strategy == STRATEGY_CHAIN:
+            sel_kind[j] = SEL_CHAIN
+            ref_context[j] = context_registry.pin(r.ref_resource)
+        elif la in ("default",):
+            sel_kind[j] = SEL_MAIN
+        else:
+            # specific origin or "other" + DIRECT → the event's origin row
+            # (FlowRuleChecker.java:137-141,154-158)
+            sel_kind[j] = SEL_ORIGIN
+
+        if r.control_behavior in (BEHAVIOR_WARM_UP, BEHAVIOR_WARM_UP_RATE_LIMITER):
+            # WarmUpController.java:66-90 constructor math
+            wt = (r.warm_up_period_sec * r.count) / (cold_factor - 1.0)
+            mt = wt + 2.0 * r.warm_up_period_sec * r.count / (1.0 + cold_factor)
+            warning_token[j] = wt
+            max_token[j] = mt
+            slope[j] = (cold_factor - 1.0) / r.count / max(mt - wt, 1e-9)
+
+    table = FlowRuleTable(
+        active=jnp.asarray(active), grade=jnp.asarray(grade),
+        count=jnp.asarray(count), behavior=jnp.asarray(behavior),
+        sel_kind=jnp.asarray(sel_kind), ref_row=jnp.asarray(ref_row),
+        ref_context=jnp.asarray(ref_context),
+        limit_origin=jnp.asarray(limit_origin),
+        max_queue_ms=jnp.asarray(max_queue_ms),
+        warning_token=jnp.asarray(warning_token),
+        max_token=jnp.asarray(max_token), slope=jnp.asarray(slope),
+        cold_factor=jnp.asarray(cold_f), sync_row=jnp.asarray(sync_row),
+        cluster_mode=jnp.asarray(cluster_mode),
+    )
+    return CompiledFlowRules(table=table, rule_idx=jnp.asarray(rule_idx),
+                             rules=tuple(valid), num_active=len(valid))
+
+
+# ---------------------------------------------------------------------------
+# Device-side check
+# ---------------------------------------------------------------------------
+
+class FlowBatchView(NamedTuple):
+    """Pre-gathered per-event inputs the flow check needs (built by the
+    engine so gathers are shared across slots)."""
+
+    rows: jnp.ndarray          # int32[B] main row, >= R padding
+    origin_ids: jnp.ndarray    # int32[B]
+    origin_rows: jnp.ndarray   # int32[B] alt-table row, >= RA when absent
+    context_ids: jnp.ndarray   # int32[B]
+    chain_rows: jnp.ndarray    # int32[B] alt-table row, >= RA when absent
+    acquire: jnp.ndarray       # int32[B]
+    valid: jnp.ndarray         # bool[B]
+
+
+def flow_check(
+    table: FlowRuleTable,
+    dyn: FlowDynState,
+    rule_idx: jnp.ndarray,
+    spec: WindowSpec,
+    main_second: WindowState,
+    alt_second: WindowState,
+    main_threads: jnp.ndarray,
+    alt_threads: jnp.ndarray,
+    batch: FlowBatchView,
+    now_idx_s: jnp.ndarray,      # int32 scalar, second-window index
+    rel_now_ms: jnp.ndarray,     # int32 scalar, ms since process epoch
+    minute_spec: Optional[WindowSpec] = None,
+    main_minute: Optional[WindowState] = None,
+    now_idx_m: Optional[jnp.ndarray] = None,
+) -> Tuple[FlowDynState, jnp.ndarray, jnp.ndarray]:
+    """→ (dyn', allow bool[B], wait_ms int32[B]).
+
+    ``allow[i]`` False means blocked by some flow rule. ``wait_ms`` > 0 with
+    ``allow`` True = rate-limiter pass-after-wait (host SDK sleeps).
+    """
+    B = batch.rows.shape[0]
+    K = rule_idx.shape[1]
+    NF = table.active.shape[0] - 1
+    R = rule_idx.shape[0]
+    RA = alt_threads.shape[0]
+
+    safe_rows = jnp.minimum(batch.rows, R - 1)
+    rules_bk = jnp.where((batch.rows < R)[:, None], rule_idx[safe_rows], NF)  # [B,K]
+    rj = rules_bk.reshape(-1)                                                # [BK]
+
+    act = table.active[rj]
+
+    # --- applicability: limitApp × origin (FlowRuleChecker.checkFlow null-node) ---
+    lim = table.limit_origin[rj]
+    origin_bk = jnp.repeat(batch.origin_ids, K)
+    ctx_bk = jnp.repeat(batch.context_ids, K)
+    # "other": origin matches no specific-origin rule of this resource
+    own_rules = rules_bk  # [B,K]
+    specific_hit = jnp.any(
+        (table.limit_origin[own_rules] == batch.origin_ids[:, None])
+        & table.active[own_rules], axis=1)                                   # [B]
+    specific_hit_bk = jnp.repeat(specific_hit, K)
+    app_default = lim == LIMIT_DEFAULT
+    app_specific = lim == origin_bk
+    app_other = (lim == LIMIT_OTHER) & (~specific_hit_bk) & (origin_bk != 0)
+    applicable = act & (app_default | app_specific | app_other)
+    # CHAIN additionally requires the event's context to match refResource
+    kind = table.sel_kind[rj]
+    applicable = applicable & jnp.where(
+        kind == SEL_CHAIN, ctx_bk == table.ref_context[rj], True)
+
+    # --- stat-row selection ---
+    rows_bk = jnp.repeat(batch.rows, K)
+    orow_bk = jnp.repeat(batch.origin_rows, K)
+    crow_bk = jnp.repeat(batch.chain_rows, K)
+    use_alt = (kind == SEL_ORIGIN) | (kind == SEL_CHAIN)
+    sel_main_row = jnp.where(kind == SEL_REF, table.ref_row[rj], rows_bk)
+    sel_alt_row = jnp.where(kind == SEL_CHAIN, crow_bk, orow_bk)
+    # events whose alt row is absent (no origin / no chain stats): rule passes
+    applicable = applicable & jnp.where(use_alt, sel_alt_row < RA, True)
+
+    # --- current counts for the selected rows ---
+    main_pass = window_sum_rows(spec, main_second, jnp.minimum(sel_main_row, R - 1),
+                                ev.PASS, now_idx_s).astype(jnp.float32)
+    alt_pass = window_sum_rows(spec, alt_second, jnp.minimum(sel_alt_row, RA - 1),
+                               ev.PASS, now_idx_s).astype(jnp.float32)
+    cur_pass = jnp.where(use_alt, alt_pass, main_pass)
+    main_thr = main_threads[jnp.minimum(sel_main_row, R - 1)].astype(jnp.float32)
+    alt_thr = alt_threads[jnp.minimum(sel_alt_row, RA - 1)].astype(jnp.float32)
+    cur_thr = jnp.where(use_alt, alt_thr, main_thr)
+
+    # --- warm-up token sync (vector over rules, once per step) ---
+    dyn, eff_limit_per_rule = _warmup_sync_and_limits(
+        table, dyn, spec, main_second, now_idx_s, rel_now_ms,
+        minute_spec, main_minute, now_idx_m)
+    eff_limit = eff_limit_per_rule[rj]                                       # [BK]
+
+    # --- greedy segment admission ---
+    acq_bk = jnp.repeat(batch.acquire, K).astype(jnp.float32)
+    valid_bk = jnp.repeat(batch.valid, K) & applicable
+    # inapplicable pairs get the sentinel rule NF so they share one segment
+    # that never blocks; their acquire contributes nothing.
+    rj_seg = jnp.where(valid_bk, rj, NF)
+    # Pacing state is PER RULE (one latestPassedTime per RateLimiterController
+    # instance), so rate-limiter pairs collapse to one segment per rule; other
+    # behaviors segment by (rule, selected stat row).
+    behavior_bk = table.behavior[rj_seg]
+    is_rl_bk = ((behavior_bk == BEHAVIOR_RATE_LIMITER)
+                | (behavior_bk == BEHAVIOR_WARM_UP_RATE_LIMITER)) & (
+        table.grade[rj_seg] == GRADE_QPS)
+    row_seg = jnp.where(use_alt, sel_alt_row + R, sel_main_row)  # disjoint key space
+    row_seg = jnp.where(is_rl_bk, 0, row_seg)
+    row_seg = jnp.where(valid_bk, row_seg, 0)
+    order = seg.sort_by_keys(rj_seg, row_seg)
+    rj_s = rj_seg[order]
+    row_s = row_seg[order]
+    acq_s = jnp.where(valid_bk, acq_bk, 0.0)[order]
+    starts = seg.segment_starts(rj_s, row_s)
+    leader = seg.segment_leader_index(starts)
+
+    grade_s = table.grade[rj_s]
+    base_s = jnp.where(grade_s == GRADE_QPS, cur_pass[order], cur_thr[order])
+    limit_s = eff_limit[order]
+    behavior_s = table.behavior[rj_s]
+
+    pass_default_s = seg.greedy_admit(base_s, acq_s, limit_s, starts, leader)
+
+    # --- rate limiter (paced queue) ---
+    # Shaped behaviors apply only to QPS-grade rules (FlowRuleUtil
+    # .generateRater falls back to DefaultController for THREAD grade).
+    # cost per element in ms: round(acquire / count * 1000)
+    count_s = jnp.maximum(table.count[rj_s], 1e-9)
+    cost_s = jnp.round(acq_s / count_s * 1000.0).astype(jnp.int32)
+    c_first = seg.segment_broadcast_first(cost_s, leader)
+    L0 = dyn.latest_passed_ms[rj_s]
+    due = (L0 + c_first - rel_now_ms) <= 0
+    base_time = jnp.where(due, rel_now_ms - c_first, L0)
+    _, incl_cost = seg.segment_prefix_sum(cost_s, starts, leader)
+    latest_s = base_time + incl_cost
+    wait_s = jnp.maximum(latest_s - rel_now_ms, 0)
+    is_rl = ((behavior_s == BEHAVIOR_RATE_LIMITER)
+             | (behavior_s == BEHAVIOR_WARM_UP_RATE_LIMITER)) & (grade_s == GRADE_QPS)
+    pass_rl_s = wait_s <= table.max_queue_ms[rj_s]
+    # zero-count rate limiter blocks everything (reference: count<=0 → block)
+    pass_rl_s = pass_rl_s & (table.count[rj_s] > 0)
+
+    pair_pass_s = jnp.where(is_rl, pass_rl_s, pass_default_s)
+    inapplicable_s = rj_s == NF
+    pair_pass_s = pair_pass_s | inapplicable_s
+    pair_wait_s = jnp.where(is_rl & pair_pass_s & ~inapplicable_s, wait_s, 0)
+
+    # update pacing clocks: last passing element's latest per rule segment
+    new_latest = jnp.where(is_rl & pair_pass_s & ~inapplicable_s,
+                           latest_s, -(2 ** 30))
+    dyn = dyn._replace(latest_passed_ms=dyn.latest_passed_ms.at[
+        jnp.where(is_rl & ~inapplicable_s, rj_s, NF)].max(new_latest, mode="drop"))
+
+    # --- combine back to events ---
+    pair_pass = seg.unsort(order, pair_pass_s.astype(jnp.int32)).astype(jnp.bool_)
+    pair_wait = seg.unsort(order, pair_wait_s.astype(jnp.int32))
+    allow = jnp.all(pair_pass.reshape(B, K), axis=1)
+    wait_ms = jnp.max(pair_wait.reshape(B, K), axis=1)
+    allow = allow | ~batch.valid
+    return dyn, allow, wait_ms.astype(jnp.int32)
+
+
+def _warmup_sync_and_limits(
+    table: FlowRuleTable, dyn: FlowDynState, spec: WindowSpec,
+    main_second: WindowState, now_idx_s: jnp.ndarray, rel_now_ms: jnp.ndarray,
+    minute_spec: Optional[WindowSpec], main_minute: Optional[WindowState],
+    now_idx_m: Optional[jnp.ndarray],
+) -> Tuple[FlowDynState, jnp.ndarray]:
+    """Once-per-step warm-up token refill (WarmUpController.syncToken) and the
+    per-rule effective QPS limit for this step.
+
+    Non-warm-up rules get their plain ``count``. Token state syncs against the
+    rule's ``sync_row``, using the previous *second's* pass count — the
+    reference reads ``previousPassQps`` from the MINUTE array's previous 1 s
+    bucket (``StatisticNode.previousPassQps`` → ``rollingCounterInMinute``),
+    so the minute window is the canonical source; without it we fall back to
+    the second window's previous (sub-second) bucket, which under-counts and
+    makes the ramp slower (conservative).
+    """
+    is_wu = ((table.behavior == BEHAVIOR_WARM_UP)
+             | (table.behavior == BEHAVIOR_WARM_UP_RATE_LIMITER)) & (
+        table.grade == GRADE_QPS)
+    R = main_second.stamps.shape[0]
+    srow = jnp.minimum(table.sync_row, R - 1)
+    if minute_spec is not None and main_minute is not None:
+        pass_prev = prev_window_sum_rows(minute_spec, main_minute, srow, ev.PASS,
+                                         now_idx_m).astype(jnp.float32)
+    else:
+        pass_prev = prev_window_sum_rows(spec, main_second, srow, ev.PASS,
+                                         now_idx_s).astype(jnp.float32)
+
+    now_sec = rel_now_ms // 1000
+    should_sync = is_wu & (now_sec > dyn.last_filled_sec)
+    old = dyn.stored_tokens
+    elapsed_s = (now_sec - dyn.last_filled_sec).astype(jnp.float32)
+    refill_ok = (old < table.warning_token) | (
+        (old > table.warning_token)
+        & (pass_prev < table.count / jnp.maximum(table.cold_factor, 1.001)))
+    refilled = jnp.minimum(old + elapsed_s * table.count, table.max_token)
+    new_tokens = jnp.where(refill_ok, refilled, old)
+    new_tokens = jnp.maximum(new_tokens - pass_prev, 0.0)
+    stored = jnp.where(should_sync, new_tokens, old)
+    last_filled = jnp.where(should_sync, now_sec, dyn.last_filled_sec)
+    dyn = dyn._replace(stored_tokens=stored, last_filled_sec=last_filled)
+
+    above = jnp.maximum(stored - table.warning_token, 0.0)
+    warning_qps = 1.0 / (above * table.slope + 1.0 / jnp.maximum(table.count, 1e-9))
+    eff = jnp.where(is_wu & (stored >= table.warning_token),
+                    warning_qps, table.count)
+    return dyn, eff

@@ -1,0 +1,142 @@
+"""System adaptive protection (SystemSlot).
+
+Reference (``sentinel-core/.../slots/system/SystemRuleManager.java``):
+``checkSystem`` gates only ``EntryType.IN`` traffic against *global* inbound
+aggregates — total QPS, total thread count, average RT, system load1 (with the
+BBR-style escape hatch: when load is high, still admit while
+``curThread <= maxSuccessQps × minRt / 1000``), and CPU usage. Thresholds are
+the minimum over all loaded rules (volatile fields rebuilt on rule update);
+load/CPU come from a 1 s ``SystemStatusListener`` poll of the OS.
+
+TPU-native shape: thresholds fold host-side into one scalar struct at rule
+load; the check is a handful of scalar compares broadcast over the batch's IN
+events, with greedy in-batch prefix for the QPS/thread gates. Load and CPU are
+host-sampled floats fed into the step (device code never syscalls).
+
+The global inbound aggregate is row 0 of the main tables (reference
+``Constants.ENTRY_NODE``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from sentinel_tpu.core.registry import ENTRY_NODE_ROW
+from sentinel_tpu.stats import events as ev
+from sentinel_tpu.stats.window import (
+    WindowSpec, WindowState, min_rt_rows, rt_totals, valid_mask,
+)
+
+
+@dataclasses.dataclass
+class SystemRule:
+    """Reference ``SystemRule.java``: any subset of gates; -1 = unset."""
+
+    highest_system_load: float = -1.0
+    highest_cpu_usage: float = -1.0
+    qps: float = -1.0
+    avg_rt: float = -1.0          # ms
+    max_thread: float = -1.0
+
+
+_UNSET = float(2 ** 31)
+
+
+class SystemThresholds(NamedTuple):
+    """Folded minima as a tiny device array pack (all float32 scalars)."""
+
+    max_load: jnp.ndarray
+    max_cpu: jnp.ndarray
+    max_qps: jnp.ndarray
+    max_rt: jnp.ndarray
+    max_thread: jnp.ndarray
+
+
+def compile_system_rules(rules: Sequence[SystemRule]) -> SystemThresholds:
+    def fold(vals):
+        vals = [v for v in vals if v >= 0.0]
+        return min(vals) if vals else _UNSET
+
+    load = fold([r.highest_system_load for r in rules])
+    cpu = fold([r.highest_cpu_usage for r in rules])
+    qps = fold([r.qps for r in rules])
+    rt = fold([r.avg_rt for r in rules])
+    thread = fold([r.max_thread for r in rules])
+    return SystemThresholds(
+        max_load=jnp.float32(load), max_cpu=jnp.float32(cpu),
+        max_qps=jnp.float32(qps), max_rt=jnp.float32(rt),
+        max_thread=jnp.float32(thread),
+    )
+
+
+def host_system_status() -> Tuple[float, float]:
+    """(load1, cpu_usage∈[0,1]) — the ``SystemStatusListener`` analog.
+
+    CPU usage is derived from /proc/stat deltas by the runtime's sampler;
+    this fallback returns load only (cpu -1 = unknown) so the framework works
+    on any POSIX host without psutil.
+    """
+    try:
+        load1 = os.getloadavg()[0]
+    except OSError:  # pragma: no cover
+        load1 = -1.0
+    return load1, -1.0
+
+
+def system_check(
+    thresholds: SystemThresholds,
+    spec: WindowSpec,
+    main_second: WindowState,
+    main_threads: jnp.ndarray,
+    is_in: jnp.ndarray,        # bool[B] — EntryType.IN events only are gated
+    acquire: jnp.ndarray,      # int32[B]
+    valid: jnp.ndarray,        # bool[B]
+    now_idx_s: jnp.ndarray,
+    load1: jnp.ndarray,        # float32 scalar (host-sampled)
+    cpu_usage: jnp.ndarray,    # float32 scalar
+    statistic_max_rt: int,
+) -> jnp.ndarray:
+    """→ allow bool[B] (False = SystemBlockException)."""
+    row0 = jnp.array([ENTRY_NODE_ROW], jnp.int32)
+    gated = is_in & valid
+
+    entry = main_second.counters[ENTRY_NODE_ROW]                  # [Bk, E]
+    live = valid_mask(spec, main_second.stamps[ENTRY_NODE_ROW][None, :], now_idx_s)[0]
+    pass_1s = jnp.sum(jnp.where(live, entry[:, ev.PASS], 0)).astype(jnp.float32)
+    succ_1s = jnp.sum(jnp.where(live, entry[:, ev.SUCCESS], 0)).astype(jnp.float32)
+    rt_sum = jnp.sum(jnp.where(live, main_second.rt_sum[ENTRY_NODE_ROW], 0.0))
+    avg_rt = jnp.where(succ_1s > 0, rt_sum / jnp.maximum(succ_1s, 1.0), 0.0)
+    cur_thread = main_threads[ENTRY_NODE_ROW].astype(jnp.float32)
+    min_rt = min_rt_rows(spec, main_second, row0, now_idx_s,
+                         statistic_max_rt)[0].astype(jnp.float32)
+    # maxSuccessQps (StatisticNode): max bucket success × buckets/sec
+    per_sec = 1000.0 / spec.win_ms
+    max_succ = jnp.max(jnp.where(live, entry[:, ev.SUCCESS], 0)).astype(jnp.float32)
+    max_success_qps = max_succ * per_sec
+
+    # greedy in-batch admission for the global QPS gate: a denied request
+    # never increments ENTRY pass and so must not consume budget for batch
+    # peers (reference counts pass post-decision) — fixed-point refinement,
+    # exact for uniform acquire.
+    acq = jnp.where(gated, acquire, 0).astype(jnp.float32)
+    qps_ok = jnp.ones_like(gated)
+    for _ in range(3):
+        contrib = jnp.where(qps_ok, acq, 0.0)
+        prefix = jnp.cumsum(contrib) - contrib
+        qps_ok = pass_1s + prefix + acq <= thresholds.max_qps
+
+    thread_ok = cur_thread <= thresholds.max_thread
+    rt_ok = avg_rt <= thresholds.max_rt
+
+    # BBR check (SystemRuleManager.checkBbr): applied when load exceeds the
+    # threshold — still admit while concurrency is under the pipe capacity.
+    bbr_ok = (cur_thread <= 1.0) | (cur_thread <= max_success_qps * min_rt / 1000.0)
+    load_ok = (load1 <= thresholds.max_load) | bbr_ok
+    cpu_ok = cpu_usage <= thresholds.max_cpu
+
+    ok = qps_ok & thread_ok & rt_ok & load_ok & cpu_ok
+    return ok | ~gated

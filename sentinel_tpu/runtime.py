@@ -1,0 +1,476 @@
+"""Host runtime: the public facade (SphU/SphO/Tracer analog) around the
+jitted decision pipeline.
+
+The reference's hot path is an in-process method call
+(``SphU.entry → CtSph.entryWithPriority``, SURVEY §3.1); here a guarded call
+becomes one device step. Two API tiers:
+
+* :meth:`Sentinel.entry` — per-call context-manager parity with
+  ``try (Entry e = SphU.entry(name)) { ... }``: pads the event into a small
+  fixed batch, runs the decide step, raises a
+  :class:`~sentinel_tpu.core.errors.BlockException` subclass on deny, sleeps
+  on pass-with-wait (RateLimiter verdicts). Convenient, correct, ~one device
+  round-trip of latency.
+* :meth:`Sentinel.entry_batch` / :meth:`Sentinel.exit_batch` — the throughput
+  tier: numpy arrays in, verdict arrays out; this is what adapters, the
+  cluster token server, and the benchmark drive.
+
+State lives on device; the runtime owns the registries, rule compilation
+(property-cell driven, ``XxxRuleManager.loadRules`` analog), the process
+epoch for wraparound-safe relative time, and the 1 s system-status sampler
+(``SystemStatusListener`` analog).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from sentinel_tpu.core.clock import Clock, global_clock
+from sentinel_tpu.core.config import SentinelConfig, load_config
+from sentinel_tpu.core.context import current_context
+from sentinel_tpu.core.errors import (
+    BlockException, BlockReason, ErrorEntryFreeError, block_exception_for,
+    is_block_exception,
+)
+from sentinel_tpu.core.property import SentinelProperty
+from sentinel_tpu.core.registry import (
+    ENTRY_NODE_ROW, OriginRegistry, Registry, ResourceRegistry,
+)
+from sentinel_tpu.engine.pipeline import (
+    EngineSpec, EntryBatch, ExitBatch, RuleSet, SentinelState, Verdicts,
+    decide_entries, init_state, invalidate_resource_rows, record_exits,
+)
+from sentinel_tpu.rules import authority as auth_mod
+from sentinel_tpu.rules import degrade as deg_mod
+from sentinel_tpu.rules import flow as flow_mod
+from sentinel_tpu.rules import system as sys_mod
+from sentinel_tpu.stats import events as ev
+from sentinel_tpu.stats.window import (
+    MINUTE_SPEC, SECOND_SPEC, WindowSpec, rolling_totals,
+)
+
+ENTRY_TYPE_OUT = 0
+ENTRY_TYPE_IN = 1
+
+
+def _pad_to(arr, b: int, fill, dtype):
+    out = np.full(b, fill, dtype)
+    out[:arr.shape[0] if hasattr(arr, "shape") else len(arr)] = arr
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_steps(spec: EngineSpec):
+    """Compiled steps shared across Sentinel instances with the same geometry
+    (EngineSpec is a frozen, hashable dataclass)."""
+    return (jax.jit(functools.partial(decide_entries, spec)),
+            jax.jit(functools.partial(record_exits, spec)),
+            jax.jit(functools.partial(invalidate_resource_rows, spec)))
+
+_H1 = 0x9E3779B1
+_H2 = 0x85EBCA6B
+_MASK = 0xFFFFFFFF
+
+
+def _alt_hash(row: int, kind: int, key_id: int, ra: int) -> int:
+    """Stable (resource, origin/context) → alt-table row."""
+    h = ((row * _H1) ^ ((key_id * 2 + kind) * _H2)) & _MASK
+    return h % ra
+
+
+class _CpuSampler:
+    """CPU usage from /proc/stat deltas, sampled at most once per second."""
+
+    def __init__(self, clock: Clock):
+        self._clock = clock
+        self._last_ms = -10_000
+        self._last_total = 0
+        self._last_idle = 0
+        self._value = -1.0
+
+    def sample(self) -> Tuple[float, float]:
+        now = self._clock.now_ms()
+        if now - self._last_ms >= 1000:
+            self._last_ms = now
+            try:
+                import os
+                load1 = os.getloadavg()[0]
+            except OSError:  # pragma: no cover
+                load1 = -1.0
+            self._load1 = load1
+            try:
+                with open("/proc/stat") as fh:
+                    parts = fh.readline().split()[1:]
+                vals = [int(x) for x in parts[:8]]
+                total = sum(vals)
+                idle = vals[3] + (vals[4] if len(vals) > 4 else 0)
+                dt = total - self._last_total
+                di = idle - self._last_idle
+                if self._last_total and dt > 0:
+                    self._value = max(0.0, min(1.0, 1.0 - di / dt))
+                self._last_total, self._last_idle = total, idle
+            except (OSError, ValueError, IndexError):  # pragma: no cover
+                self._value = -1.0
+        return getattr(self, "_load1", -1.0), self._value
+
+
+class Entry:
+    """A granted (or in-flight) guarded call. Context-manager; reference
+    ``Entry``/``CtEntry`` with try-with-resources semantics."""
+
+    __slots__ = ("_rt", "resource", "row", "origin_row", "chain_row",
+                 "acquire", "is_in", "create_ms", "error", "_exited")
+
+    def __init__(self, rt: "Sentinel", resource: str, row: int, origin_row: int,
+                 chain_row: int, acquire: int, is_in: bool, create_ms: int):
+        self._rt = rt
+        self.resource = resource
+        self.row = row
+        self.origin_row = origin_row
+        self.chain_row = chain_row
+        self.acquire = acquire
+        self.is_in = is_in
+        self.create_ms = create_ms
+        self.error: Optional[BaseException] = None
+        self._exited = False
+
+    def trace(self, exc: BaseException) -> None:
+        """Reference ``Tracer.trace`` — mark a business exception so it feeds
+        exception-ratio/count circuit breakers and exception QPS."""
+        if exc is not None and not is_block_exception(exc):
+            self.error = exc
+
+    def exit(self) -> None:
+        if self._exited:
+            raise ErrorEntryFreeError(f"entry for {self.resource!r} exited twice")
+        self._exited = True
+        self._rt._exit_one(self)
+
+    def __enter__(self) -> "Entry":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc is not None:
+            self.trace(exc)
+        self.exit()
+        return False
+
+
+class Sentinel:
+    """The framework instance (Env/CtSph + rule managers, in one object)."""
+
+    def __init__(self, config: Optional[SentinelConfig] = None,
+                 clock: Optional[Clock] = None):
+        self.cfg = config or load_config()
+        self.clock = clock or global_clock()
+        cfg = self.cfg
+
+        self.resources = ResourceRegistry(cfg.max_resources)
+        self.origins = OriginRegistry(cfg.max_origins)
+        self.contexts = Registry(2048, reserved=("sentinel_default_context",))
+
+        self.spec = EngineSpec(
+            rows=cfg.max_resources,
+            alt_rows=max(2 * cfg.max_resources, 1024),
+            second=WindowSpec(cfg.second_sample_count,
+                              cfg.second_interval_ms // max(cfg.second_sample_count, 1)),
+            minute=MINUTE_SPEC if cfg.minute_enabled else None,
+            statistic_max_rt=cfg.statistic_max_rt,
+        )
+        # process epoch: wraparound-safe int32 relative time base
+        self.epoch_ms = self.clock.now_ms()
+
+        self._lock = threading.RLock()
+        self._state = init_state(self.spec, cfg.max_flow_rules, cfg.max_degrade_rules)
+        self._compile_empty_rules()
+
+        self.flow_property: SentinelProperty = SentinelProperty()
+        self.degrade_property: SentinelProperty = SentinelProperty()
+        self.system_property: SentinelProperty = SentinelProperty()
+        self.authority_property: SentinelProperty = SentinelProperty()
+        self.flow_property.add_listener(lambda rs: self.load_flow_rules(rs))
+        self.degrade_property.add_listener(lambda rs: self.load_degrade_rules(rs))
+        self.system_property.add_listener(lambda rs: self.load_system_rules(rs))
+        self.authority_property.add_listener(lambda rs: self.load_authority_rules(rs))
+
+        self._cpu = _CpuSampler(self.clock)
+        self._global_on = True  # reference Constants.ON / setSwitch command
+
+        self._jit_decide, self._jit_exit, self._jit_invalidate = _jitted_steps(self.spec)
+
+    # ------------------------------------------------------------------
+    # Rule management (XxxRuleManager.loadRules analog)
+    # ------------------------------------------------------------------
+
+    def _compile_empty_rules(self) -> None:
+        cfg = self.cfg
+        self._flow = flow_mod.compile_flow_rules(
+            [], resource_registry=self.resources, context_registry=self.contexts,
+            capacity=cfg.max_flow_rules, k_per_resource=cfg.max_rules_per_resource,
+            num_rows=cfg.max_resources, cold_factor=float(cfg.cold_factor),
+            origin_registry=self.origins)
+        self._deg = deg_mod.compile_degrade_rules(
+            [], resource_registry=self.resources, capacity=cfg.max_degrade_rules,
+            k_per_resource=cfg.max_rules_per_resource, num_rows=cfg.max_resources)
+        self._auth = auth_mod.compile_authority_rules(
+            [], resource_registry=self.resources, origin_registry=self.origins,
+            capacity=cfg.max_authority_rules, k_per_resource=2,
+            num_rows=cfg.max_resources)
+        self._sys = sys_mod.compile_system_rules([])
+        self._ruleset = self._build_ruleset()
+
+    def _build_ruleset(self) -> RuleSet:
+        return RuleSet(
+            flow_table=self._flow.table, flow_idx=self._flow.rule_idx,
+            deg_table=self._deg.table, deg_idx=self._deg.rule_idx,
+            auth_table=self._auth.table, auth_idx=self._auth.rule_idx,
+            sys_thresholds=self._sys)
+
+    def load_flow_rules(self, rules: Sequence[flow_mod.FlowRule]) -> None:
+        cfg = self.cfg
+        compiled = flow_mod.compile_flow_rules(
+            rules, resource_registry=self.resources, context_registry=self.contexts,
+            capacity=cfg.max_flow_rules, k_per_resource=cfg.max_rules_per_resource,
+            num_rows=cfg.max_resources, cold_factor=float(cfg.cold_factor),
+            origin_registry=self.origins)
+        with self._lock:
+            self._flow = compiled
+            self._ruleset = self._build_ruleset()
+            # fresh shaping state for the new tables (reference rebuilds raters)
+            self._state = self._state._replace(
+                flow_dyn=flow_mod.init_flow_dyn(cfg.max_flow_rules))
+
+    def load_degrade_rules(self, rules: Sequence[deg_mod.DegradeRule]) -> None:
+        cfg = self.cfg
+        compiled = deg_mod.compile_degrade_rules(
+            rules, resource_registry=self.resources, capacity=cfg.max_degrade_rules,
+            k_per_resource=cfg.max_rules_per_resource, num_rows=cfg.max_resources)
+        with self._lock:
+            self._deg = compiled
+            self._ruleset = self._build_ruleset()
+            self._state = self._state._replace(
+                breakers=deg_mod.init_breaker_state(cfg.max_degrade_rules))
+
+    def load_system_rules(self, rules: Sequence[sys_mod.SystemRule]) -> None:
+        with self._lock:
+            self._sys = sys_mod.compile_system_rules(rules)
+            self._ruleset = self._build_ruleset()
+
+    def load_authority_rules(self, rules: Sequence[auth_mod.AuthorityRule]) -> None:
+        cfg = self.cfg
+        compiled = auth_mod.compile_authority_rules(
+            rules, resource_registry=self.resources, origin_registry=self.origins,
+            capacity=cfg.max_authority_rules, k_per_resource=2,
+            num_rows=cfg.max_resources)
+        with self._lock:
+            self._auth = compiled
+            self._ruleset = self._build_ruleset()
+
+    def set_global_switch(self, on: bool) -> None:
+        """Reference setSwitch command — off = everything passes unchecked."""
+        self._global_on = bool(on)
+
+    # ------------------------------------------------------------------
+    # Time helpers
+    # ------------------------------------------------------------------
+
+    def _rel_ms(self, now_ms: int) -> int:
+        return int((now_ms - self.epoch_ms + 2 ** 31) % 2 ** 32 - 2 ** 31)
+
+    def _time_scalars(self, now_ms: int):
+        s = self.spec
+        idx_s = s.second.index_of(now_ms)
+        idx_m = s.minute.index_of(now_ms) if s.minute else 0
+        return (jnp.int32(idx_s), jnp.int32(idx_m), jnp.int32(self._rel_ms(now_ms)))
+
+    # ------------------------------------------------------------------
+    # Per-call API
+    # ------------------------------------------------------------------
+
+    def entry(self, resource: str, *, origin: Optional[str] = None,
+              acquire: int = 1, entry_type: int = ENTRY_TYPE_IN,
+              prioritized: bool = False) -> Entry:
+        """Guard a call. Raises a BlockException subclass when denied;
+        sleeps (via the clock) on pass-with-wait verdicts."""
+        if not self._global_on:
+            now = self.clock.now_ms()
+            return Entry(self, resource, -1, -1, -1, acquire,
+                         entry_type == ENTRY_TYPE_IN, now)
+        ctx = current_context()
+        use_origin = ctx.origin if origin is None else origin
+        # resolve rows ONCE; the same rows feed the verdict and the Entry so
+        # an LRU eviction between lookups can't skew exit accounting
+        row = self.resources.get_or_create(resource)
+        origin_id = self.origins.get_or_create(use_origin) if use_origin else 0
+        o_row, c_row = self._alt_rows_for(row, use_origin, ctx.name)
+        context_id = (self.contexts.get_or_create(ctx.name)
+                      if c_row < self.spec.alt_rows else 0)
+        is_in = entry_type == ENTRY_TYPE_IN
+        verdict = self.decide_raw(
+            np.array([row], np.int32), np.array([origin_id], np.int32),
+            np.array([o_row], np.int32), np.array([context_id], np.int32),
+            np.array([c_row], np.int32), np.array([acquire], np.int32),
+            np.array([is_in], np.bool_), np.array([prioritized], np.bool_))
+        if not bool(verdict.allow[0]):
+            raise block_exception_for(int(verdict.reason[0]), resource,
+                                      origin=use_origin)
+        wait = int(verdict.wait_ms[0])
+        if wait > 0:
+            self.clock.sleep_ms(wait)
+        now = self.clock.now_ms()
+        return Entry(self, resource, row, o_row, c_row, acquire, is_in, now)
+
+    def _alt_rows_for(self, row: int, origin: str, context_name: str):
+        ra = self.spec.alt_rows
+        o_row = ra
+        c_row = ra
+        if origin:
+            o_row = _alt_hash(row, 0, self.origins.get_or_create(origin), ra)
+        if context_name and context_name != "sentinel_default_context":
+            c_row = _alt_hash(row, 1, self.contexts.get_or_create(context_name), ra)
+        return o_row, c_row
+
+    def _exit_one(self, e: Entry) -> None:
+        if e.row < 0:  # global switch was off at entry
+            return
+        now = self.clock.now_ms()
+        rt = max(0, now - e.create_ms)
+        self.exit_batch(
+            rows=np.array([e.row], np.int32),
+            origin_rows=np.array([e.origin_row], np.int32),
+            chain_rows=np.array([e.chain_row], np.int32),
+            acquire=np.array([e.acquire], np.int32),
+            rt_ms=np.array([min(rt, self.cfg.statistic_max_rt)], np.int32),
+            error=np.array([e.error is not None], np.bool_),
+            is_in=np.array([e.is_in], np.bool_))
+
+    # ------------------------------------------------------------------
+    # Batch API (throughput tier)
+    # ------------------------------------------------------------------
+
+    def _pad(self, n: int) -> int:
+        b = 8
+        while b < n:
+            b *= 2
+        return b
+
+    def entry_batch(self, resources: Sequence[str], *,
+                    origins: Optional[Sequence[str]] = None,
+                    contexts: Optional[Sequence[str]] = None,
+                    acquire: Optional[Sequence[int]] = None,
+                    entry_types: Optional[Sequence[int]] = None,
+                    prioritized: Optional[Sequence[bool]] = None) -> Verdicts:
+        n = len(resources)
+        rows = np.fromiter((self.resources.get_or_create(r) for r in resources),
+                           np.int32, count=n)
+        origin_ids = np.zeros(n, np.int32)
+        origin_rows = np.full(n, self.spec.alt_rows, np.int32)
+        context_ids = np.zeros(n, np.int32)
+        chain_rows = np.full(n, self.spec.alt_rows, np.int32)
+        if origins is not None:
+            for i, o in enumerate(origins):
+                if o:
+                    oid = self.origins.get_or_create(o)
+                    origin_ids[i] = oid
+                    origin_rows[i] = _alt_hash(int(rows[i]), 0, oid, self.spec.alt_rows)
+        if contexts is not None:
+            for i, c in enumerate(contexts):
+                if c and c != "sentinel_default_context":
+                    cid = self.contexts.get_or_create(c)
+                    context_ids[i] = cid
+                    chain_rows[i] = _alt_hash(int(rows[i]), 1, cid, self.spec.alt_rows)
+        acq = np.asarray(acquire, np.int32) if acquire is not None else np.ones(n, np.int32)
+        is_in = (np.asarray(entry_types, np.int32) == ENTRY_TYPE_IN) \
+            if entry_types is not None else np.ones(n, np.bool_)
+        prio = np.asarray(prioritized, np.bool_) if prioritized is not None \
+            else np.zeros(n, np.bool_)
+        return self.decide_raw(rows, origin_ids, origin_rows, context_ids,
+                               chain_rows, acq, is_in, prio)
+
+    def decide_raw(self, rows, origin_ids, origin_rows, context_ids, chain_rows,
+                   acquire, is_in, prioritized) -> Verdicts:
+        """Lowest-level host entry point: pre-resolved numpy arrays."""
+        n = rows.shape[0]
+        b = self._pad(n)
+        pad_r = self.spec.rows
+        pad_a = self.spec.alt_rows
+        batch = EntryBatch(
+            rows=_pad_to(rows, b, pad_r, np.int32),
+            origin_ids=_pad_to(origin_ids, b, 0, np.int32),
+            origin_rows=_pad_to(origin_rows, b, pad_a, np.int32),
+            context_ids=_pad_to(context_ids, b, 0, np.int32),
+            chain_rows=_pad_to(chain_rows, b, pad_a, np.int32),
+            acquire=_pad_to(acquire, b, 0, np.int32),
+            is_in=_pad_to(is_in, b, False, np.bool_),
+            prioritized=_pad_to(prioritized, b, False, np.bool_),
+            valid=_pad_to(np.ones(n, np.bool_), b, False, np.bool_),
+        )
+        now = self.clock.now_ms()
+        idx_s, idx_m, rel = self._time_scalars(now)
+        load1, cpu = self._cpu.sample()
+        with self._lock:
+            self._drain_evictions_locked()
+            state, verdicts = self._jit_decide(
+                self._ruleset, self._state, batch, idx_s, idx_m, rel,
+                jnp.float32(load1), jnp.float32(cpu))
+            self._state = state
+        return Verdicts(allow=np.asarray(verdicts.allow)[:n],
+                        reason=np.asarray(verdicts.reason)[:n],
+                        wait_ms=np.asarray(verdicts.wait_ms)[:n])
+
+    def exit_batch(self, *, rows, origin_rows, chain_rows, acquire, rt_ms,
+                   error, is_in) -> None:
+        n = rows.shape[0]
+        b = self._pad(n)
+        batch = ExitBatch(
+            rows=_pad_to(rows, b, self.spec.rows, np.int32),
+            origin_rows=_pad_to(origin_rows, b, self.spec.alt_rows, np.int32),
+            chain_rows=_pad_to(chain_rows, b, self.spec.alt_rows, np.int32),
+            acquire=_pad_to(acquire, b, 0, np.int32),
+            rt_ms=_pad_to(rt_ms, b, 0, np.int32),
+            error=_pad_to(error, b, False, np.bool_),
+            is_in=_pad_to(is_in, b, False, np.bool_),
+            valid=_pad_to(np.ones(n, np.bool_), b, False, np.bool_),
+        )
+        now = self.clock.now_ms()
+        idx_s, idx_m, rel = self._time_scalars(now)
+        with self._lock:
+            self._state = self._jit_exit(self._ruleset, self._state, batch,
+                                         idx_s, idx_m, rel)
+
+    def _drain_evictions_locked(self) -> None:
+        evicted = self.resources.drain_evicted()
+        if evicted:
+            self._state = self._jit_invalidate(
+                self._state, jnp.asarray(np.asarray(evicted, np.int32)))
+
+    # ------------------------------------------------------------------
+    # Introspection (command-surface backing)
+    # ------------------------------------------------------------------
+
+    def node_totals(self, resource: str) -> dict:
+        """Current rolling-second totals for a resource (ClusterNode view)."""
+        row = self.resources.lookup(resource)
+        if row is None:
+            return {}
+        now = self.clock.now_ms()
+        idx_s = jnp.int32(self.spec.second.index_of(now))
+        with self._lock:
+            tot = np.asarray(rolling_totals(self.spec.second,
+                                            self._state.second, idx_s)[row])
+            threads = int(np.asarray(self._state.threads[row]))
+        return {
+            "pass": int(tot[ev.PASS]), "block": int(tot[ev.BLOCK]),
+            "success": int(tot[ev.SUCCESS]), "exception": int(tot[ev.EXCEPTION]),
+            "threads": threads,
+        }
+
+    def breaker_states(self) -> List[int]:
+        with self._lock:
+            return [int(s) for s in np.asarray(self._state.breakers.state[:-1])]

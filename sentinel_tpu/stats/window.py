@@ -135,6 +135,18 @@ def rt_totals(spec: WindowSpec, state: WindowState, now_idx: jnp.ndarray) -> jnp
     return jnp.sum(jnp.where(mask, state.rt_sum, 0.0), axis=1)
 
 
+def prev_window_sum_rows(spec: WindowSpec, state: WindowState, rows: jnp.ndarray,
+                         event: int, now_idx: jnp.ndarray) -> jnp.ndarray:
+    """Value of ``event`` in the *previous* window (index ``now_idx - 1``) per
+    row → int32[N]. Reference: ``StatisticNode.previousPassQps`` /
+    ``LeapArray.getPreviousWindow`` — zero if that bucket was never written or
+    has been recycled since."""
+    k = _bucket_of(spec, now_idx - 1)
+    vals = state.counters[rows, k, event]
+    live = state.stamps[rows, k] == (now_idx - 1)
+    return jnp.where(live, vals, 0)
+
+
 def refresh_rows(spec: WindowSpec, state: WindowState, rows: jnp.ndarray,
                  now_idx: jnp.ndarray) -> WindowState:
     """Lazy-reset the *current* bucket of each touched row.
