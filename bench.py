@@ -1,0 +1,140 @@
+"""Headline benchmark: pass/block decisions/sec @ 1M resources, one chip.
+
+BASELINE.json primary metric. Measures the fused decision pipeline (the full
+slot chain: authority → system → flow → degrade → statistics recording) as a
+jitted device step over a 1M-row counter tensor, with pre-staged event batches
+so the number is device throughput, not host marshalling.
+
+North star (BASELINE.json): ≥50M decisions/sec across 1M resources on a
+v5e-8 ⇒ 6.25M/sec/chip. ``vs_baseline`` = measured / 6.25e6.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Knobs via env: BENCH_RESOURCES, BENCH_BATCH, BENCH_STEPS, BENCH_RULES.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from sentinel_tpu.core.registry import OriginRegistry, Registry, ResourceRegistry
+    from sentinel_tpu.engine.pipeline import (
+        EngineSpec, EntryBatch, RuleSet, decide_entries, init_state,
+    )
+    from sentinel_tpu.rules import authority as auth_mod
+    from sentinel_tpu.rules import degrade as deg_mod
+    from sentinel_tpu.rules import flow as flow_mod
+    from sentinel_tpu.rules import system as sys_mod
+    from sentinel_tpu.stats.window import WindowSpec
+
+    R = int(os.environ.get("BENCH_RESOURCES", str(1 << 20)))        # 1M rows
+    B = int(os.environ.get("BENCH_BATCH", str(1 << 15)))            # 32k events
+    STEPS = int(os.environ.get("BENCH_STEPS", "500"))
+    NRULES = int(os.environ.get("BENCH_RULES", "4096"))
+    WARMUP = 3
+
+    spec = EngineSpec(
+        rows=R, alt_rows=1024,
+        second=WindowSpec(buckets=2, win_ms=500),
+        minute=None,                      # minute ring off: 1M×60 won't fit
+        statistic_max_rt=5000)
+
+    resources = ResourceRegistry(R)
+    origins = OriginRegistry(64)
+    contexts = Registry(64, reserved=("sentinel_default_context",))
+
+    # QPS rules on the first NRULES resources; the rest decide rule-free
+    # (still full statistics recording) — a realistic mixed population.
+    rules = [flow_mod.FlowRule(resource=f"r{i}", count=50.0)
+             for i in range(NRULES)]
+    compiled = flow_mod.compile_flow_rules(
+        rules, resource_registry=resources, context_registry=contexts,
+        capacity=NRULES, k_per_resource=2, num_rows=R, origin_registry=origins)
+    deg_rules = [deg_mod.DegradeRule(resource=f"r{i}",
+                                     grade=deg_mod.GRADE_EXCEPTION_RATIO,
+                                     count=0.5, time_window=10)
+                 for i in range(min(NRULES, 1024))]
+    deg = deg_mod.compile_degrade_rules(
+        deg_rules, resource_registry=resources, capacity=max(len(deg_rules), 1),
+        k_per_resource=2, num_rows=R)
+    auth = auth_mod.compile_authority_rules(
+        [], resource_registry=resources, origin_registry=origins,
+        capacity=16, k_per_resource=2, num_rows=R)
+    ruleset = RuleSet(
+        flow_table=compiled.table, flow_idx=compiled.rule_idx,
+        deg_table=deg.table, deg_idx=deg.rule_idx,
+        auth_table=auth.table, auth_idx=auth.rule_idx,
+        sys_thresholds=sys_mod.compile_system_rules([]))
+
+    state = init_state(spec, NRULES, max(len(deg_rules), 1))
+
+    rng = np.random.default_rng(42)
+    n_batches = 4
+    batches = []
+    for _ in range(n_batches):
+        # 1/4 of traffic on ruled rows (hot), rest uniform over all 1M
+        hot = rng.integers(1, NRULES, B // 4)
+        cold = rng.integers(1, R, B - B // 4)
+        rows = np.concatenate([hot, cold]).astype(np.int32)
+        rng.shuffle(rows)
+        batches.append(EntryBatch(
+            rows=jax.device_put(jnp.asarray(rows)),
+            origin_ids=jnp.zeros(B, jnp.int32),
+            origin_rows=jnp.full(B, spec.alt_rows, jnp.int32),
+            context_ids=jnp.zeros(B, jnp.int32),
+            chain_rows=jnp.full(B, spec.alt_rows, jnp.int32),
+            acquire=jnp.ones(B, jnp.int32),
+            is_in=jnp.ones(B, jnp.bool_),
+            prioritized=jnp.zeros(B, jnp.bool_),
+            valid=jnp.ones(B, jnp.bool_)))
+
+    step = jax.jit(functools.partial(decide_entries, spec), donate_argnums=(1,))
+
+    t0_ms = 1_000_000_000
+    load1 = jnp.float32(0.5)
+    cpu = jnp.float32(0.1)
+
+    def scalars(i):
+        now = t0_ms + i * 2  # 2 ms per step → windows rotate during the run
+        return (jnp.int32(spec.second.index_of(now)), jnp.int32(0),
+                jnp.int32(now - t0_ms))
+
+    print(f"bench: R={R} B={B} steps={STEPS} on {jax.devices()[0]}",
+          file=sys.stderr)
+    for i in range(WARMUP):
+        idx_s, idx_m, rel = scalars(i)
+        state, verdicts = step(ruleset, state, batches[i % n_batches],
+                               idx_s, idx_m, rel, load1, cpu)
+    jax.block_until_ready(state)
+
+    start = time.perf_counter()
+    for i in range(STEPS):
+        idx_s, idx_m, rel = scalars(WARMUP + i)
+        state, verdicts = step(ruleset, state, batches[i % n_batches],
+                               idx_s, idx_m, rel, load1, cpu)
+    jax.block_until_ready((state, verdicts))
+    elapsed = time.perf_counter() - start
+
+    decisions = B * STEPS
+    rate = decisions / elapsed
+    print(f"bench: {decisions} decisions in {elapsed:.3f}s", file=sys.stderr)
+    print(json.dumps({
+        "metric": "decisions_per_sec_1chip_1M_resources",
+        "value": round(rate, 1),
+        "unit": "decisions/s",
+        "vs_baseline": round(rate / 6.25e6, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""Host-side batch staging helpers shared by the local and cluster engines.
+
+Batches are padded to powers of two so jit compiles a small, reused set of
+shapes (the analog of the reference compiling one slot chain per resource,
+``CtSph.lookProcessChain`` — here one executable per batch shape).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def pad_pow2(n: int, floor: int = 8) -> int:
+    """Smallest power of two >= max(n, floor)."""
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+def pad_to(arr, b: int, fill, dtype) -> np.ndarray:
+    """Copy ``arr`` into a length-``b`` array padded with ``fill``."""
+    out = np.full(b, fill, dtype)
+    n = arr.shape[0] if hasattr(arr, "shape") else len(arr)
+    out[:n] = arr
+    return out

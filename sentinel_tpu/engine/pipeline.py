@@ -296,11 +296,23 @@ def record_exits(
 
 
 def invalidate_resource_rows(spec: EngineSpec, state: SentinelState,
-                             rows: jnp.ndarray) -> SentinelState:
-    """Forget recycled rows' stats (registry eviction hygiene)."""
+                             rows: jnp.ndarray,
+                             alt_rows: jnp.ndarray) -> SentinelState:
+    """Forget recycled rows' stats (registry eviction hygiene).
+
+    ``alt_rows`` are the hashed (resource × origin/context) rows the evicted
+    resources ever touched — without clearing them, a recycled main row whose
+    (new resource, origin) pair hashes to the same alt slot would inherit the
+    evicted resource's live origin counters. A hash-collided alt row shared
+    with a live pair loses that pair's short-window stats too — bounded, the
+    same merging the hash already implies.
+    """
     second = invalidate_rows(spec.second, state.second, rows)
     minute = state.minute
     if spec.minute:
         minute = invalidate_rows(spec.minute, state.minute, rows)
     threads = state.threads.at[rows].set(0, mode="drop")
-    return state._replace(second=second, minute=minute, threads=threads)
+    alt_second = invalidate_rows(spec.second, state.alt_second, alt_rows)
+    alt_threads = state.alt_threads.at[alt_rows].set(0, mode="drop")
+    return state._replace(second=second, minute=minute, threads=threads,
+                         alt_second=alt_second, alt_threads=alt_threads)

@@ -1,0 +1,505 @@
+"""Sharded cluster token engine: the TPU-native ClusterFlowChecker.
+
+Reference semantics being reproduced (``sentinel-cluster-server-default``):
+
+* ``ClusterFlowChecker.acquireClusterToken`` (``flow/ClusterFlowChecker.java:55-112``):
+  threshold = ``calcGlobalThreshold(rule) × exceedCount`` where the global
+  threshold is ``count`` (GLOBAL) or ``count × connectedCount`` (AVG_LOCAL);
+  pass ⇒ add PASS/PASS_REQUEST (+OCCUPIED_PASS when prioritized); prioritized
+  deficit ⇒ ``tryOccupyNext`` → SHOULD_WAIT(waitInMs) bounded by
+  ``maxOccupyRatio``; else BLOCK/BLOCK_REQUEST.
+* ``GlobalRequestLimiter`` (``server/connection/../GlobalRequestLimiter.java``):
+  per-namespace inbound token-request QPS self-protection (default 30,000/s,
+  ``ServerFlowConfig.java:26-31``) → TOO_MANY_REQUEST.
+* ``ClusterMetric`` (``statistic/metric/ClusterMetric.java``): 10×100 ms
+  LeapArray of ClusterFlowEvent counters — here the same
+  :mod:`sentinel_tpu.stats.window` dense tensors used by the local engine.
+
+TPU-native shape (SURVEY §2.8 north star): flow counters live in ONE window
+tensor of rows = ``n_shards × flows_per_shard``, sharded over the mesh axis
+``"shard"`` on the row dimension — each device owns its flows' counters, so
+per-flow admission is an entirely local greedy segment scan (no collective on
+the critical path). The *namespace* request-limiter counters are
+shard-local tensors whose pod-global totals are combined with ``lax.psum``
+over ICI inside ``shard_map`` — the reference's single-JVM global view,
+rebuilt as a collective.
+
+The host routes each token request to its flow's owner shard by batch
+position (``ClusterEngine.request_tokens``); cross-shard prefix interaction in
+the namespace limiter is ignored within one batch step, a bounded
+over-admission of the same class the reference tolerates
+(``FlowRuleChecker.java:89`` comment).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import threading
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from sentinel_tpu.ops import segments as seg
+from sentinel_tpu.stats import events as ev
+from sentinel_tpu.stats.window import (
+    WindowSpec, WindowState, init_window, valid_mask, window_sum_all,
+)
+
+# TokenResultStatus parity (CORE/cluster/TokenResultStatus.java)
+STATUS_BAD_REQUEST = -4
+STATUS_TOO_MANY_REQUEST = -2
+STATUS_FAIL = -1
+STATUS_OK = 0
+STATUS_BLOCKED = 1
+STATUS_SHOULD_WAIT = 2
+STATUS_NO_RULE_EXISTS = 3
+STATUS_NO_REF_RULE_EXISTS = 4
+STATUS_NOT_AVAILABLE = 5
+STATUS_RELEASE_OK = 6
+STATUS_ALREADY_RELEASE = 7
+
+# thresholdType (ClusterRuleConstant)
+THRESHOLD_AVG_LOCAL = 0
+THRESHOLD_GLOBAL = 1
+
+# ClusterMetric geometry: sampleCount 10 × interval 1000 ms
+CLUSTER_WINDOW = WindowSpec(buckets=10, win_ms=100, track_rt=False)
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterSpec:
+    """Static sharded-engine geometry (hashable, closed over by jit)."""
+
+    n_shards: int
+    flows_per_shard: int          # L — flow rows owned per shard
+    namespaces: int               # NS — namespace slots
+    window: WindowSpec = CLUSTER_WINDOW
+
+    @property
+    def total_rows(self) -> int:
+        return self.n_shards * self.flows_per_shard
+
+
+class ClusterRuleTable(NamedTuple):
+    """Device rule arrays, row-sharded like the counters ([S·L])."""
+
+    active: jnp.ndarray        # bool[S·L]
+    count: jnp.ndarray         # float32 — rule threshold
+    is_global: jnp.ndarray     # bool — GLOBAL vs AVG_LOCAL
+    exceed: jnp.ndarray        # float32 — exceedCount factor
+    max_occupy: jnp.ndarray    # float32 — maxOccupyRatio
+    ns_id: jnp.ndarray         # int32 — owning namespace
+
+
+class ClusterState(NamedTuple):
+    flows: WindowState             # rows = S·L (sharded on rows)
+    ns: WindowState                # rows = S·NS (sharded: NS local rows/shard)
+
+
+class TokenBatch(NamedTuple):
+    """Routed request batch, arrays [S·Bl] sharded on axis 0."""
+
+    local_rows: jnp.ndarray    # int32 — row within the owner shard [0, L)
+    acquire: jnp.ndarray       # int32
+    prioritized: jnp.ndarray   # bool
+    valid: jnp.ndarray         # bool
+
+
+class TokenVerdicts(NamedTuple):
+    status: jnp.ndarray        # int32[S·Bl] — TokenResultStatus codes
+    wait_ms: jnp.ndarray       # int32[S·Bl]
+    remaining: jnp.ndarray     # int32[S·Bl]
+
+
+def init_cluster_state(spec: ClusterSpec) -> ClusterState:
+    return ClusterState(
+        flows=init_window(spec.window, spec.total_rows),
+        ns=init_window(spec.window, spec.n_shards * spec.namespaces),
+    )
+
+
+def _shard_step(
+    spec: ClusterSpec,
+    table: ClusterRuleTable,
+    state: ClusterState,
+    batch: TokenBatch,
+    connected: jnp.ndarray,     # float32[NS] replicated
+    ns_limit: jnp.ndarray,      # float32[NS] replicated
+    now_idx: jnp.ndarray,       # int32 scalar
+    in_win_ms: jnp.ndarray,     # int32 scalar — ms elapsed inside current window
+) -> Tuple[ClusterState, TokenVerdicts]:
+    """Per-shard body (runs under shard_map; local views)."""
+    w = spec.window
+    L = table.active.shape[0]       # local flow rows
+    NS = spec.namespaces
+    Bl = batch.local_rows.shape[0]  # local batch
+
+    rows = jnp.where(batch.valid, batch.local_rows, 0)
+    active = table.active[rows] & batch.valid
+    ns_req = jnp.where(active, table.ns_id[rows], NS)  # NS = inapplicable seg
+
+    # ---- GlobalRequestLimiter: pod-global per-namespace request QPS (psum) ----
+    ns_local = window_sum_all(w, state.ns, ev.PASS, now_idx).astype(jnp.float32)
+    ns_global = lax.psum(ns_local, "shard")                       # [NS]
+    ns_base = jnp.concatenate([ns_global, jnp.zeros((1,), jnp.float32)])
+    ns_lim = jnp.concatenate([ns_limit, jnp.full((1,), jnp.inf, jnp.float32)])
+
+    order_ns = seg.sort_by_keys(ns_req, jnp.zeros_like(ns_req))
+    ns_s = ns_req[order_ns]
+    starts_ns = seg.segment_starts(ns_s, jnp.zeros_like(ns_s))
+    leader_ns = seg.segment_leader_index(starts_ns)
+    ones = jnp.where(active, 1.0, 0.0)[order_ns]
+    limiter_ok_s = seg.greedy_admit(ns_base[ns_s], ones, ns_lim[ns_s],
+                                    starts_ns, leader_ns)
+    limiter_ok = seg.unsort(order_ns, limiter_ok_s.astype(jnp.int32)).astype(jnp.bool_)
+    proceed = active & limiter_ok
+
+    # ---- per-flow admission (ClusterFlowChecker.acquireClusterToken) ----
+    latest = window_sum_all(w, state.flows, ev.PASS, now_idx).astype(jnp.float32)  # [L]
+    conn = connected[jnp.minimum(table.ns_id, NS - 1)]
+    thr_rule = table.count * jnp.where(table.is_global, 1.0, conn) * table.exceed  # [L]
+
+    seg_rows = jnp.where(proceed, rows, L)  # L = never-blocking sentinel segment
+    order = seg.sort_by_keys(seg_rows, jnp.zeros_like(seg_rows))
+    rows_s = seg_rows[order]
+    starts = seg.segment_starts(rows_s, jnp.zeros_like(rows_s))
+    leader = seg.segment_leader_index(starts)
+    acq_s = jnp.where(proceed, batch.acquire, 0).astype(jnp.float32)[order]
+    safe_rows_s = jnp.minimum(rows_s, L - 1)
+    base_s = latest[safe_rows_s]
+    lim_s = jnp.where(rows_s < L, thr_rule[safe_rows_s], jnp.inf)
+    admit_s = seg.greedy_admit(base_s, acq_s, lim_s, starts, leader)
+    excl_s, _ = seg.segment_prefix_sum(jnp.where(admit_s, acq_s, 0.0), starts, leader)
+    remaining_s = lim_s - base_s - excl_s - acq_s
+    admitted = seg.unsort(order, admit_s.astype(jnp.int32)).astype(jnp.bool_) & proceed
+    remaining = jnp.where(jnp.isfinite(remaining_s), remaining_s, 0.0)
+    remaining = seg.unsort(order, remaining.astype(jnp.int32))
+
+    # ---- occupy: prioritized deficit pre-books future windows ----
+    denied = proceed & ~admitted
+    waiting_sum = window_sum_all(w, state.flows, ev.WAITING, now_idx).astype(jnp.float32)
+    occupy_open = waiting_sum[rows] <= table.max_occupy[rows] * thr_rule[rows]
+    # expiry scan: waiting until bucket k (stamp s_k) rotates out frees its
+    # PASS count at wait = (s_k - now_idx + B)·win - in_win_ms
+    stamps_req = state.flows.stamps[rows]                       # [Bl, B]
+    pass_req = state.flows.counters[rows, :, ev.PASS]           # [Bl, B]
+    live = valid_mask(w, stamps_req, now_idx)
+    delta = jnp.where(live, stamps_req - now_idx, jnp.int32(0))  # [-B+1, 0]
+    # freed(k) = sum of pass in buckets expiring no later than bucket k
+    freed = jnp.sum(
+        jnp.where(live[:, None, :] & (delta[:, None, :] <= delta[:, :, None]),
+                  pass_req[:, None, :], 0), axis=2).astype(jnp.float32)  # [Bl, B]
+    total_pass = latest[rows][:, None]
+    fits = (total_pass - freed + batch.acquire[:, None].astype(jnp.float32)
+            <= thr_rule[rows][:, None]) & live
+    wait_k = (delta + w.buckets) * w.win_ms - in_win_ms          # [Bl, B]
+    wait_k = jnp.where(fits & (wait_k > 0), wait_k, jnp.int32(2 ** 30))
+    best_wait = jnp.min(wait_k, axis=1)
+    should_wait = (denied & batch.prioritized & occupy_open
+                   & (best_wait < 2 ** 30))
+    wait_ms = jnp.where(should_wait, best_wait, 0)
+
+    blocked = denied & ~should_wait
+
+    # ---- record (post-decision, like StatisticSlot ordering) ----
+    pad = jnp.int32(L)
+    def tgt(mask):
+        return jnp.where(mask, rows, pad)
+
+    flows = state.flows
+    from sentinel_tpu.stats.window import add_rows, refresh_rows
+    flows = refresh_rows(w, flows, tgt(proceed), now_idx)
+    acq = batch.acquire
+    flows = add_rows(w, flows, tgt(admitted), ev.PASS, jnp.where(admitted, acq, 0), now_idx)
+    flows = add_rows(w, flows, tgt(admitted), ev.PASS_REQUEST,
+                     jnp.where(admitted, 1, 0), now_idx)
+    flows = add_rows(w, flows, tgt(admitted & batch.prioritized), ev.OCCUPIED_PASS,
+                     jnp.where(admitted & batch.prioritized, acq, 0), now_idx)
+    flows = add_rows(w, flows, tgt(blocked), ev.BLOCK, jnp.where(blocked, acq, 0), now_idx)
+    flows = add_rows(w, flows, tgt(blocked), ev.BLOCK_REQUEST,
+                     jnp.where(blocked, 1, 0), now_idx)
+    flows = add_rows(w, flows, tgt(should_wait), ev.WAITING,
+                     jnp.where(should_wait, acq, 0), now_idx)
+
+    ns_state = state.ns
+    ns_state = refresh_rows(w, ns_state, ns_req, now_idx)
+    ns_state = add_rows(w, ns_state, jnp.where(proceed, ns_req, jnp.int32(NS)),
+                        ev.PASS, jnp.where(proceed, 1, 0), now_idx)
+    ns_state = add_rows(w, ns_state, jnp.where(active & ~limiter_ok, ns_req, jnp.int32(NS)),
+                        ev.BLOCK, jnp.where(active & ~limiter_ok, 1, 0), now_idx)
+
+    status = jnp.full((Bl,), STATUS_FAIL, jnp.int32)
+    status = jnp.where(batch.valid & ~table.active[rows], STATUS_NO_RULE_EXISTS, status)
+    status = jnp.where(active & ~limiter_ok, STATUS_TOO_MANY_REQUEST, status)
+    status = jnp.where(blocked, STATUS_BLOCKED, status)
+    status = jnp.where(should_wait, STATUS_SHOULD_WAIT, status)
+    status = jnp.where(admitted, STATUS_OK, status)
+
+    verdicts = TokenVerdicts(
+        status=status,
+        wait_ms=wait_ms.astype(jnp.int32),
+        remaining=jnp.where(admitted, jnp.maximum(remaining, 0), 0).astype(jnp.int32))
+    return ClusterState(flows=flows, ns=ns_state), verdicts
+
+
+@dataclasses.dataclass
+class ClusterFlowRule:
+    """Host-facing cluster rule (reference ``FlowRule`` cluster fields +
+    ``ClusterFlowConfig``: flowId, thresholdType, count; exceedCount and
+    maxOccupyRatio come from ``ClusterServerConfigManager`` server-wide but are
+    kept per-rule here, defaulting to the reference's 1.0/1.0)."""
+
+    flow_id: int
+    count: float
+    threshold_type: int = THRESHOLD_AVG_LOCAL
+    exceed_count: float = 1.0
+    max_occupy_ratio: float = 1.0
+
+
+class ClusterEngine:
+    """Host facade: flow routing, namespace management, the sharded step.
+
+    The reference's ``ClusterFlowRuleManager`` (flowId→rule, namespace→flowIds,
+    per-namespace property suppliers) + ``DefaultTokenService`` dispatch,
+    collapsed onto dense sharded tensors.
+    """
+
+    def __init__(self, spec: ClusterSpec, mesh: Optional[Mesh] = None,
+                 default_ns_qps: float = 30_000.0):
+        self.spec = spec
+        if mesh is None:
+            devs = jax.devices()[:spec.n_shards]
+            if len(devs) < spec.n_shards:
+                raise ValueError(
+                    f"need {spec.n_shards} devices, have {len(devs)}")
+            mesh = Mesh(np.array(devs), ("shard",))
+        self.mesh = mesh
+        self._sh_rows = NamedSharding(mesh, P("shard"))
+        self._sh_rep = NamedSharding(mesh, P())
+
+        self._flow_to_row: Dict[int, int] = {}
+        self._row_to_flow: Dict[int, int] = {}
+        self._ns_ids: Dict[str, int] = {}
+        self._flow_ns: Dict[int, str] = {}
+        self._rules: Dict[int, ClusterFlowRule] = {}
+        self._connected = np.ones(spec.namespaces, np.float32)
+        self._ns_limit = np.full(spec.namespaces, default_ns_qps, np.float32)
+        self._next_row_per_shard = [0] * spec.n_shards
+        self._free_rows: List[List[int]] = [[] for _ in range(spec.n_shards)]
+        self._rr = 0  # round-robin shard cursor for row allocation
+        self._lock = threading.RLock()  # guards state swap (donated buffers),
+        # routing tables, and rule reloads against concurrent server threads
+
+        self.state = jax.device_put(init_cluster_state(spec), self._sh_rows)
+        self._table = self._empty_table()
+        self._step = self._build_step()
+
+    # ------------------------------------------------------------------
+    def _empty_table(self) -> ClusterRuleTable:
+        n = self.spec.total_rows
+        z = np.zeros(n, np.float32)
+        return jax.device_put(ClusterRuleTable(
+            active=jnp.asarray(np.zeros(n, np.bool_)),
+            count=jnp.asarray(z), is_global=jnp.asarray(np.zeros(n, np.bool_)),
+            exceed=jnp.asarray(np.ones(n, np.float32)),
+            max_occupy=jnp.asarray(np.ones(n, np.float32)),
+            ns_id=jnp.asarray(np.zeros(n, np.int32))), self._sh_rows)
+
+    def _build_step(self):
+        spec = self.spec
+        mesh = self.mesh
+        body = functools.partial(_shard_step, spec)
+        row_spec = P("shard")
+        state_specs = ClusterState(
+            flows=WindowState(*([row_spec] * 4)), ns=WindowState(*([row_spec] * 4)))
+        table_specs = ClusterRuleTable(*([row_spec] * 6))
+        batch_specs = TokenBatch(*([row_spec] * 4))
+        sm = _shard_map(
+            body, mesh=mesh,
+            in_specs=(table_specs, state_specs, batch_specs, P(), P(), P(), P()),
+            out_specs=(state_specs, TokenVerdicts(row_spec, row_spec, row_spec)),
+            check_vma=False)
+        return jax.jit(sm, donate_argnums=(1,))
+
+    # ------------------------------------------------------------------
+    # Namespace / rule management
+    # ------------------------------------------------------------------
+
+    def namespace_id(self, namespace: str) -> int:
+        nid = self._ns_ids.get(namespace)
+        if nid is None:
+            if len(self._ns_ids) >= self.spec.namespaces:
+                raise ValueError("namespace capacity exceeded")
+            nid = len(self._ns_ids)
+            self._ns_ids[namespace] = nid
+        return nid
+
+    def set_connected_count(self, namespace: str, count: int) -> None:
+        """ConnectionManager.getConnectedCount feed for AVG_LOCAL thresholds."""
+        with self._lock:
+            self._connected[self.namespace_id(namespace)] = max(1, count)
+        # connected counts are replicated scalars; no table rebuild needed
+
+    def set_namespace_qps_limit(self, namespace: str, limit: float) -> None:
+        """ServerFlowConfig.maxAllowedQps per namespace (hot-tunable)."""
+        with self._lock:
+            self._ns_limit[self.namespace_id(namespace)] = limit
+
+    def load_rules(self, namespace: str, rules: Sequence[ClusterFlowRule]) -> None:
+        """Replace the namespace's rules (ClusterFlowRuleManager property path).
+
+        Rows of removed flows go to a free list for reuse; their window state
+        is invalidated immediately so a reused row can't inherit the dead
+        flow's live counters.
+        """
+        with self._lock:
+            self.namespace_id(namespace)
+            freed: List[int] = []
+            for fid, ns in list(self._flow_ns.items()):
+                if ns == namespace and fid not in {r.flow_id for r in rules}:
+                    row = self._flow_to_row.pop(fid)
+                    self._row_to_flow.pop(row, None)
+                    self._flow_ns.pop(fid)
+                    self._rules.pop(fid, None)
+                    self._free_rows[row // self.spec.flows_per_shard].append(row)
+                    freed.append(row)
+            for r in rules:
+                if r.flow_id not in self._flow_to_row:
+                    self._flow_to_row[r.flow_id] = self._alloc_row()
+                    self._row_to_flow[self._flow_to_row[r.flow_id]] = r.flow_id
+                self._flow_ns[r.flow_id] = namespace
+                self._rules[r.flow_id] = r
+            if freed:
+                from sentinel_tpu.stats.window import invalidate_rows
+                self.state = self.state._replace(flows=invalidate_rows(
+                    self.spec.window, self.state.flows,
+                    jnp.asarray(np.asarray(freed, np.int32))))
+            self._rebuild_table()
+
+    def _alloc_row(self) -> int:
+        L = self.spec.flows_per_shard
+        for _ in range(self.spec.n_shards):
+            s = self._rr
+            self._rr = (self._rr + 1) % self.spec.n_shards
+            if self._free_rows[s]:
+                return self._free_rows[s].pop()
+            if self._next_row_per_shard[s] < L:
+                local = self._next_row_per_shard[s]
+                self._next_row_per_shard[s] += 1
+                return s * L + local
+        raise ValueError("cluster flow capacity exceeded")
+
+    def _rebuild_table(self) -> None:
+        n = self.spec.total_rows
+        active = np.zeros(n, np.bool_)
+        count = np.zeros(n, np.float32)
+        is_global = np.zeros(n, np.bool_)
+        exceed = np.ones(n, np.float32)
+        max_occ = np.ones(n, np.float32)
+        ns_id = np.zeros(n, np.int32)
+        for fid, row in self._flow_to_row.items():
+            r = self._rules[fid]
+            active[row] = True
+            count[row] = r.count
+            is_global[row] = r.threshold_type == THRESHOLD_GLOBAL
+            exceed[row] = r.exceed_count
+            max_occ[row] = r.max_occupy_ratio
+            ns_id[row] = self._ns_ids[self._flow_ns[fid]]
+        self._table = jax.device_put(ClusterRuleTable(
+            active=jnp.asarray(active), count=jnp.asarray(count),
+            is_global=jnp.asarray(is_global), exceed=jnp.asarray(exceed),
+            max_occupy=jnp.asarray(max_occ), ns_id=jnp.asarray(ns_id)),
+            self._sh_rows)
+
+    # ------------------------------------------------------------------
+    # Token requests
+    # ------------------------------------------------------------------
+
+    def request_tokens(self, flow_ids: Sequence[int], acquire: Sequence[int],
+                       prioritized: Optional[Sequence[bool]] = None,
+                       *, now_ms: int) -> List[Tuple[int, int, int]]:
+        """Batched ``TokenService.requestToken`` → list of
+        ``(status, wait_ms, remaining)`` aligned with the inputs."""
+        from sentinel_tpu.core.batching import pad_pow2
+
+        n = len(flow_ids)
+        S = self.spec.n_shards
+        L = self.spec.flows_per_shard
+        prioritized = prioritized or [False] * n
+
+        with self._lock:
+            per_shard: List[List[int]] = [[] for _ in range(S)]
+            results: List[Optional[Tuple[int, int, int]]] = [None] * n
+            for i, fid in enumerate(flow_ids):
+                row = self._flow_to_row.get(int(fid))
+                if acquire[i] <= 0:
+                    # DefaultTokenService.requestToken count validation
+                    results[i] = (STATUS_BAD_REQUEST, 0, 0)
+                elif row is None:
+                    results[i] = (STATUS_NO_RULE_EXISTS, 0, 0)
+                else:
+                    per_shard[row // L].append(i)
+
+            bl = max((len(p) for p in per_shard), default=0)
+            if bl == 0:
+                return [r or (STATUS_FAIL, 0, 0) for r in results]
+            blp = pad_pow2(bl)
+
+            rows = np.zeros((S, blp), np.int32)
+            acq = np.zeros((S, blp), np.int32)
+            prio = np.zeros((S, blp), np.bool_)
+            valid = np.zeros((S, blp), np.bool_)
+            for s in range(S):
+                for k, i in enumerate(per_shard[s]):
+                    rows[s, k] = self._flow_to_row[int(flow_ids[i])] % L
+                    acq[s, k] = acquire[i]
+                    prio[s, k] = bool(prioritized[i])
+                    valid[s, k] = True
+
+            batch = jax.device_put(TokenBatch(
+                local_rows=jnp.asarray(rows.reshape(-1)),
+                acquire=jnp.asarray(acq.reshape(-1)),
+                prioritized=jnp.asarray(prio.reshape(-1)),
+                valid=jnp.asarray(valid.reshape(-1))), self._sh_rows)
+
+            w = self.spec.window
+            now_idx = jnp.int32(w.index_of(now_ms))
+            in_win = jnp.int32(now_ms % w.win_ms)
+            self.state, verdicts = self._step(
+                self._table, self.state, batch,
+                jax.device_put(jnp.asarray(self._connected), self._sh_rep),
+                jax.device_put(jnp.asarray(self._ns_limit), self._sh_rep),
+                now_idx, in_win)
+
+        st = np.asarray(verdicts.status).reshape(S, blp)
+        wt = np.asarray(verdicts.wait_ms).reshape(S, blp)
+        rm = np.asarray(verdicts.remaining).reshape(S, blp)
+        for s in range(S):
+            for k, i in enumerate(per_shard[s]):
+                results[i] = (int(st[s, k]), int(wt[s, k]), int(rm[s, k]))
+        return [r or (STATUS_FAIL, 0, 0) for r in results]
+
+    def flow_metrics(self, flow_id: int, *, now_ms: int) -> dict:
+        """Per-flow current-window snapshot (ClusterMetricNodeGenerator)."""
+        with self._lock:
+            row = self._flow_to_row.get(flow_id)
+            if row is None:
+                return {}
+            w = self.spec.window
+            now_idx = jnp.int32(w.index_of(now_ms))
+            counters = np.asarray(self.state.flows.counters[row])   # [B, E]
+            stamps = np.asarray(self.state.flows.stamps[row])       # [B]
+        delta = (int(now_idx) - stamps.astype(np.int64)).astype(np.int32)
+        live = (delta >= 0) & (delta < w.buckets)
+        tot = np.where(live[:, None], counters, 0).sum(axis=0)
+        return {name: int(tot[i]) for i, name in enumerate(ev.NAMES)}

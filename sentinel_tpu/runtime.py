@@ -31,6 +31,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from sentinel_tpu.core.batching import pad_pow2, pad_to as _pad_to
 from sentinel_tpu.core.clock import Clock, global_clock
 from sentinel_tpu.core.config import SentinelConfig, load_config
 from sentinel_tpu.core.context import current_context
@@ -57,12 +58,6 @@ from sentinel_tpu.stats.window import (
 
 ENTRY_TYPE_OUT = 0
 ENTRY_TYPE_IN = 1
-
-
-def _pad_to(arr, b: int, fill, dtype):
-    out = np.full(b, fill, dtype)
-    out[:arr.shape[0] if hasattr(arr, "shape") else len(arr)] = arr
-    return out
 
 
 @functools.lru_cache(maxsize=None)
@@ -187,6 +182,9 @@ class Sentinel:
         self.epoch_ms = self.clock.now_ms()
 
         self._lock = threading.RLock()
+        # main row → alt rows it ever hashed to; consulted on row eviction so
+        # the recycled row's origin/context stats are cleared too
+        self._alt_rows_by_row: dict = {}
         self._state = init_state(self.spec, cfg.max_flow_rules, cfg.max_degrade_rules)
         self._compile_empty_rules()
 
@@ -326,14 +324,20 @@ class Sentinel:
         now = self.clock.now_ms()
         return Entry(self, resource, row, o_row, c_row, acquire, is_in, now)
 
+    def _alt_row(self, row: int, kind: int, key_id: int) -> int:
+        """Hash + record the (main row → alt row) edge for eviction hygiene."""
+        r = _alt_hash(row, kind, key_id, self.spec.alt_rows)
+        self._alt_rows_by_row.setdefault(row, set()).add(r)
+        return r
+
     def _alt_rows_for(self, row: int, origin: str, context_name: str):
         ra = self.spec.alt_rows
         o_row = ra
         c_row = ra
         if origin:
-            o_row = _alt_hash(row, 0, self.origins.get_or_create(origin), ra)
+            o_row = self._alt_row(row, 0, self.origins.get_or_create(origin))
         if context_name and context_name != "sentinel_default_context":
-            c_row = _alt_hash(row, 1, self.contexts.get_or_create(context_name), ra)
+            c_row = self._alt_row(row, 1, self.contexts.get_or_create(context_name))
         return o_row, c_row
 
     def _exit_one(self, e: Entry) -> None:
@@ -355,10 +359,7 @@ class Sentinel:
     # ------------------------------------------------------------------
 
     def _pad(self, n: int) -> int:
-        b = 8
-        while b < n:
-            b *= 2
-        return b
+        return pad_pow2(n)
 
     def entry_batch(self, resources: Sequence[str], *,
                     origins: Optional[Sequence[str]] = None,
@@ -378,13 +379,13 @@ class Sentinel:
                 if o:
                     oid = self.origins.get_or_create(o)
                     origin_ids[i] = oid
-                    origin_rows[i] = _alt_hash(int(rows[i]), 0, oid, self.spec.alt_rows)
+                    origin_rows[i] = self._alt_row(int(rows[i]), 0, oid)
         if contexts is not None:
             for i, c in enumerate(contexts):
                 if c and c != "sentinel_default_context":
                     cid = self.contexts.get_or_create(c)
                     context_ids[i] = cid
-                    chain_rows[i] = _alt_hash(int(rows[i]), 1, cid, self.spec.alt_rows)
+                    chain_rows[i] = self._alt_row(int(rows[i]), 1, cid)
         acq = np.asarray(acquire, np.int32) if acquire is not None else np.ones(n, np.int32)
         is_in = (np.asarray(entry_types, np.int32) == ENTRY_TYPE_IN) \
             if entry_types is not None else np.ones(n, np.bool_)
@@ -447,8 +448,15 @@ class Sentinel:
     def _drain_evictions_locked(self) -> None:
         evicted = self.resources.drain_evicted()
         if evicted:
+            alt: List[int] = []
+            for row in evicted:
+                alt.extend(self._alt_rows_by_row.pop(row, ()))
+            rows_arr = _pad_to(np.asarray(evicted, np.int32),
+                               self._pad(len(evicted)), self.spec.rows, np.int32)
+            alt_arr = _pad_to(np.asarray(alt, np.int32), self._pad(len(alt)),
+                              self.spec.alt_rows, np.int32)
             self._state = self._jit_invalidate(
-                self._state, jnp.asarray(np.asarray(evicted, np.int32)))
+                self._state, jnp.asarray(rows_arr), jnp.asarray(alt_arr))
 
     # ------------------------------------------------------------------
     # Introspection (command-surface backing)

@@ -1,0 +1,174 @@
+"""Sharded cluster token engine tests — 8 virtual CPU devices, virtual time.
+
+Mirrors the reference's single-JVM cluster-checker tests
+(``ClusterFlowCheckerTest`` etc., SURVEY §4): checker semantics exercised
+directly, no sockets.
+"""
+
+import numpy as np
+import pytest
+
+from sentinel_tpu.parallel.cluster import (
+    STATUS_BLOCKED, STATUS_NO_RULE_EXISTS, STATUS_OK, STATUS_SHOULD_WAIT,
+    STATUS_TOO_MANY_REQUEST, THRESHOLD_AVG_LOCAL, THRESHOLD_GLOBAL,
+    ClusterEngine, ClusterFlowRule, ClusterSpec,
+)
+
+NOW0 = 10_000_000
+
+
+@pytest.fixture(scope="module")
+def engine8():
+    spec = ClusterSpec(n_shards=8, flows_per_shard=16, namespaces=4)
+    return ClusterEngine(spec)
+
+
+def fresh_engine(n_shards=8, **kw):
+    spec = ClusterSpec(n_shards=n_shards, flows_per_shard=16, namespaces=4)
+    return ClusterEngine(spec, **kw)
+
+
+def test_global_threshold_exact_admission():
+    eng = fresh_engine()
+    eng.load_rules("ns-a", [ClusterFlowRule(
+        flow_id=101, count=10, threshold_type=THRESHOLD_GLOBAL)])
+    res = eng.request_tokens([101] * 15, [1] * 15, now_ms=NOW0)
+    ok = sum(1 for s, _, _ in res if s == STATUS_OK)
+    blocked = sum(1 for s, _, _ in res if s == STATUS_BLOCKED)
+    assert ok == 10 and blocked == 5
+
+
+def test_avg_local_threshold_scales_with_connected_count():
+    eng = fresh_engine()
+    eng.load_rules("ns-a", [ClusterFlowRule(
+        flow_id=7, count=5, threshold_type=THRESHOLD_AVG_LOCAL)])
+    eng.set_connected_count("ns-a", 3)
+    res = eng.request_tokens([7] * 20, [1] * 20, now_ms=NOW0)
+    ok = sum(1 for s, _, _ in res if s == STATUS_OK)
+    assert ok == 15  # 5 × 3 connected clients
+
+
+def test_window_slide_replenishes():
+    eng = fresh_engine()
+    eng.load_rules("ns-a", [ClusterFlowRule(
+        flow_id=1, count=4, threshold_type=THRESHOLD_GLOBAL)])
+    r1 = eng.request_tokens([1] * 6, [1] * 6, now_ms=NOW0)
+    assert sum(1 for s, _, _ in r1 if s == STATUS_OK) == 4
+    # 1 s later the whole 10×100 ms window has rotated
+    r2 = eng.request_tokens([1] * 6, [1] * 6, now_ms=NOW0 + 1100)
+    assert sum(1 for s, _, _ in r2 if s == STATUS_OK) == 4
+
+
+def test_unknown_flow_is_no_rule():
+    eng = fresh_engine()
+    res = eng.request_tokens([999], [1], now_ms=NOW0)
+    assert res[0][0] == STATUS_NO_RULE_EXISTS
+
+
+def test_namespace_request_limiter_too_many():
+    eng = fresh_engine()
+    eng.load_rules("ns-a", [ClusterFlowRule(
+        flow_id=5, count=1e9, threshold_type=THRESHOLD_GLOBAL)])
+    eng.set_namespace_qps_limit("ns-a", 10)
+    res = eng.request_tokens([5] * 25, [1] * 25, now_ms=NOW0)
+    ok = sum(1 for s, _, _ in res if s == STATUS_OK)
+    many = sum(1 for s, _, _ in res if s == STATUS_TOO_MANY_REQUEST)
+    assert ok == 10 and many == 15
+
+
+def test_namespace_limiter_is_global_across_shards():
+    """Flows on different shards share one namespace budget (the psum)."""
+    eng = fresh_engine()
+    # two flows land on different shards (round-robin allocator)
+    eng.load_rules("ns-a", [
+        ClusterFlowRule(flow_id=1, count=1e9, threshold_type=THRESHOLD_GLOBAL),
+        ClusterFlowRule(flow_id=2, count=1e9, threshold_type=THRESHOLD_GLOBAL),
+    ])
+    eng.set_namespace_qps_limit("ns-a", 10)
+    eng.request_tokens([1] * 10, [1] * 10, now_ms=NOW0)
+    # budget consumed on shard of flow 1; flow 2 (other shard) must see it
+    res = eng.request_tokens([2] * 5, [1] * 5, now_ms=NOW0 + 1)
+    assert all(s == STATUS_TOO_MANY_REQUEST for s, _, _ in res)
+
+
+def test_acquire_weights_count_against_threshold():
+    eng = fresh_engine()
+    eng.load_rules("ns-a", [ClusterFlowRule(
+        flow_id=3, count=10, threshold_type=THRESHOLD_GLOBAL)])
+    res = eng.request_tokens([3, 3, 3], [4, 4, 4], now_ms=NOW0)
+    statuses = [s for s, _, _ in res]
+    assert statuses.count(STATUS_OK) == 2  # 4+4 fits, third 4 would exceed 10
+    assert statuses.count(STATUS_BLOCKED) == 1
+
+
+def test_remaining_decreases():
+    eng = fresh_engine()
+    eng.load_rules("ns-a", [ClusterFlowRule(
+        flow_id=4, count=10, threshold_type=THRESHOLD_GLOBAL)])
+    res = eng.request_tokens([4, 4], [3, 3], now_ms=NOW0)
+    assert res[0][2] > res[1][2]
+    assert res[0][2] == 7  # threshold 10 − qps 0 − own 3 (ClusterFlowChecker)
+    assert res[1][2] == 4  # − first request's 3 admitted ahead in-batch
+
+
+def test_prioritized_should_wait():
+    eng = fresh_engine()
+    eng.load_rules("ns-a", [ClusterFlowRule(
+        flow_id=6, count=5, threshold_type=THRESHOLD_GLOBAL)])
+    # exhaust the window
+    eng.request_tokens([6] * 5, [1] * 5, now_ms=NOW0)
+    # non-prioritized → BLOCKED; prioritized → SHOULD_WAIT with wait>0
+    r_np = eng.request_tokens([6], [1], now_ms=NOW0 + 10)
+    r_p = eng.request_tokens([6], [1], [True], now_ms=NOW0 + 10)
+    assert r_np[0][0] == STATUS_BLOCKED
+    assert r_p[0][0] == STATUS_SHOULD_WAIT
+    assert 0 < r_p[0][1] <= 1000
+
+
+def test_rules_across_many_shards(engine8):
+    """Round-robin row allocation spreads flows over all 8 shards; all decide."""
+    rules = [ClusterFlowRule(flow_id=i, count=2, threshold_type=THRESHOLD_GLOBAL)
+             for i in range(100, 124)]
+    engine8.load_rules("ns-spread", rules)
+    ids = [r.flow_id for r in rules for _ in range(3)]
+    res = engine8.request_tokens(ids, [1] * len(ids), now_ms=NOW0)
+    by_flow = {}
+    for fid, (s, _, _) in zip(ids, res):
+        by_flow.setdefault(fid, []).append(s)
+    for fid, sts in by_flow.items():
+        assert sts.count(STATUS_OK) == 2, (fid, sts)
+        assert sts.count(STATUS_BLOCKED) == 1
+
+
+def test_rule_reload_churn_reuses_rows_and_clears_counters():
+    """Regression: repeated reloads must not leak rows, and a reused row must
+    not inherit the dead flow's live window counters."""
+    spec = ClusterSpec(n_shards=2, flows_per_shard=2, namespaces=2)
+    eng = ClusterEngine(spec)
+    for gen in range(12):  # 12 single-rule generations on a 4-row engine
+        fid = 1000 + gen
+        eng.load_rules("ns", [ClusterFlowRule(
+            flow_id=fid, count=3, threshold_type=THRESHOLD_GLOBAL)])
+        # same instant every generation: stale counters would block instantly
+        res = eng.request_tokens([fid] * 3, [1] * 3, now_ms=NOW0 + gen)
+        assert all(s == STATUS_OK for s, _, _ in res), (gen, res)
+
+
+def test_non_positive_acquire_is_bad_request():
+    from sentinel_tpu.parallel.cluster import STATUS_BAD_REQUEST
+    eng = fresh_engine()
+    eng.load_rules("ns-a", [ClusterFlowRule(flow_id=1, count=5)])
+    res = eng.request_tokens([1, 1, 1], [0, -5, 1], now_ms=NOW0)
+    assert res[0][0] == STATUS_BAD_REQUEST
+    assert res[1][0] == STATUS_BAD_REQUEST
+    assert res[2][0] == STATUS_OK
+
+
+def test_rule_reload_drops_removed_flows():
+    eng = fresh_engine()
+    eng.load_rules("ns-a", [ClusterFlowRule(flow_id=1, count=5),
+                            ClusterFlowRule(flow_id=2, count=5)])
+    eng.load_rules("ns-a", [ClusterFlowRule(flow_id=2, count=5)])
+    res = eng.request_tokens([1, 2], [1, 1], now_ms=NOW0)
+    assert res[0][0] == STATUS_NO_RULE_EXISTS
+    assert res[1][0] == STATUS_OK
