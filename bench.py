@@ -34,6 +34,7 @@ def main() -> None:
     from sentinel_tpu.rules import authority as auth_mod
     from sentinel_tpu.rules import degrade as deg_mod
     from sentinel_tpu.rules import flow as flow_mod
+    from sentinel_tpu.rules import param_flow as pf_mod
     from sentinel_tpu.rules import system as sys_mod
     from sentinel_tpu.stats.window import WindowSpec
 
@@ -70,11 +71,14 @@ def main() -> None:
     auth = auth_mod.compile_authority_rules(
         [], resource_registry=resources, origin_registry=origins,
         capacity=16, k_per_resource=2, num_rows=R)
+    param = pf_mod.compile_param_rules(
+        [], resource_registry=resources, capacity=1, k_per_resource=2)
     ruleset = RuleSet(
         flow_table=compiled.table, flow_idx=compiled.rule_idx,
         deg_table=deg.table, deg_idx=deg.rule_idx,
         auth_table=auth.table, auth_idx=auth.rule_idx,
-        sys_thresholds=sys_mod.compile_system_rules([]))
+        sys_thresholds=sys_mod.compile_system_rules([]),
+        param_table=param.table)
 
     state = init_state(spec, NRULES, max(len(deg_rules), 1))
 

@@ -46,6 +46,11 @@ from sentinel_tpu.rules.flow import (
     STRATEGY_RELATE,
     FlowRule,
 )
+from sentinel_tpu.rules.param_flow import (
+    BEHAVIOR_RATE_LIMITER as PARAM_BEHAVIOR_RATE_LIMITER,
+    ParamFlowItem,
+    ParamFlowRule,
+)
 from sentinel_tpu.rules.system import SystemRule
 from sentinel_tpu.runtime import ENTRY_TYPE_IN, ENTRY_TYPE_OUT, Entry, Sentinel
 
@@ -54,6 +59,7 @@ __version__ = "0.1.0"
 __all__ = [
     "Sentinel", "Entry", "ENTRY_TYPE_IN", "ENTRY_TYPE_OUT",
     "FlowRule", "DegradeRule", "SystemRule", "AuthorityRule",
+    "ParamFlowRule", "ParamFlowItem", "PARAM_BEHAVIOR_RATE_LIMITER",
     "BlockException", "FlowException", "DegradeException",
     "SystemBlockException", "AuthorityException", "ParamFlowException",
     "BlockReason",

@@ -34,7 +34,8 @@ class SentinelConfig:
     max_authority_rules: int = 1024
     max_param_rules: int = 512
     max_rules_per_resource: int = 4  # K in the per-event rule gather
-    param_table_slots: int = 65536   # hashed hot-key slots per param rule set
+    param_table_slots: int = 65536   # hot-key rows (ParameterMetric LRU cap analog)
+    param_pairs_per_event: int = 4   # PV — (rule, value) checks per entry
 
     # Statistics windows (reference: SampleCountProperty SAMPLE_COUNT=2,
     # IntervalProperty INTERVAL=1000; minute window 60×1000ms)

@@ -37,6 +37,7 @@ from sentinel_tpu.core.registry import ENTRY_NODE_ROW
 from sentinel_tpu.rules import authority as auth_mod
 from sentinel_tpu.rules import degrade as deg_mod
 from sentinel_tpu.rules import flow as flow_mod
+from sentinel_tpu.rules import param_flow as pf_mod
 from sentinel_tpu.rules import system as sys_mod
 from sentinel_tpu.stats import events as ev
 from sentinel_tpu.stats.window import (
@@ -54,6 +55,8 @@ class EngineSpec:
     second: WindowSpec
     minute: Optional[WindowSpec]
     statistic_max_rt: int
+    param_keys: int = 0       # PK — hot-key rows (0 = param flow disabled)
+    param_pairs: int = 0      # PV — (rule, value) checks per event
 
 
 class SentinelState(NamedTuple):
@@ -66,6 +69,7 @@ class SentinelState(NamedTuple):
     alt_threads: jnp.ndarray      # int32[RA]
     flow_dyn: flow_mod.FlowDynState
     breakers: deg_mod.BreakerState
+    param_dyn: pf_mod.ParamDynState
 
 
 class RuleSet(NamedTuple):
@@ -78,6 +82,7 @@ class RuleSet(NamedTuple):
     auth_table: auth_mod.AuthorityRuleTable
     auth_idx: jnp.ndarray
     sys_thresholds: sys_mod.SystemThresholds
+    param_table: pf_mod.ParamRuleTable
 
 
 class EntryBatch(NamedTuple):
@@ -93,6 +98,8 @@ class EntryBatch(NamedTuple):
     is_in: jnp.ndarray          # bool[B]
     prioritized: jnp.ndarray    # bool[B]
     valid: jnp.ndarray          # bool[B]
+    param_rules: Optional[jnp.ndarray] = None   # int32[B, PV] (param slot off: None)
+    param_keys: Optional[jnp.ndarray] = None    # int32[B, PV]
 
 
 class ExitBatch(NamedTuple):
@@ -104,6 +111,8 @@ class ExitBatch(NamedTuple):
     error: jnp.ndarray          # bool[B]
     is_in: jnp.ndarray          # bool[B]
     valid: jnp.ndarray          # bool[B]
+    param_rules: Optional[jnp.ndarray] = None   # int32[B, PV]
+    param_keys: Optional[jnp.ndarray] = None    # int32[B, PV]
 
 
 class Verdicts(NamedTuple):
@@ -123,6 +132,7 @@ def init_state(spec: EngineSpec, nf: int, nd: int) -> SentinelState:
         alt_threads=jnp.zeros((spec.alt_rows,), jnp.int32),
         flow_dyn=flow_mod.init_flow_dyn(nf),
         breakers=deg_mod.init_breaker_state(nd),
+        param_dyn=pf_mod.init_param_dyn(spec.param_keys),
     )
 
 
@@ -156,6 +166,18 @@ def decide_entries(
         spec.statistic_max_rt)
     live2 = live1 & sys_ok
 
+    # ParamFlowSlot sits between SystemSlot and FlowSlot (extension SPI slot
+    # order, SURVEY §1). Static skip when the engine has no param geometry.
+    param_dyn = state.param_dyn
+    if spec.param_keys and batch.param_rules is not None:
+        param_dyn, param_ok, param_wait = pf_mod.param_check(
+            rules.param_table, param_dyn, batch.param_rules, batch.param_keys,
+            batch.acquire, live2, rel_now_ms)
+        live2 = live2 & param_ok
+    else:
+        param_ok = jnp.ones_like(live2)
+        param_wait = jnp.zeros(live2.shape, jnp.int32)
+
     fview = flow_mod.FlowBatchView(
         rows=batch.rows, origin_ids=batch.origin_ids,
         origin_rows=batch.origin_rows, context_ids=batch.context_ids,
@@ -173,14 +195,16 @@ def decide_entries(
         rules.deg_table, state.breakers, rules.deg_idx, batch.rows, live3,
         rel_now_ms)
 
-    allow = live & auth_ok & sys_ok & flow_ok & deg_ok
+    allow = live & auth_ok & sys_ok & param_ok & flow_ok & deg_ok
     reason = jnp.zeros(batch.rows.shape, jnp.int8)
     reason = jnp.where(~deg_ok, jnp.int8(BlockReason.DEGRADE), reason)
     reason = jnp.where(~flow_ok, jnp.int8(BlockReason.FLOW), reason)
+    reason = jnp.where(~param_ok, jnp.int8(BlockReason.PARAM_FLOW), reason)
     reason = jnp.where(~sys_ok, jnp.int8(BlockReason.SYSTEM), reason)
     reason = jnp.where(~auth_ok, jnp.int8(BlockReason.AUTHORITY), reason)
     reason = jnp.where(~batch.valid, jnp.int8(BlockReason.NONE), reason)
-    wait_ms = jnp.where(allow, wait_ms, 0)
+    wait_ms = jnp.maximum(jnp.where(allow, wait_ms, 0),
+                          jnp.where(allow, param_wait, 0))
 
     # ---- StatisticSlot.entry (post-decision recording) ----
     passed = allow & batch.valid
@@ -222,10 +246,15 @@ def decide_entries(
     alt_threads = state.alt_threads.at[jnp.where(pass2, alt_targets, pad_a)].add(
         thr_amt, mode="drop")
 
+    if spec.param_keys and batch.param_rules is not None:
+        param_dyn = pf_mod.param_thread_update(
+            rules.param_table, param_dyn, batch.param_rules, batch.param_keys,
+            passed, +1)
+
     new_state = SentinelState(
         second=second, minute=minute, alt_second=alt_second,
         threads=threads, alt_threads=alt_threads,
-        flow_dyn=flow_dyn, breakers=breakers)
+        flow_dyn=flow_dyn, breakers=breakers, param_dyn=param_dyn)
     return new_state, Verdicts(allow=allow, reason=reason, wait_ms=wait_ms)
 
 
@@ -289,10 +318,16 @@ def record_exits(
         rules.deg_table, state.breakers, rules.deg_idx, batch.rows,
         batch.rt_ms, batch.error, batch.valid, rel_now_ms)
 
+    param_dyn = state.param_dyn
+    if spec.param_keys and batch.param_rules is not None:
+        param_dyn = pf_mod.param_thread_update(
+            rules.param_table, param_dyn, batch.param_rules, batch.param_keys,
+            batch.valid, -1)
+
     return SentinelState(
         second=second, minute=minute, alt_second=alt_second,
         threads=threads, alt_threads=alt_threads,
-        flow_dyn=state.flow_dyn, breakers=breakers)
+        flow_dyn=state.flow_dyn, breakers=breakers, param_dyn=param_dyn)
 
 
 def invalidate_resource_rows(spec: EngineSpec, state: SentinelState,

@@ -386,14 +386,21 @@ def flow_check(
     L0 = dyn.latest_passed_ms[rj_s]
     due = (L0 + c_first - rel_now_ms) <= 0
     base_time = jnp.where(due, rel_now_ms - c_first, L0)
-    _, incl_cost = seg.segment_prefix_sum(cost_s, starts, leader)
-    latest_s = base_time + incl_cost
-    wait_s = jnp.maximum(latest_s - rel_now_ms, 0)
     is_rl = ((behavior_s == BEHAVIOR_RATE_LIMITER)
              | (behavior_s == BEHAVIOR_WARM_UP_RATE_LIMITER)) & (grade_s == GRADE_QPS)
-    pass_rl_s = wait_s <= table.max_queue_ms[rj_s]
-    # zero-count rate limiter blocks everything (reference: count<=0 → block)
-    pass_rl_s = pass_rl_s & (table.count[rj_s] > 0)
+    # a rejected request never advances the pacing clock (its CAS fails in
+    # the reference), so its cost must not delay later in-batch requests:
+    # fixed-point — exclusive prefix over admitted costs + own cost always
+    pass_rl_s = jnp.ones_like(starts)
+    maxq_s = table.max_queue_ms[rj_s]
+    for _ in range(3):
+        excl_cost, _ = seg.segment_prefix_sum(
+            jnp.where(pass_rl_s, cost_s, 0), starts, leader)
+        latest_s = base_time + excl_cost + cost_s
+        wait_s = jnp.maximum(latest_s - rel_now_ms, 0)
+        pass_rl_s = wait_s <= maxq_s
+        # zero-count rate limiter blocks everything (count<=0 → block)
+        pass_rl_s = pass_rl_s & (table.count[rj_s] > 0)
 
     pair_pass_s = jnp.where(is_rl, pass_rl_s, pass_default_s)
     inapplicable_s = rj_s == NF

@@ -1,0 +1,503 @@
+"""Hot-parameter flow control: vectorized ParamFlowSlot / ParamFlowChecker.
+
+Reference semantics being reproduced (``sentinel-extension/
+sentinel-parameter-flow-control``):
+
+* ``ParamFlowChecker.passDefaultLocalCheck:139-220`` — a simplified token
+  bucket per (rule, param value): tokens replenish only once the statistic
+  window (``durationInSec``) has passed, refill = ``passTime × tokenCount /
+  durationMs`` capped at ``count + burstCount``; an acquire larger than the
+  cap, or a zero threshold, blocks outright.
+* ``ParamFlowChecker.passThrottleLocalCheck:224-270`` — RATE_LIMITER
+  behavior = per-key paced queue with ``costTime = round(1000 · acquire ·
+  durationInSec / tokenCount)``; wait must be strictly under
+  ``maxQueueingTimeMs`` (default 0 ⇒ only zero-wait passes).
+* ``ParamFlowChecker.passSingleValueCheck:115-137`` — THREAD grade = per-key
+  live concurrency, ``count + 1 <= threshold`` (acquire ignored).
+* ``ParamFlowRule.java:45-83`` — field parity (paramIdx, durationInSec=1,
+  burstCount=0, maxQueueingTimeMs=0, paramFlowItemList per-value overrides).
+* ``ParamFlowSlot.applyRealParamIdx:56-67`` — negative paramIdx counts from
+  the tail; out-of-range indices silently pass.
+* ``ParameterMetric.java:37-39`` — key storage is an exact LRU-bounded map
+  (NOT a sketch); reproduced host-side by :class:`ParamKeyRegistry`.
+
+TPU-native shape: param values are interned host-side into *key rows* of a
+fixed device table (LRU like the reference's ``ConcurrentLinkedHashMap``
+caches, but with loud capacity + device-side invalidation of recycled rows);
+token/pacing/concurrency state is four dense vectors indexed by key row, and
+the check is a segmented scan over (event × pair) applications — the same
+machinery as ``flow_check``. Per-item overrides live in a per-key-row
+``override`` vector written at intern time, so the device never sees strings.
+
+Divergences (bounded, documented): the token-refill timestamp advances even
+when every request in the refilling batch is denied (the reference only
+advances it on a passing request — affects only the sub-window fractional
+accrual, worst case one ``durationInSec`` of refill); in-batch admission is
+greedy-FIFO rather than thread-racy (same class of skew the reference's CAS
+loops tolerate).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from sentinel_tpu.ops import segments as seg
+
+GRADE_THREAD = 0
+GRADE_QPS = 1
+BEHAVIOR_DEFAULT = 0
+BEHAVIOR_RATE_LIMITER = 2
+
+_NEVER = -(2 ** 30)
+
+
+@dataclasses.dataclass
+class ParamFlowItem:
+    """Per-value threshold override (reference ``ParamFlowItem``)."""
+
+    object: Any
+    count: int
+    class_type: str = ""   # informational; values are compared by key form
+
+
+@dataclasses.dataclass
+class ParamFlowRule:
+    """Host-facing rule (reference ``ParamFlowRule.java`` field parity)."""
+
+    resource: str
+    param_idx: int = 0
+    count: float = 0.0
+    grade: int = GRADE_QPS
+    duration_in_sec: int = 1
+    burst_count: int = 0
+    control_behavior: int = BEHAVIOR_DEFAULT
+    max_queueing_time_ms: int = 0
+    param_flow_item_list: List[ParamFlowItem] = dataclasses.field(default_factory=list)
+    cluster_mode: bool = False
+    cluster_flow_id: int = 0
+
+    def is_valid(self) -> bool:
+        # ParamFlowRuleUtil.isValidRule: non-empty resource, count >= 0,
+        # grade valid, duration > 0, paramIdx set
+        if not self.resource or self.count < 0 or self.duration_in_sec <= 0:
+            return False
+        if self.grade not in (GRADE_THREAD, GRADE_QPS):
+            return False
+        if self.param_idx is None:
+            return False
+        return True
+
+    def hot_items(self) -> Dict[Any, int]:
+        """Parsed per-value overrides (``ParamFlowRuleUtil.parseHotItems``)."""
+        out: Dict[Any, int] = {}
+        for it in self.param_flow_item_list:
+            if it.object is not None and it.count >= 0:
+                out[_key_form(it.object)] = int(it.count)
+        return out
+
+
+def _key_form(value: Any) -> Any:
+    """Canonical hashable form of a param value (reference compares via
+    Object.equals; here unhashables fall back to repr)."""
+    pk = getattr(value, "param_flow_key", None)
+    if callable(pk):  # ParamFlowArgument analog
+        value = pk()
+    try:
+        hash(value)
+        return value
+    except TypeError:
+        return repr(value)
+
+
+class ParamRuleTable(NamedTuple):
+    """Static per-rule device arrays, NP+1 rows (last = inactive sentinel)."""
+
+    active: jnp.ndarray        # bool[NP+1]
+    grade: jnp.ndarray         # int32
+    count: jnp.ndarray         # float32
+    duration_ms: jnp.ndarray   # int32
+    burst: jnp.ndarray         # float32
+    behavior: jnp.ndarray      # int32
+    max_queue_ms: jnp.ndarray  # int32
+
+
+class ParamDynState(NamedTuple):
+    """Per-key-row mutable device state, PK+1 rows (last = scatter sink)."""
+
+    tokens: jnp.ndarray          # float32[PK+1]
+    last_fill_ms: jnp.ndarray    # int32[PK+1] rel-ms; _NEVER = never filled
+    latest_passed_ms: jnp.ndarray  # int32[PK+1] rate-limiter pacing clock
+    threads: jnp.ndarray         # int32[PK+1] per-key live concurrency
+    override: jnp.ndarray        # float32[PK+1]; <0 = use rule count
+
+
+class CompiledParamRules(NamedTuple):
+    table: ParamRuleTable
+    rules: Tuple[ParamFlowRule, ...]       # index-aligned with table
+    # host map: main row → ((table_slot, param_idx, hot_items), ...) — pairs
+    # are resolved host-side at entry time, so no device gather table exists
+    by_row: Dict[int, Tuple[Tuple[int, int, Dict[Any, int]], ...]]
+    num_active: int
+
+
+def init_param_dyn(pk: int) -> ParamDynState:
+    return ParamDynState(
+        tokens=jnp.zeros((pk + 1,), jnp.float32),
+        last_fill_ms=jnp.full((pk + 1,), _NEVER, jnp.int32),
+        latest_passed_ms=jnp.full((pk + 1,), _NEVER, jnp.int32),
+        threads=jnp.zeros((pk + 1,), jnp.int32),
+        override=jnp.full((pk + 1,), -1.0, jnp.float32),
+    )
+
+
+def compile_param_rules(rules: Sequence[ParamFlowRule], *, resource_registry,
+                        capacity: int, k_per_resource: int) -> CompiledParamRules:
+    """Validate + vectorize (the ``ParamFlowRuleUtil`` analog). Loud on
+    capacity overflow, like the other compilers."""
+    valid = [r for r in rules if r.is_valid()]
+    if len(valid) > capacity:
+        raise ValueError(f"too many param flow rules: {len(valid)} > {capacity}")
+
+    np_ = capacity
+    active = np.zeros(np_ + 1, np.bool_)
+    grade = np.zeros(np_ + 1, np.int32)
+    count = np.zeros(np_ + 1, np.float32)
+    duration_ms = np.full(np_ + 1, 1000, np.int32)
+    burst = np.zeros(np_ + 1, np.float32)
+    behavior = np.zeros(np_ + 1, np.int32)
+    max_queue_ms = np.zeros(np_ + 1, np.int32)
+    by_row: Dict[int, List[Tuple[int, int, Dict[Any, int]]]] = {}
+    slots_used: Dict[int, int] = {}
+
+    for j, r in enumerate(valid):
+        row = resource_registry.pin(r.resource)
+        k = slots_used.get(row, 0)
+        if k >= k_per_resource:
+            raise ValueError(
+                f"more than {k_per_resource} param rules for {r.resource!r}")
+        slots_used[row] = k + 1
+        by_row.setdefault(row, []).append((j, int(r.param_idx), r.hot_items()))
+
+        active[j] = True
+        grade[j] = r.grade
+        count[j] = r.count
+        duration_ms[j] = int(r.duration_in_sec) * 1000
+        burst[j] = r.burst_count
+        behavior[j] = r.control_behavior
+        max_queue_ms[j] = r.max_queueing_time_ms
+
+    table = ParamRuleTable(
+        active=jnp.asarray(active), grade=jnp.asarray(grade),
+        count=jnp.asarray(count), duration_ms=jnp.asarray(duration_ms),
+        burst=jnp.asarray(burst), behavior=jnp.asarray(behavior),
+        max_queue_ms=jnp.asarray(max_queue_ms))
+    return CompiledParamRules(
+        table=table, rules=tuple(valid),
+        by_row={k: tuple(v) for k, v in by_row.items()}, num_active=len(valid))
+
+
+# ---------------------------------------------------------------------------
+# Host-side key interning (ParameterMetric / CacheMap analog)
+# ---------------------------------------------------------------------------
+
+class ParamKeyRegistry:
+    """LRU intern table: (rule_slot, value) → device key row.
+
+    Mirrors ``ParameterMetric``'s ``ConcurrentLinkedHashMapWrapper`` caches
+    (exact, LRU-bounded — SURVEY §2.2), sized globally like
+    ``TOTAL_MAX_CAPACITY``. Evicted rows are drained by the runtime and
+    invalidated on device so a recycled row starts cold. Rows for values with
+    per-item overrides record a pending (row, threshold) update the runtime
+    flushes into ``ParamDynState.override`` before the next decide step.
+    """
+
+    def __init__(self, capacity: int):
+        self._cap = capacity
+        self._map: "OrderedDict[Tuple[int, Any], int]" = OrderedDict()
+        self._free: List[int] = list(range(capacity - 1, -1, -1))
+        self._evicted: List[int] = []
+        self._pending_override: List[Tuple[int, float]] = []
+        self._pins: Dict[int, int] = {}   # row → live-entry refcount
+        self._lock = threading.Lock()
+
+    @property
+    def capacity(self) -> int:
+        return self._cap
+
+    def get_or_create(self, rule_slot: int, value: Any,
+                      override: Optional[int] = None) -> int:
+        key = (rule_slot, _key_form(value))
+        with self._lock:
+            row = self._map.get(key)
+            if row is not None:
+                self._map.move_to_end(key)
+                return row
+            if self._free:
+                row = self._free.pop()
+            else:
+                row = self._evict_lru_locked()
+            self._map[key] = row
+            if override is not None:
+                self._pending_override.append((row, float(override)))
+            return row
+
+    def _evict_lru_locked(self) -> int:
+        # skip rows pinned by in-flight entries: recycling one would let the
+        # old entry's exit decrement the row's NEW occupant's thread count
+        for key, row in self._map.items():
+            if not self._pins.get(row):
+                del self._map[key]
+                self._evicted.append(row)
+                return row
+        raise RuntimeError(
+            "all hot-param key rows are pinned by live entries; "
+            "raise param_table_slots")
+
+    def pin_rows(self, rows) -> None:
+        """Hold rows against LRU recycling while an entry is in flight."""
+        with self._lock:
+            for r in rows:
+                r = int(r)
+                if r < self._cap:
+                    self._pins[r] = self._pins.get(r, 0) + 1
+
+    def unpin_rows(self, rows) -> None:
+        with self._lock:
+            for r in rows:
+                r = int(r)
+                if r < self._cap:
+                    n = self._pins.get(r, 0) - 1
+                    if n <= 0:
+                        self._pins.pop(r, None)
+                    else:
+                        self._pins[r] = n
+
+    def drain_updates(self) -> Tuple[List[int], List[Tuple[int, float]]]:
+        """→ (evicted rows to invalidate, pending override writes)."""
+        with self._lock:
+            ev_, ov = self._evicted, self._pending_override
+            self._evicted, self._pending_override = [], []
+            return ev_, ov
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+
+def resolve_pairs(compiled: CompiledParamRules, keys: ParamKeyRegistry,
+                  row: int, args: Sequence[Any],
+                  pairs_per_event: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Map one event's positional args to (rule_slot, key_row) pairs.
+
+    Implements ``ParamFlowSlot.applyRealParamIdx`` (negative index from tail,
+    out-of-range passes), ``ParamFlowArgument.paramFlowKey`` resolution, null
+    pass-through, and collection/array expansion (every element checked).
+    Overflow beyond ``pairs_per_event`` raises — a silent drop would silently
+    stop checking, the reference failure mode this build rejects.
+    """
+    np_sentinel = compiled.table.active.shape[0] - 1
+    pk_sentinel = keys.capacity
+    pr = np.full(pairs_per_event, np_sentinel, np.int32)
+    pk = np.full(pairs_per_event, pk_sentinel, np.int32)
+    fills = 0
+    entries = compiled.by_row.get(row)
+    if not entries:
+        return pr, pk
+    n = len(args)
+    for slot_j, idx, hot in entries:
+        if idx < 0:
+            idx = n + idx if -idx <= n else -idx
+        if idx >= n:
+            continue
+        value = args[idx]
+        if value is None:
+            continue
+        values = (list(value) if isinstance(value, (list, tuple, set, frozenset))
+                  else [value])
+        for v in values:
+            if v is None:
+                continue
+            if fills >= pairs_per_event:
+                raise ValueError(
+                    f"event needs more than {pairs_per_event} param checks; "
+                    f"raise param_pairs_per_event")
+            kf = _key_form(v)
+            ov = hot.get(kf)
+            pr[fills] = slot_j
+            pk[fills] = keys.get_or_create(slot_j, kf, override=ov)
+            fills += 1
+    return pr, pk
+
+
+# ---------------------------------------------------------------------------
+# Device-side check
+# ---------------------------------------------------------------------------
+
+def param_check(
+    table: ParamRuleTable,
+    dyn: ParamDynState,
+    pair_rules: jnp.ndarray,     # int32[B, PV] table slot, NP = none
+    pair_keys: jnp.ndarray,      # int32[B, PV] key row, PK = none
+    acquire: jnp.ndarray,        # int32[B]
+    valid: jnp.ndarray,          # bool[B] — events still live in the chain
+    rel_now_ms: jnp.ndarray,     # int32 scalar
+) -> Tuple[ParamDynState, jnp.ndarray, jnp.ndarray]:
+    """→ (dyn', allow bool[B], wait_ms int32[B]).
+
+    One segmented scan over all (event, pair) applications; each key row is a
+    segment so in-batch requests on the same hot key consume sequentially.
+    """
+    B, PV = pair_rules.shape
+    NP = table.active.shape[0] - 1
+    PK = dyn.tokens.shape[0] - 1
+
+    rj = pair_rules.reshape(-1)
+    kj = pair_keys.reshape(-1)
+    valid_p = jnp.repeat(valid, PV) & (rj != NP) & (kj < PK) & table.active[rj]
+    rj = jnp.where(valid_p, rj, NP)
+    kj = jnp.where(valid_p, kj, PK)
+    acq_p = jnp.where(valid_p, jnp.repeat(acquire, PV), 0).astype(jnp.float32)
+
+    # threshold: per-item override beats rule count (parsedHotItems)
+    ov = dyn.override[kj]
+    threshold = jnp.where(ov >= 0.0, ov, table.count[rj])
+    max_count = threshold + table.burst[rj]
+    duration = jnp.maximum(table.duration_ms[rj], 1).astype(jnp.float32)
+
+    # --- segments: one per key row (key rows are unique per (rule, value)) ---
+    order = seg.sort_by_keys(kj, jnp.zeros_like(kj))
+    rj_s = rj[order]
+    kj_s = kj[order]
+    acq_s = acq_p[order]
+    valid_s = valid_p[order]
+    starts = seg.segment_starts(kj_s, jnp.zeros_like(kj_s))
+    leader = seg.segment_leader_index(starts)
+
+    thr_s = threshold[order]
+    maxc_s = max_count[order]
+    dur_s = duration[order]
+    grade_s = table.grade[rj_s]
+    behavior_s = table.behavior[rj_s]
+
+    # --- QPS default: leader refill, then greedy in-segment consumption ---
+    last_fill = dyn.last_fill_ms[kj_s]
+    never = last_fill == _NEVER
+    pass_time = (rel_now_ms - last_fill).astype(jnp.float32)
+    refill = pass_time > dur_s
+    to_add = jnp.floor(pass_time * thr_s / dur_s)
+    t0 = jnp.where(never, maxc_s,
+                   jnp.where(refill,
+                             jnp.minimum(dyn.tokens[kj_s] + to_add, maxc_s),
+                             dyn.tokens[kj_s]))
+    t0 = seg.segment_broadcast_first(t0, leader)
+    qps_pass = seg.greedy_admit(jnp.zeros_like(acq_s), acq_s, t0, starts, leader)
+    qps_pass = qps_pass & (thr_s > 0.0) & (acq_s <= maxc_s)
+
+    # --- QPS rate limiter: per-key paced queue ---
+    cost_s = jnp.round(1000.0 * acq_s * dur_s / 1000.0
+                       / jnp.maximum(thr_s, 1e-9)).astype(jnp.int32)
+    c_first = seg.segment_broadcast_first(cost_s, leader)
+    L0 = dyn.latest_passed_ms[kj_s]
+    due = (L0 == _NEVER) | ((L0 + c_first - rel_now_ms) <= 0)
+    base_time = jnp.where(due, rel_now_ms - c_first, L0)
+    # a rejected request consumes no pacing budget (its CAS never lands in
+    # the reference) — fixed-point like greedy_admit: drop rejected costs,
+    # recompute the prefix; exact after one refinement for the dominant
+    # admit-prefix/deny-suffix shape, bounded over-spacing otherwise
+    rl_pass = jnp.ones_like(starts)
+    maxq_s = table.max_queue_ms[rj_s]
+    for _ in range(3):
+        # exclusive prefix over ADMITTED earlier costs + own cost always
+        excl_cost, _ = seg.segment_prefix_sum(
+            jnp.where(rl_pass, cost_s, 0), starts, leader)
+        latest_s = base_time + excl_cost + cost_s
+        wait_s = jnp.maximum(latest_s - rel_now_ms, 0)
+        # strict '<' on maxQueueingTimeMs (default 0 ⇒ only zero-wait passes)
+        rl_pass = ((wait_s <= 0) | (wait_s < maxq_s)) & (thr_s > 0.0)
+
+    # --- THREAD grade: per-key concurrency, +1 each regardless of acquire ---
+    ones = jnp.where(valid_s, 1.0, 0.0)
+    thread_pass = seg.greedy_admit(dyn.threads[kj_s].astype(jnp.float32),
+                                   ones, thr_s, starts, leader)
+
+    is_rl = (grade_s == GRADE_QPS) & (behavior_s == BEHAVIOR_RATE_LIMITER)
+    is_qps = (grade_s == GRADE_QPS) & ~is_rl
+    pair_pass_s = jnp.where(is_qps, qps_pass,
+                            jnp.where(is_rl, rl_pass, thread_pass))
+    pair_pass_s = pair_pass_s | ~valid_s
+    pair_wait_s = jnp.where(is_rl & pair_pass_s & valid_s, wait_s, 0)
+
+    # --- state writeback (scatter at segment granularity) ---
+    live_qps = valid_s & is_qps
+    consumed = jnp.where(live_qps & pair_pass_s, acq_s, 0.0)
+    _, incl_consumed = seg.segment_prefix_sum(consumed, starts, leader)
+    new_tokens = t0 - incl_consumed
+    # last element of each key segment carries the final value
+    is_last = jnp.concatenate([starts[1:], jnp.ones((1,), jnp.bool_)])
+    tok_target = jnp.where(is_last & live_qps, kj_s, PK)
+    tokens = dyn.tokens.at[tok_target].set(new_tokens, mode="drop")
+    fill_target = jnp.where(is_last & live_qps & (never | refill), kj_s, PK)
+    last_fill_new = dyn.last_fill_ms.at[fill_target].set(rel_now_ms, mode="drop")
+
+    rl_latest = jnp.where(is_rl & pair_pass_s & valid_s, latest_s, _NEVER)
+    rl_target = jnp.where(is_rl & valid_s, kj_s, PK)
+    latest_passed = dyn.latest_passed_ms.at[rl_target].max(rl_latest, mode="drop")
+
+    dyn = dyn._replace(tokens=tokens, last_fill_ms=last_fill_new,
+                       latest_passed_ms=latest_passed)
+
+    # --- back to events: every pair must pass ---
+    pair_pass = seg.unsort(order, pair_pass_s.astype(jnp.int32)).astype(jnp.bool_)
+    pair_wait = seg.unsort(order, pair_wait_s.astype(jnp.int32))
+    allow = jnp.all(pair_pass.reshape(B, PV), axis=1)
+    wait_ms = jnp.max(pair_wait.reshape(B, PV), axis=1).astype(jnp.int32)
+    allow = allow | ~valid
+    return dyn, allow, wait_ms
+
+
+def param_thread_update(
+    table: ParamRuleTable,
+    dyn: ParamDynState,
+    pair_rules: jnp.ndarray,     # int32[B, PV]
+    pair_keys: jnp.ndarray,      # int32[B, PV]
+    counted: jnp.ndarray,        # bool[B] — events whose pairs adjust threads
+    delta: int,
+) -> ParamDynState:
+    """±1 per-key concurrency for THREAD-grade pairs (the reference's
+    ``ParamFlowStatisticEntryCallback`` / ``ExitCallback`` thread bookkeeping,
+    applied post-decision for passed entries and on exit)."""
+    NP = table.active.shape[0] - 1
+    PK = dyn.tokens.shape[0] - 1
+    PV = pair_rules.shape[1]
+    rj = pair_rules.reshape(-1)
+    kj = pair_keys.reshape(-1)
+    live = jnp.repeat(counted, PV) & (rj != NP) & (kj < PK)
+    live = live & (table.grade[rj] == GRADE_THREAD)
+    target = jnp.where(live, kj, PK)
+    threads = dyn.threads.at[target].add(jnp.where(live, delta, 0), mode="drop")
+    if delta < 0:
+        threads = jnp.maximum(threads, 0)
+    return dyn._replace(threads=threads)
+
+
+def invalidate_param_keys(dyn: ParamDynState, rows: jnp.ndarray) -> ParamDynState:
+    """Reset recycled key rows (registry-eviction hygiene)."""
+    return ParamDynState(
+        tokens=dyn.tokens.at[rows].set(0.0, mode="drop"),
+        last_fill_ms=dyn.last_fill_ms.at[rows].set(_NEVER, mode="drop"),
+        latest_passed_ms=dyn.latest_passed_ms.at[rows].set(_NEVER, mode="drop"),
+        threads=dyn.threads.at[rows].set(0, mode="drop"),
+        override=dyn.override.at[rows].set(-1.0, mode="drop"),
+    )
+
+
+def apply_overrides(dyn: ParamDynState, rows: jnp.ndarray,
+                    values: jnp.ndarray) -> ParamDynState:
+    """Flush pending per-item threshold writes (rows padded with PK)."""
+    return dyn._replace(override=dyn.override.at[rows].set(values, mode="drop"))

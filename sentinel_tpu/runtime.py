@@ -50,6 +50,7 @@ from sentinel_tpu.engine.pipeline import (
 from sentinel_tpu.rules import authority as auth_mod
 from sentinel_tpu.rules import degrade as deg_mod
 from sentinel_tpu.rules import flow as flow_mod
+from sentinel_tpu.rules import param_flow as pf_mod
 from sentinel_tpu.rules import system as sys_mod
 from sentinel_tpu.stats import events as ev
 from sentinel_tpu.stats.window import (
@@ -67,6 +68,11 @@ def _jitted_steps(spec: EngineSpec):
     return (jax.jit(functools.partial(decide_entries, spec)),
             jax.jit(functools.partial(record_exits, spec)),
             jax.jit(functools.partial(invalidate_resource_rows, spec)))
+
+# jitted once at import; shapes are padded to powers of two so the trace
+# cache stays small (calling jax.jit(...) per drain would re-trace every time)
+_jit_invalidate_param_keys = jax.jit(pf_mod.invalidate_param_keys)
+_jit_apply_overrides = jax.jit(pf_mod.apply_overrides)
 
 _H1 = 0x9E3779B1
 _H2 = 0x85EBCA6B
@@ -120,10 +126,12 @@ class Entry:
     ``Entry``/``CtEntry`` with try-with-resources semantics."""
 
     __slots__ = ("_rt", "resource", "row", "origin_row", "chain_row",
-                 "acquire", "is_in", "create_ms", "error", "_exited")
+                 "acquire", "is_in", "create_ms", "error", "_exited",
+                 "param_pairs")
 
     def __init__(self, rt: "Sentinel", resource: str, row: int, origin_row: int,
-                 chain_row: int, acquire: int, is_in: bool, create_ms: int):
+                 chain_row: int, acquire: int, is_in: bool, create_ms: int,
+                 param_pairs=None):
         self._rt = rt
         self.resource = resource
         self.row = row
@@ -132,6 +140,7 @@ class Entry:
         self.acquire = acquire
         self.is_in = is_in
         self.create_ms = create_ms
+        self.param_pairs = param_pairs   # (rules [PV], keys [PV]) or None
         self.error: Optional[BaseException] = None
         self._exited = False
 
@@ -177,7 +186,15 @@ class Sentinel:
                               cfg.second_interval_ms // max(cfg.second_sample_count, 1)),
             minute=MINUTE_SPEC if cfg.minute_enabled else None,
             statistic_max_rt=cfg.statistic_max_rt,
+            param_keys=cfg.param_table_slots,
+            param_pairs=cfg.param_pairs_per_event,
         )
+        self.param_key_registry = pf_mod.ParamKeyRegistry(cfg.param_table_slots)
+        # bumped on every param-rule reload: pairs resolved against a stale
+        # (table, registry) pair carry their generation and are dropped by
+        # decide_raw/exit if a reload happened in between — a stale rule slot
+        # must never be applied against the new table
+        self._param_gen = 0
         # process epoch: wraparound-safe int32 relative time base
         self.epoch_ms = self.clock.now_ms()
 
@@ -196,6 +213,8 @@ class Sentinel:
         self.degrade_property.add_listener(lambda rs: self.load_degrade_rules(rs))
         self.system_property.add_listener(lambda rs: self.load_system_rules(rs))
         self.authority_property.add_listener(lambda rs: self.load_authority_rules(rs))
+        self.param_flow_property: SentinelProperty = SentinelProperty()
+        self.param_flow_property.add_listener(lambda rs: self.load_param_flow_rules(rs))
 
         self._cpu = _CpuSampler(self.clock)
         self._global_on = True  # reference Constants.ON / setSwitch command
@@ -221,6 +240,10 @@ class Sentinel:
             capacity=cfg.max_authority_rules, k_per_resource=2,
             num_rows=cfg.max_resources)
         self._sys = sys_mod.compile_system_rules([])
+        self._param = pf_mod.compile_param_rules(
+            [], resource_registry=self.resources,
+            capacity=cfg.max_param_rules,
+            k_per_resource=cfg.max_rules_per_resource)
         self._ruleset = self._build_ruleset()
 
     def _build_ruleset(self) -> RuleSet:
@@ -228,7 +251,7 @@ class Sentinel:
             flow_table=self._flow.table, flow_idx=self._flow.rule_idx,
             deg_table=self._deg.table, deg_idx=self._deg.rule_idx,
             auth_table=self._auth.table, auth_idx=self._auth.rule_idx,
-            sys_thresholds=self._sys)
+            sys_thresholds=self._sys, param_table=self._param.table)
 
     def load_flow_rules(self, rules: Sequence[flow_mod.FlowRule]) -> None:
         cfg = self.cfg
@@ -254,6 +277,22 @@ class Sentinel:
             self._ruleset = self._build_ruleset()
             self._state = self._state._replace(
                 breakers=deg_mod.init_breaker_state(cfg.max_degrade_rules))
+
+    def load_param_flow_rules(self, rules: Sequence[pf_mod.ParamFlowRule]) -> None:
+        cfg = self.cfg
+        compiled = pf_mod.compile_param_rules(
+            rules, resource_registry=self.resources,
+            capacity=cfg.max_param_rules,
+            k_per_resource=cfg.max_rules_per_resource)
+        with self._lock:
+            self._param = compiled
+            self._ruleset = self._build_ruleset()
+            # rule slots changed meaning: fresh key interning + cold key state
+            # (ParameterMetricStorage re-initializes metrics per rule)
+            self.param_key_registry = pf_mod.ParamKeyRegistry(cfg.param_table_slots)
+            self._param_gen += 1
+            self._state = self._state._replace(
+                param_dyn=pf_mod.init_param_dyn(self.spec.param_keys))
 
     def load_system_rules(self, rules: Sequence[sys_mod.SystemRule]) -> None:
         with self._lock:
@@ -293,9 +332,10 @@ class Sentinel:
 
     def entry(self, resource: str, *, origin: Optional[str] = None,
               acquire: int = 1, entry_type: int = ENTRY_TYPE_IN,
-              prioritized: bool = False) -> Entry:
+              prioritized: bool = False, args: Sequence = ()) -> Entry:
         """Guard a call. Raises a BlockException subclass when denied;
-        sleeps (via the clock) on pass-with-wait verdicts."""
+        sleeps (via the clock) on pass-with-wait verdicts. ``args`` are the
+        call's parameters for hot-param rules (``SphU.entry(name, args)``)."""
         if not self._global_on:
             now = self.clock.now_ms()
             return Entry(self, resource, -1, -1, -1, acquire,
@@ -310,11 +350,18 @@ class Sentinel:
         context_id = (self.contexts.get_or_create(ctx.name)
                       if c_row < self.spec.alt_rows else 0)
         is_in = entry_type == ENTRY_TYPE_IN
+        pairs = self._resolve_param_pairs_one(row, args)
+        pr = pk = None
+        if pairs is not None:
+            pr = pairs[0][None, :]
+            pk = pairs[1][None, :]
         verdict = self.decide_raw(
             np.array([row], np.int32), np.array([origin_id], np.int32),
             np.array([o_row], np.int32), np.array([context_id], np.int32),
             np.array([c_row], np.int32), np.array([acquire], np.int32),
-            np.array([is_in], np.bool_), np.array([prioritized], np.bool_))
+            np.array([is_in], np.bool_), np.array([prioritized], np.bool_),
+            param_rules=pr, param_keys=pk,
+            param_gen=pairs[2] if pairs is not None else -1)
         if not bool(verdict.allow[0]):
             raise block_exception_for(int(verdict.reason[0]), resource,
                                       origin=use_origin)
@@ -322,7 +369,29 @@ class Sentinel:
         if wait > 0:
             self.clock.sleep_ms(wait)
         now = self.clock.now_ms()
-        return Entry(self, resource, row, o_row, c_row, acquire, is_in, now)
+        if pairs is not None:
+            # hold the key rows against LRU recycling while in flight, so this
+            # entry's exit can't decrement a recycled row's new occupant
+            pairs[3].pin_rows(pairs[1])
+        return Entry(self, resource, row, o_row, c_row, acquire, is_in, now,
+                     param_pairs=pairs)
+
+    def _resolve_param_pairs_one(self, row: int, args: Sequence):
+        """→ (rules [PV], keys [PV], generation, registry), or None when the
+        resource has no param rules / no args (rule-free events skip the
+        param slot). Table, registry and generation are snapshotted together
+        under the lock so they are mutually consistent."""
+        with self._lock:
+            compiled = self._param
+            registry = self.param_key_registry
+            gen = self._param_gen
+        if not compiled.num_active or not args:
+            return None
+        if row not in compiled.by_row:
+            return None
+        pr, pk = pf_mod.resolve_pairs(compiled, registry, row, args,
+                                      self.spec.param_pairs)
+        return (pr, pk, gen, registry)
 
     def _alt_row(self, row: int, kind: int, key_id: int) -> int:
         """Hash + record the (main row → alt row) edge for eviction hygiene."""
@@ -345,6 +414,13 @@ class Sentinel:
             return
         now = self.clock.now_ms()
         rt = max(0, now - e.create_ms)
+        pr = pk = None
+        gen = -1
+        if e.param_pairs is not None:
+            pr = e.param_pairs[0][None, :]
+            pk = e.param_pairs[1][None, :]
+            gen = e.param_pairs[2]
+            e.param_pairs[3].unpin_rows(e.param_pairs[1])
         self.exit_batch(
             rows=np.array([e.row], np.int32),
             origin_rows=np.array([e.origin_row], np.int32),
@@ -352,7 +428,8 @@ class Sentinel:
             acquire=np.array([e.acquire], np.int32),
             rt_ms=np.array([min(rt, self.cfg.statistic_max_rt)], np.int32),
             error=np.array([e.error is not None], np.bool_),
-            is_in=np.array([e.is_in], np.bool_))
+            is_in=np.array([e.is_in], np.bool_),
+            param_rules=pr, param_keys=pk, param_gen=gen)
 
     # ------------------------------------------------------------------
     # Batch API (throughput tier)
@@ -366,10 +443,28 @@ class Sentinel:
                     contexts: Optional[Sequence[str]] = None,
                     acquire: Optional[Sequence[int]] = None,
                     entry_types: Optional[Sequence[int]] = None,
-                    prioritized: Optional[Sequence[bool]] = None) -> Verdicts:
+                    prioritized: Optional[Sequence[bool]] = None,
+                    args_list: Optional[Sequence[Sequence]] = None) -> Verdicts:
         n = len(resources)
         rows = np.fromiter((self.resources.get_or_create(r) for r in resources),
                            np.int32, count=n)
+        param_rules = param_keys = None
+        param_gen = -1
+        with self._lock:
+            compiled = self._param
+            registry = self.param_key_registry
+            gen = self._param_gen
+        if args_list is not None and compiled.num_active:
+            param_gen = gen
+            pv = self.spec.param_pairs
+            param_rules = np.full((n, pv), self.cfg.max_param_rules, np.int32)
+            param_keys = np.full((n, pv), self.spec.param_keys, np.int32)
+            for i, a in enumerate(args_list):
+                if a and int(rows[i]) in compiled.by_row:
+                    pr, pk = pf_mod.resolve_pairs(
+                        compiled, registry, int(rows[i]), a, pv)
+                    param_rules[i] = pr
+                    param_keys[i] = pk
         origin_ids = np.zeros(n, np.int32)
         origin_rows = np.full(n, self.spec.alt_rows, np.int32)
         context_ids = np.zeros(n, np.int32)
@@ -392,15 +487,30 @@ class Sentinel:
         prio = np.asarray(prioritized, np.bool_) if prioritized is not None \
             else np.zeros(n, np.bool_)
         return self.decide_raw(rows, origin_ids, origin_rows, context_ids,
-                               chain_rows, acq, is_in, prio)
+                               chain_rows, acq, is_in, prio,
+                               param_rules=param_rules, param_keys=param_keys,
+                               param_gen=param_gen)
+
+    def _pad_pairs(self, arr: Optional[np.ndarray], b: int, fill: int):
+        """Pad an [n, PV] pair array to [b, PV] (or None passthrough)."""
+        if arr is None:
+            return None
+        out = np.full((b, self.spec.param_pairs), fill, np.int32)
+        out[:arr.shape[0]] = arr
+        return out
 
     def decide_raw(self, rows, origin_ids, origin_rows, context_ids, chain_rows,
-                   acquire, is_in, prioritized) -> Verdicts:
-        """Lowest-level host entry point: pre-resolved numpy arrays."""
+                   acquire, is_in, prioritized, *, param_rules=None,
+                   param_keys=None, param_gen: int = -1) -> Verdicts:
+        """Lowest-level host entry point: pre-resolved numpy arrays.
+        ``param_gen`` is the generation the pair arrays were resolved against;
+        stale pairs (a reload raced the resolve) are dropped, not misapplied."""
         n = rows.shape[0]
         b = self._pad(n)
         pad_r = self.spec.rows
         pad_a = self.spec.alt_rows
+        if param_rules is not None and param_gen != self._param_gen:
+            param_rules = param_keys = None
         batch = EntryBatch(
             rows=_pad_to(rows, b, pad_r, np.int32),
             origin_ids=_pad_to(origin_ids, b, 0, np.int32),
@@ -411,6 +521,8 @@ class Sentinel:
             is_in=_pad_to(is_in, b, False, np.bool_),
             prioritized=_pad_to(prioritized, b, False, np.bool_),
             valid=_pad_to(np.ones(n, np.bool_), b, False, np.bool_),
+            param_rules=self._pad_pairs(param_rules, b, self.cfg.max_param_rules),
+            param_keys=self._pad_pairs(param_keys, b, self.spec.param_keys),
         )
         now = self.clock.now_ms()
         idx_s, idx_m, rel = self._time_scalars(now)
@@ -426,9 +538,12 @@ class Sentinel:
                         wait_ms=np.asarray(verdicts.wait_ms)[:n])
 
     def exit_batch(self, *, rows, origin_rows, chain_rows, acquire, rt_ms,
-                   error, is_in) -> None:
+                   error, is_in, param_rules=None, param_keys=None,
+                   param_gen: int = -1) -> None:
         n = rows.shape[0]
         b = self._pad(n)
+        if param_rules is not None and param_gen != self._param_gen:
+            param_rules = param_keys = None   # state was reset by the reload
         batch = ExitBatch(
             rows=_pad_to(rows, b, self.spec.rows, np.int32),
             origin_rows=_pad_to(origin_rows, b, self.spec.alt_rows, np.int32),
@@ -438,6 +553,8 @@ class Sentinel:
             error=_pad_to(error, b, False, np.bool_),
             is_in=_pad_to(is_in, b, False, np.bool_),
             valid=_pad_to(np.ones(n, np.bool_), b, False, np.bool_),
+            param_rules=self._pad_pairs(param_rules, b, self.cfg.max_param_rules),
+            param_keys=self._pad_pairs(param_keys, b, self.spec.param_keys),
         )
         now = self.clock.now_ms()
         idx_s, idx_m, rel = self._time_scalars(now)
@@ -446,6 +563,24 @@ class Sentinel:
                                          idx_s, idx_m, rel)
 
     def _drain_evictions_locked(self) -> None:
+        ev_keys, overrides = self.param_key_registry.drain_updates()
+        if ev_keys:
+            rows = jnp.asarray(_pad_to(np.asarray(ev_keys, np.int32),
+                                       self._pad(len(ev_keys)),
+                                       self.spec.param_keys, np.int32))
+            self._state = self._state._replace(
+                param_dyn=_jit_invalidate_param_keys(
+                    self._state.param_dyn, rows))
+        if overrides:
+            rows = jnp.asarray(_pad_to(
+                np.asarray([r for r, _ in overrides], np.int32),
+                self._pad(len(overrides)), self.spec.param_keys, np.int32))
+            vals = jnp.asarray(_pad_to(
+                np.asarray([v for _, v in overrides], np.float32),
+                self._pad(len(overrides)), -1.0, np.float32))
+            self._state = self._state._replace(
+                param_dyn=_jit_apply_overrides(
+                    self._state.param_dyn, rows, vals))
         evicted = self.resources.drain_evicted()
         if evicted:
             alt: List[int] = []

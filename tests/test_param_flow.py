@@ -1,0 +1,304 @@
+"""Hot-parameter flow control tests — parity target: the reference's
+ParamFlowCheckerTest / ParamFlowDefaultCheckerTest / ParamFlowThrottleChecker
+Test (sentinel-extension/sentinel-parameter-flow-control, SURVEY §2.2), over
+virtual time."""
+
+import numpy as np
+import pytest
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.rules.param_flow import (
+    GRADE_THREAD, ParamFlowItem, ParamFlowRule,
+)
+
+
+def make_sentinel(clk, **cfg_over):
+    base = dict(max_resources=64, max_origins=32, max_flow_rules=16,
+                max_degrade_rules=16, max_authority_rules=16,
+                max_param_rules=16, param_table_slots=256)
+    base.update(cfg_over)
+    return stpu.Sentinel(config=stpu.load_config(**base), clock=clk)
+
+
+@pytest.fixture
+def clk():
+    return ManualClock(start_ms=1_785_000_000_000)
+
+
+def burst(sph, resource, n, args):
+    p = b = 0
+    for _ in range(n):
+        try:
+            with sph.entry(resource, args=args):
+                p += 1
+        except stpu.ParamFlowException:
+            b += 1
+    return p, b
+
+
+# ------------------------------------------------------------- QPS default
+
+def test_qps_token_bucket_per_value(clk):
+    sph = make_sentinel(clk)
+    sph.load_param_flow_rules([ParamFlowRule(resource="r", param_idx=0, count=5)])
+    # each distinct value has its own bucket
+    assert burst(sph, "r", 8, args=("alice",)) == (5, 3)
+    assert burst(sph, "r", 8, args=("bob",)) == (5, 3)
+    # other resources unaffected
+    assert burst(sph, "other", 3, args=("alice",)) == (3, 0)
+
+
+def test_qps_refill_after_window(clk):
+    sph = make_sentinel(clk)
+    sph.load_param_flow_rules([ParamFlowRule(resource="r", param_idx=0, count=5)])
+    assert burst(sph, "r", 6, args=("k",)) == (5, 1)
+    clk.advance_ms(400)   # inside the window: still dry
+    assert burst(sph, "r", 2, args=("k",)) == (0, 2)
+    clk.advance_ms(700)   # window (1s) passed: full refill
+    assert burst(sph, "r", 6, args=("k",)) == (5, 1)
+
+
+def test_qps_burst_count(clk):
+    sph = make_sentinel(clk)
+    sph.load_param_flow_rules([
+        ParamFlowRule(resource="r", param_idx=0, count=3, burst_count=2)])
+    # first window admits count + burst
+    assert burst(sph, "r", 7, args=("k",)) == (5, 2)
+
+
+def test_qps_duration_in_sec(clk):
+    sph = make_sentinel(clk)
+    sph.load_param_flow_rules([
+        ParamFlowRule(resource="r", param_idx=0, count=4, duration_in_sec=2)])
+    assert burst(sph, "r", 5, args=("k",)) == (4, 1)
+    clk.advance_ms(1200)  # only 1.2s of a 2s window: no refill yet
+    assert burst(sph, "r", 2, args=("k",)) == (0, 2)
+    clk.advance_ms(1000)  # 2.2s total: refilled
+    assert burst(sph, "r", 5, args=("k",)) == (4, 1)
+
+
+def test_zero_threshold_blocks(clk):
+    sph = make_sentinel(clk)
+    sph.load_param_flow_rules([
+        ParamFlowRule(resource="r", param_idx=0, count=0, burst_count=5)])
+    assert burst(sph, "r", 3, args=("k",)) == (0, 3)
+
+
+def test_acquire_over_cap_blocks(clk):
+    sph = make_sentinel(clk)
+    sph.load_param_flow_rules([ParamFlowRule(resource="r", param_idx=0, count=3)])
+    with pytest.raises(stpu.ParamFlowException):
+        sph.entry("r", acquire=4, args=("k",))
+    # a fitting acquire still passes afterwards (nothing was consumed)
+    with sph.entry("r", acquire=3, args=("k",)):
+        pass
+
+
+def test_per_item_override(clk):
+    sph = make_sentinel(clk)
+    sph.load_param_flow_rules([ParamFlowRule(
+        resource="r", param_idx=0, count=5,
+        param_flow_item_list=[ParamFlowItem(object="vip", count=10),
+                              ParamFlowItem(object="banned", count=0)])])
+    assert burst(sph, "r", 12, args=("vip",)) == (10, 2)
+    assert burst(sph, "r", 7, args=("normal",)) == (5, 2)
+    assert burst(sph, "r", 2, args=("banned",)) == (0, 2)
+
+
+def test_missing_or_none_arg_passes(clk):
+    sph = make_sentinel(clk)
+    sph.load_param_flow_rules([ParamFlowRule(resource="r", param_idx=2, count=1)])
+    # args shorter than paramIdx → rule not applied (ParamFlowChecker.passCheck)
+    assert burst(sph, "r", 4, args=("a",)) == (4, 0)
+    # None value → pass
+    assert burst(sph, "r", 4, args=("a", "b", None)) == (4, 0)
+
+
+def test_negative_param_idx_from_tail(clk):
+    sph = make_sentinel(clk)
+    sph.load_param_flow_rules([ParamFlowRule(resource="r", param_idx=-1, count=2)])
+    # -1 → last arg (applyRealParamIdx)
+    assert burst(sph, "r", 4, args=("x", "hot")) == (2, 2)
+    # different last value: own bucket
+    assert burst(sph, "r", 4, args=("x", "cold")) == (2, 2)
+
+
+def test_collection_value_checks_every_element(clk):
+    sph = make_sentinel(clk)
+    sph.load_param_flow_rules([ParamFlowRule(resource="r", param_idx=0, count=2)])
+    # a list arg checks each element; all must pass
+    assert burst(sph, "r", 2, args=(["a", "b"],)) == (2, 0)
+    # both buckets now dry — third call blocks
+    assert burst(sph, "r", 1, args=(["a", "b"],)) == (0, 1)
+    # "c" is fresh but "a" is dry → still blocked (all-must-pass)
+    assert burst(sph, "r", 1, args=(["c", "a"],)) == (0, 1)
+    assert burst(sph, "r", 1, args=(["c"],)) == (1, 0)
+
+
+def test_param_flow_key_protocol(clk):
+    class User:
+        def __init__(self, uid):
+            self.uid = uid
+
+        def param_flow_key(self):
+            return self.uid
+
+    sph = make_sentinel(clk)
+    sph.load_param_flow_rules([ParamFlowRule(resource="r", param_idx=0, count=2)])
+    assert burst(sph, "r", 3, args=(User("u1"),)) == (2, 1)
+    # same key via plain string shares the bucket
+    assert burst(sph, "r", 1, args=("u1",)) == (0, 1)
+
+
+# ------------------------------------------------------------ rate limiter
+
+def test_throttle_zero_queue_blocks_back_to_back(clk):
+    sph = make_sentinel(clk)
+    sph.load_param_flow_rules([ParamFlowRule(
+        resource="r", param_idx=0, count=10,
+        control_behavior=stpu.PARAM_BEHAVIOR_RATE_LIMITER)])
+    # cost = 100ms; first passes at t, immediate second has wait>0 and
+    # maxQueueingTimeMs=0 → blocked
+    assert burst(sph, "r", 2, args=("k",)) == (1, 1)
+    clk.advance_ms(100)
+    assert burst(sph, "r", 1, args=("k",)) == (1, 0)
+
+
+def test_throttle_queueing_waits(clk):
+    sph = make_sentinel(clk)
+    sph.load_param_flow_rules([ParamFlowRule(
+        resource="r", param_idx=0, count=10, max_queueing_time_ms=500,
+        control_behavior=stpu.PARAM_BEHAVIOR_RATE_LIMITER)])
+    t0 = clk.now_ms()
+    p, b = burst(sph, "r", 4, args=("k",))
+    assert (p, b) == (4, 0)
+    # entry() sleeps the verdict's wait via the clock: 3 × 100ms pacing
+    assert clk.now_ms() - t0 >= 300
+    # a simultaneous burst beyond the queue horizon blocks its tail:
+    # waits pace at 100ms each, those reaching >= 500ms are rejected
+    v = sph.entry_batch(["r"] * 8, args_list=[("k",)] * 8)
+    assert 0 < int(np.sum(v.allow)) < 8
+    w = np.asarray(v.wait_ms)[np.asarray(v.allow)]
+    assert int(w.max()) < 500
+
+
+def test_throttle_rejected_request_consumes_no_pacing(clk):
+    sph = make_sentinel(clk)
+    sph.load_param_flow_rules([ParamFlowRule(
+        resource="r", param_idx=0, count=10, max_queueing_time_ms=500,
+        control_behavior=stpu.PARAM_BEHAVIOR_RATE_LIMITER)])
+    # acquires [1, 100, 1]: the 100-acquire costs 10s and must be rejected,
+    # and its cost must NOT delay the third request (reference: a failed CAS
+    # consumes nothing)
+    v = sph.entry_batch(["r"] * 3, args_list=[("k",)] * 3,
+                        acquire=[1, 100, 1])
+    assert list(np.asarray(v.allow)) == [True, False, True]
+    assert int(v.wait_ms[2]) <= 200
+
+
+def test_throttle_per_key_independent(clk):
+    sph = make_sentinel(clk)
+    sph.load_param_flow_rules([ParamFlowRule(
+        resource="r", param_idx=0, count=10,
+        control_behavior=stpu.PARAM_BEHAVIOR_RATE_LIMITER)])
+    assert burst(sph, "r", 1, args=("a",)) == (1, 0)
+    # different key: own pacing clock, passes immediately
+    assert burst(sph, "r", 1, args=("b",)) == (1, 0)
+
+
+# ------------------------------------------------------------ THREAD grade
+
+def test_thread_grade_concurrency(clk):
+    sph = make_sentinel(clk)
+    sph.load_param_flow_rules([ParamFlowRule(
+        resource="r", param_idx=0, grade=GRADE_THREAD, count=2)])
+    e1 = sph.entry("r", args=("k",))
+    e2 = sph.entry("r", args=("k",))
+    with pytest.raises(stpu.ParamFlowException):
+        sph.entry("r", args=("k",))
+    # other key unaffected
+    e3 = sph.entry("r", args=("other",))
+    e3.exit()
+    # releasing one slot readmits
+    e1.exit()
+    e4 = sph.entry("r", args=("k",))
+    e4.exit()
+    e2.exit()
+    # all released
+    e5 = sph.entry("r", args=("k",))
+    e5.exit()
+
+
+# ------------------------------------------------------------ batch + misc
+
+def test_batch_greedy_fifo_per_key(clk):
+    sph = make_sentinel(clk)
+    sph.load_param_flow_rules([ParamFlowRule(resource="r", param_idx=0, count=3)])
+    v = sph.entry_batch(["r"] * 8, args_list=[("k",)] * 8)
+    assert int(np.sum(v.allow)) == 3
+    assert bool(np.all(v.allow[:3])) and not bool(np.any(v.allow[3:]))
+    assert all(int(x) == stpu.BlockReason.PARAM_FLOW
+               for x in v.reason[np.asarray(~v.allow)])
+
+
+def test_batch_mixed_keys(clk):
+    sph = make_sentinel(clk)
+    sph.load_param_flow_rules([ParamFlowRule(resource="r", param_idx=0, count=2)])
+    args = [("a",), ("b",), ("a",), ("b",), ("a",), ("b",)]
+    v = sph.entry_batch(["r"] * 6, args_list=args)
+    # 2 per key admitted, FIFO within key
+    assert list(np.asarray(v.allow)) == [True, True, True, True, False, False]
+
+
+def test_key_registry_lru_eviction_resets_state(clk):
+    sph = make_sentinel(clk, param_table_slots=4)
+    sph.load_param_flow_rules([ParamFlowRule(resource="r", param_idx=0, count=1)])
+    assert burst(sph, "r", 2, args=("k0",)) == (1, 1)   # k0 dry
+    # flood the 4-slot registry so k0 is evicted
+    for i in range(1, 5):
+        burst(sph, "r", 1, args=(f"k{i}",))
+    # k0 re-interned on a recycled row: state must be cold (passes again)
+    assert burst(sph, "r", 1, args=("k0",)) == (1, 0)
+
+
+def test_rule_reload_resets_buckets(clk):
+    sph = make_sentinel(clk)
+    sph.load_param_flow_rules([ParamFlowRule(resource="r", param_idx=0, count=1)])
+    assert burst(sph, "r", 2, args=("k",)) == (1, 1)
+    sph.load_param_flow_rules([ParamFlowRule(resource="r", param_idx=0, count=5)])
+    assert burst(sph, "r", 6, args=("k",)) == (5, 1)
+
+
+def test_param_and_flow_rules_compose(clk):
+    sph = make_sentinel(clk)
+    sph.load_flow_rules([stpu.FlowRule(resource="r", count=10)])
+    sph.load_param_flow_rules([ParamFlowRule(resource="r", param_idx=0, count=3)])
+    # per-key cap binds first for a single hot key
+    assert burst(sph, "r", 5, args=("hot",)) == (3, 2)
+    # across keys the resource-level flow rule binds: 10 total pass
+    p = b = 0
+    for i in range(12):
+        try:
+            with sph.entry("r", args=(f"u{i}",)):
+                p += 1
+        except stpu.BlockException:
+            b += 1
+    assert (p, b) == (7, 5)  # 3 already passed → 7 more until the 10-cap
+
+
+def test_param_blocked_does_not_consume_flow_quota(clk):
+    sph = make_sentinel(clk)
+    sph.load_flow_rules([stpu.FlowRule(resource="r", count=5)])
+    sph.load_param_flow_rules([ParamFlowRule(resource="r", param_idx=0, count=1)])
+    assert burst(sph, "r", 5, args=("hot",)) == (1, 4)
+    # the 4 param-blocked events must not have eaten flow tokens: 4 more
+    # pass before the resource-level count=5 binds (FlowException, not param)
+    p = f = 0
+    for _ in range(6):
+        try:
+            with sph.entry("r", args=(None,)):
+                p += 1
+        except stpu.FlowException:
+            f += 1
+    assert (p, f) == (4, 2)
