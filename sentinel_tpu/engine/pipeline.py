@@ -203,8 +203,7 @@ def decide_entries(
     reason = jnp.where(~sys_ok, jnp.int8(BlockReason.SYSTEM), reason)
     reason = jnp.where(~auth_ok, jnp.int8(BlockReason.AUTHORITY), reason)
     reason = jnp.where(~batch.valid, jnp.int8(BlockReason.NONE), reason)
-    wait_ms = jnp.maximum(jnp.where(allow, wait_ms, 0),
-                          jnp.where(allow, param_wait, 0))
+    wait_ms = jnp.where(allow, jnp.maximum(wait_ms, param_wait), 0)
 
     # ---- StatisticSlot.entry (post-decision recording) ----
     passed = allow & batch.valid

@@ -254,6 +254,10 @@ class ParamKeyRegistry:
             if not self._pins.get(row):
                 del self._map[key]
                 self._evicted.append(row)
+                # a queued override for the evicted occupant must not land on
+                # the row's next occupant at the coming drain
+                self._pending_override = [
+                    (r, v) for r, v in self._pending_override if r != row]
                 return row
         raise RuntimeError(
             "all hot-param key rows are pinned by live entries; "
@@ -288,6 +292,21 @@ class ParamKeyRegistry:
     def __len__(self) -> int:
         with self._lock:
             return len(self._map)
+
+
+def thread_key_rows(compiled: CompiledParamRules, pair_rules: np.ndarray,
+                    pair_keys: np.ndarray) -> np.ndarray:
+    """Key rows of THREAD-grade pairs only; others → sentinel (skipped by
+    pin/unpin). Only THREAD-grade pairs need pinning: their exit-side
+    decrement must hit the same occupant, while QPS state is entry-only and
+    survives recycling as a bounded reset."""
+    out = np.asarray(pair_keys).copy().reshape(-1)
+    rj = np.asarray(pair_rules).reshape(-1)
+    nrules = len(compiled.rules)
+    for i, j in enumerate(rj):
+        if not (0 <= j < nrules and compiled.rules[j].grade == GRADE_THREAD):
+            out[i] = 2 ** 31 - 1   # >= any registry capacity → pin/unpin no-op
+    return out
 
 
 def resolve_pairs(compiled: CompiledParamRules, keys: ParamKeyRegistry,
@@ -433,9 +452,22 @@ def param_check(
     pair_pass_s = pair_pass_s | ~valid_s
     pair_wait_s = jnp.where(is_rl & pair_pass_s & valid_s, wait_s, 0)
 
+    # --- back to events: every pair must pass ---
+    pair_pass = seg.unsort(order, pair_pass_s.astype(jnp.int32)).astype(jnp.bool_)
+    pair_wait = seg.unsort(order, pair_wait_s.astype(jnp.int32))
+    allow = jnp.all(pair_pass.reshape(B, PV), axis=1)
+    wait_ms = jnp.max(pair_wait.reshape(B, PV), axis=1).astype(jnp.int32)
+    allow = allow | ~valid
+
     # --- state writeback (scatter at segment granularity) ---
+    # Consumption is EVENT-level: a pair whose event is blocked by a sibling
+    # pair consumes nothing (the reference's per-rule sequential check leaves
+    # earlier rules' consumption in place on a later rule's failure — an
+    # order-dependent artifact this build replaces with the same
+    # blocked-consumes-nothing invariant the rest of the pipeline uses).
+    event_ok_pair_s = jnp.repeat(allow & valid, PV)[order]
     live_qps = valid_s & is_qps
-    consumed = jnp.where(live_qps & pair_pass_s, acq_s, 0.0)
+    consumed = jnp.where(live_qps & pair_pass_s & event_ok_pair_s, acq_s, 0.0)
     _, incl_consumed = seg.segment_prefix_sum(consumed, starts, leader)
     new_tokens = t0 - incl_consumed
     # last element of each key segment carries the final value
@@ -445,19 +477,13 @@ def param_check(
     fill_target = jnp.where(is_last & live_qps & (never | refill), kj_s, PK)
     last_fill_new = dyn.last_fill_ms.at[fill_target].set(rel_now_ms, mode="drop")
 
-    rl_latest = jnp.where(is_rl & pair_pass_s & valid_s, latest_s, _NEVER)
+    rl_latest = jnp.where(is_rl & pair_pass_s & valid_s & event_ok_pair_s,
+                          latest_s, _NEVER)
     rl_target = jnp.where(is_rl & valid_s, kj_s, PK)
     latest_passed = dyn.latest_passed_ms.at[rl_target].max(rl_latest, mode="drop")
 
     dyn = dyn._replace(tokens=tokens, last_fill_ms=last_fill_new,
                        latest_passed_ms=latest_passed)
-
-    # --- back to events: every pair must pass ---
-    pair_pass = seg.unsort(order, pair_pass_s.astype(jnp.int32)).astype(jnp.bool_)
-    pair_wait = seg.unsort(order, pair_wait_s.astype(jnp.int32))
-    allow = jnp.all(pair_pass.reshape(B, PV), axis=1)
-    wait_ms = jnp.max(pair_wait.reshape(B, PV), axis=1).astype(jnp.int32)
-    allow = allow | ~valid
     return dyn, allow, wait_ms
 
 

@@ -190,6 +190,8 @@ class Sentinel:
             param_pairs=cfg.param_pairs_per_event,
         )
         self.param_key_registry = pf_mod.ParamKeyRegistry(cfg.param_table_slots)
+        self._user_param_rules: List[pf_mod.ParamFlowRule] = []
+        self._gateway_param_rules: List[pf_mod.ParamFlowRule] = []
         # bumped on every param-rule reload: pairs resolved against a stale
         # (table, registry) pair carry their generation and are dropped by
         # decide_raw/exit if a reload happened in between — a stale rule slot
@@ -279,7 +281,18 @@ class Sentinel:
                 breakers=deg_mod.init_breaker_state(cfg.max_degrade_rules))
 
     def load_param_flow_rules(self, rules: Sequence[pf_mod.ParamFlowRule]) -> None:
+        self._user_param_rules = list(rules)
+        self._reload_param_rules()
+
+    def set_gateway_param_rules(self, rules: Sequence[pf_mod.ParamFlowRule]) -> None:
+        """Install gateway-converted param rules (GatewayRuleManager path);
+        merged with user param rules into the single param slot."""
+        self._gateway_param_rules = list(rules)
+        self._reload_param_rules()
+
+    def _reload_param_rules(self) -> None:
         cfg = self.cfg
+        rules = self._user_param_rules + self._gateway_param_rules
         compiled = pf_mod.compile_param_rules(
             rules, resource_registry=self.resources,
             capacity=cfg.max_param_rules,
@@ -355,24 +368,25 @@ class Sentinel:
         if pairs is not None:
             pr = pairs[0][None, :]
             pk = pairs[1][None, :]
-        verdict = self.decide_raw(
-            np.array([row], np.int32), np.array([origin_id], np.int32),
-            np.array([o_row], np.int32), np.array([context_id], np.int32),
-            np.array([c_row], np.int32), np.array([acquire], np.int32),
-            np.array([is_in], np.bool_), np.array([prioritized], np.bool_),
-            param_rules=pr, param_keys=pk,
-            param_gen=pairs[2] if pairs is not None else -1)
-        if not bool(verdict.allow[0]):
-            raise block_exception_for(int(verdict.reason[0]), resource,
-                                      origin=use_origin)
+        try:
+            verdict = self.decide_raw(
+                np.array([row], np.int32), np.array([origin_id], np.int32),
+                np.array([o_row], np.int32), np.array([context_id], np.int32),
+                np.array([c_row], np.int32), np.array([acquire], np.int32),
+                np.array([is_in], np.bool_), np.array([prioritized], np.bool_),
+                param_rules=pr, param_keys=pk,
+                param_gen=pairs[2] if pairs is not None else -1)
+            if not bool(verdict.allow[0]):
+                raise block_exception_for(int(verdict.reason[0]), resource,
+                                          origin=use_origin)
+        except BaseException:
+            if pairs is not None:   # blocked entries never exit → unpin now
+                pairs[3].unpin_rows(pairs[4])
+            raise
         wait = int(verdict.wait_ms[0])
         if wait > 0:
             self.clock.sleep_ms(wait)
         now = self.clock.now_ms()
-        if pairs is not None:
-            # hold the key rows against LRU recycling while in flight, so this
-            # entry's exit can't decrement a recycled row's new occupant
-            pairs[3].pin_rows(pairs[1])
         return Entry(self, resource, row, o_row, c_row, acquire, is_in, now,
                      param_pairs=pairs)
 
@@ -380,7 +394,10 @@ class Sentinel:
         """→ (rules [PV], keys [PV], generation, registry), or None when the
         resource has no param rules / no args (rule-free events skip the
         param slot). Table, registry and generation are snapshotted together
-        under the lock so they are mutually consistent."""
+        under the lock so they are mutually consistent. The key rows come
+        back PINNED against LRU recycling (so a concurrent intern flood can't
+        recycle them between decide and exit); the caller owns the unpin —
+        on block, or after the exit-side decrement."""
         with self._lock:
             compiled = self._param
             registry = self.param_key_registry
@@ -391,7 +408,9 @@ class Sentinel:
             return None
         pr, pk = pf_mod.resolve_pairs(compiled, registry, row, args,
                                       self.spec.param_pairs)
-        return (pr, pk, gen, registry)
+        pins = pf_mod.thread_key_rows(compiled, pr, pk)
+        registry.pin_rows(pins)
+        return (pr, pk, gen, registry, pins)
 
     def _alt_row(self, row: int, kind: int, key_id: int) -> int:
         """Hash + record the (main row → alt row) edge for eviction hygiene."""
@@ -420,7 +439,6 @@ class Sentinel:
             pr = e.param_pairs[0][None, :]
             pk = e.param_pairs[1][None, :]
             gen = e.param_pairs[2]
-            e.param_pairs[3].unpin_rows(e.param_pairs[1])
         self.exit_batch(
             rows=np.array([e.row], np.int32),
             origin_rows=np.array([e.origin_row], np.int32),
@@ -465,6 +483,10 @@ class Sentinel:
                         compiled, registry, int(rows[i]), a, pv)
                     param_rules[i] = pr
                     param_keys[i] = pk
+            # pin THREAD-grade pairs while in flight (released for blocked
+            # events below; allowed events stay pinned until exit_batch)
+            registry.pin_rows(pf_mod.thread_key_rows(
+                compiled, param_rules, param_keys))
         origin_ids = np.zeros(n, np.int32)
         origin_rows = np.full(n, self.spec.alt_rows, np.int32)
         context_ids = np.zeros(n, np.int32)
@@ -486,10 +508,17 @@ class Sentinel:
             if entry_types is not None else np.ones(n, np.bool_)
         prio = np.asarray(prioritized, np.bool_) if prioritized is not None \
             else np.zeros(n, np.bool_)
-        return self.decide_raw(rows, origin_ids, origin_rows, context_ids,
-                               chain_rows, acq, is_in, prio,
-                               param_rules=param_rules, param_keys=param_keys,
-                               param_gen=param_gen)
+        verdicts = self.decide_raw(rows, origin_ids, origin_rows, context_ids,
+                                   chain_rows, acq, is_in, prio,
+                                   param_rules=param_rules,
+                                   param_keys=param_keys, param_gen=param_gen)
+        if param_keys is not None:
+            # blocked events never exit → release their pins immediately
+            blocked = ~np.asarray(verdicts.allow)
+            if blocked.any():
+                registry.unpin_rows(pf_mod.thread_key_rows(
+                    compiled, param_rules[blocked], param_keys[blocked]))
+        return verdicts
 
     def _pad_pairs(self, arr: Optional[np.ndarray], b: int, fill: int):
         """Pad an [n, PV] pair array to [b, PV] (or None passthrough)."""
@@ -509,8 +538,6 @@ class Sentinel:
         b = self._pad(n)
         pad_r = self.spec.rows
         pad_a = self.spec.alt_rows
-        if param_rules is not None and param_gen != self._param_gen:
-            param_rules = param_keys = None
         batch = EntryBatch(
             rows=_pad_to(rows, b, pad_r, np.int32),
             origin_ids=_pad_to(origin_ids, b, 0, np.int32),
@@ -528,6 +555,10 @@ class Sentinel:
         idx_s, idx_m, rel = self._time_scalars(now)
         load1, cpu = self._cpu.sample()
         with self._lock:
+            # gen check must happen under the same lock that guards reloads,
+            # or a reload racing here could land stale pairs on the new table
+            if batch.param_rules is not None and param_gen != self._param_gen:
+                batch = batch._replace(param_rules=None, param_keys=None)
             self._drain_evictions_locked()
             state, verdicts = self._jit_decide(
                 self._ruleset, self._state, batch, idx_s, idx_m, rel,
@@ -542,8 +573,6 @@ class Sentinel:
                    param_gen: int = -1) -> None:
         n = rows.shape[0]
         b = self._pad(n)
-        if param_rules is not None and param_gen != self._param_gen:
-            param_rules = param_keys = None   # state was reset by the reload
         batch = ExitBatch(
             rows=_pad_to(rows, b, self.spec.rows, np.int32),
             origin_rows=_pad_to(origin_rows, b, self.spec.alt_rows, np.int32),
@@ -559,8 +588,22 @@ class Sentinel:
         now = self.clock.now_ms()
         idx_s, idx_m, rel = self._time_scalars(now)
         with self._lock:
+            unpin = None
+            if batch.param_rules is not None:
+                if param_gen != self._param_gen:
+                    # state was reset by a reload: neither decrement nor unpin
+                    # (the pins live on the discarded registry)
+                    batch = batch._replace(param_rules=None, param_keys=None)
+                else:
+                    unpin = (self.param_key_registry,
+                             pf_mod.thread_key_rows(self._param, param_rules,
+                                                    param_keys))
             self._state = self._jit_exit(self._ruleset, self._state, batch,
                                          idx_s, idx_m, rel)
+        # unpin only AFTER the device-side decrement is enqueued (entry-side
+        # pin discipline: resolve→pin, decide, exit-decrement→unpin)
+        if unpin is not None:
+            unpin[0].unpin_rows(unpin[1])
 
     def _drain_evictions_locked(self) -> None:
         ev_keys, overrides = self.param_key_registry.drain_updates()

@@ -1,0 +1,215 @@
+"""Gateway flow rule tests — parity targets: GatewayRuleConverterTest /
+GatewayRuleManagerTest / GatewayParamParserTest / api matcher tests
+(sentinel-api-gateway-adapter-common + sentinel-spring-cloud-gateway-adapter
+test suites)."""
+
+import numpy as np
+import pytest
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.gateway import (
+    PARAM_MATCH_STRATEGY_CONTAINS,
+    PARAM_MATCH_STRATEGY_EXACT,
+    PARAM_MATCH_STRATEGY_REGEX,
+    PARAM_PARSE_STRATEGY_CLIENT_IP,
+    PARAM_PARSE_STRATEGY_HEADER,
+    PARAM_PARSE_STRATEGY_URL_PARAM,
+    RESOURCE_MODE_CUSTOM_API_NAME,
+    URL_MATCH_STRATEGY_EXACT,
+    URL_MATCH_STRATEGY_PREFIX,
+    URL_MATCH_STRATEGY_REGEX,
+    ApiDefinition,
+    ApiPathPredicateItem,
+    GatewayApiDefinitionManager,
+    GatewayFlowRule,
+    GatewayParamFlowItem,
+    GatewayParamParser,
+    GatewayRuleManager,
+)
+
+
+@pytest.fixture
+def clk():
+    return ManualClock(start_ms=1_785_000_000_000)
+
+
+def make(clk):
+    cfg = stpu.load_config(max_resources=64, max_param_rules=16,
+                           param_table_slots=256)
+    sph = stpu.Sentinel(config=cfg, clock=clk)
+    mgr = GatewayRuleManager(sph)
+    return sph, mgr
+
+
+def gw_burst(sph, resource, n, args):
+    p = b = 0
+    for _ in range(n):
+        try:
+            with sph.entry(resource, args=args):
+                p += 1
+        except stpu.ParamFlowException:
+            b += 1
+    return p, b
+
+
+# --------------------------------------------------------------- conversion
+
+def test_route_rule_without_param_item_caps_route_qps(clk):
+    sph, mgr = make(clk)
+    mgr.load_rules([GatewayFlowRule(resource="route-a", count=5)])
+    parser = GatewayParamParser(mgr)
+    args = parser.parse_parameters("route-a", {"path": "/x"})
+    assert args == ["$D"]
+    assert gw_burst(sph, "route-a", 8, args) == (5, 3)
+
+
+def test_client_ip_rule_throttles_per_ip(clk):
+    sph, mgr = make(clk)
+    mgr.load_rules([GatewayFlowRule(
+        resource="route-a", count=2,
+        param_item=GatewayParamFlowItem(
+            parse_strategy=PARAM_PARSE_STRATEGY_CLIENT_IP))])
+    parser = GatewayParamParser(mgr)
+    a1 = parser.parse_parameters("route-a", {"remote": "10.0.0.1"})
+    a2 = parser.parse_parameters("route-a", {"remote": "10.0.0.2"})
+    assert gw_burst(sph, "route-a", 3, a1) == (2, 1)
+    assert gw_burst(sph, "route-a", 3, a2) == (2, 1)
+
+
+def test_header_pattern_exact_only_matching_values_throttled(clk):
+    sph, mgr = make(clk)
+    mgr.load_rules([GatewayFlowRule(
+        resource="route-a", count=1,
+        param_item=GatewayParamFlowItem(
+            parse_strategy=PARAM_PARSE_STRATEGY_HEADER, field_name="X-User",
+            pattern="mallory", match_strategy=PARAM_MATCH_STRATEGY_EXACT))])
+    parser = GatewayParamParser(mgr)
+    bad = parser.parse_parameters("route-a", {"headers": {"X-User": "mallory"}})
+    good = parser.parse_parameters("route-a", {"headers": {"X-User": "alice"}})
+    assert bad == ["mallory"]
+    assert good == ["$NM"]   # non-matching → $NM, huge per-item override
+    assert gw_burst(sph, "route-a", 3, bad) == (1, 2)
+    assert gw_burst(sph, "route-a", 10, good) == (10, 0)
+
+
+def test_url_param_regex_and_contains(clk):
+    sph, mgr = make(clk)
+    mgr.load_rules([
+        GatewayFlowRule(resource="r1", count=1, param_item=GatewayParamFlowItem(
+            parse_strategy=PARAM_PARSE_STRATEGY_URL_PARAM, field_name="uid",
+            pattern=r"\d+", match_strategy=PARAM_MATCH_STRATEGY_REGEX)),
+        GatewayFlowRule(resource="r2", count=1, param_item=GatewayParamFlowItem(
+            parse_strategy=PARAM_PARSE_STRATEGY_HEADER, field_name="UA",
+            pattern="bot", match_strategy=PARAM_MATCH_STRATEGY_CONTAINS)),
+    ])
+    parser = GatewayParamParser(mgr)
+    assert parser.parse_parameters("r1", {"params": {"uid": "42"}}) == ["42"]
+    assert parser.parse_parameters("r1", {"params": {"uid": "abc"}}) == ["$NM"]
+    assert parser.parse_parameters("r2", {"headers": {"UA": "somebot/1"}}) == ["somebot/1"]
+    assert parser.parse_parameters("r2", {"headers": {"UA": "firefox"}}) == ["$NM"]
+
+
+def test_mixed_param_and_non_param_rules_share_args_array(clk):
+    sph, mgr = make(clk)
+    mgr.load_rules([
+        GatewayFlowRule(resource="route-a", count=2, param_item=GatewayParamFlowItem(
+            parse_strategy=PARAM_PARSE_STRATEGY_CLIENT_IP)),
+        GatewayFlowRule(resource="route-a", count=10),   # route-level cap
+    ])
+    parser = GatewayParamParser(mgr)
+    args = parser.parse_parameters("route-a", {"remote": "1.2.3.4"})
+    assert args == ["1.2.3.4", "$D"]
+    assert mgr.args_length("route-a") == 2
+    # per-IP cap of 2 binds first
+    assert gw_burst(sph, "route-a", 4, args) == (2, 2)
+    # other IPs ride until the shared $D cap of 10 binds
+    p = b = 0
+    for i in range(12):
+        a = parser.parse_parameters("route-a", {"remote": f"9.9.9.{i}"})
+        pp, bb = gw_burst(sph, "route-a", 1, a)
+        p += pp
+        b += bb
+    assert (p, b) == (8, 4)   # 2 already passed → 8 more until 10 total
+
+
+def test_interval_and_burst_conversion(clk):
+    sph, mgr = make(clk)
+    mgr.load_rules([GatewayFlowRule(
+        resource="route-a", count=2, interval_sec=2, burst=1,
+        param_item=GatewayParamFlowItem(
+            parse_strategy=PARAM_PARSE_STRATEGY_CLIENT_IP))])
+    parser = GatewayParamParser(mgr)
+    args = parser.parse_parameters("route-a", {"remote": "1.1.1.1"})
+    assert gw_burst(sph, "route-a", 5, args) == (3, 2)   # count+burst
+    # refill is rate-based: 2.1s at count/interval = 2/2s → floor(2.1·1) = 2
+    clk.advance_ms(2100)
+    assert gw_burst(sph, "route-a", 3, args) == (2, 1)
+    # a long idle period caps back at count+burst
+    clk.advance_ms(60_000)
+    assert gw_burst(sph, "route-a", 5, args) == (3, 2)
+
+
+def test_invalid_rules_skipped(clk):
+    sph, mgr = make(clk)
+    mgr.load_rules([
+        GatewayFlowRule(resource="", count=1),
+        GatewayFlowRule(resource="ok", count=-1),
+        GatewayFlowRule(resource="ok", count=1, interval_sec=0),
+        GatewayFlowRule(resource="ok", count=1, param_item=GatewayParamFlowItem(
+            parse_strategy=PARAM_PARSE_STRATEGY_HEADER, field_name="")),
+    ])
+    assert mgr.all_rules() == []
+
+
+# ------------------------------------------------------------- API groups
+
+def test_api_definition_matching():
+    mgr = GatewayApiDefinitionManager()
+    mgr.load_api_definitions([
+        ApiDefinition("products", (
+            ApiPathPredicateItem("/products"),
+            ApiPathPredicateItem("/products/**", URL_MATCH_STRATEGY_PREFIX))),
+        ApiDefinition("orders", (
+            ApiPathPredicateItem(r"/orders/\d+", URL_MATCH_STRATEGY_REGEX),)),
+    ])
+    assert mgr.matching_apis("/products") == ["products"]
+    assert mgr.matching_apis("/products/42/detail") == ["products"]
+    assert mgr.matching_apis("/orders/17") == ["orders"]
+    assert mgr.matching_apis("/orders/aa") == []
+    assert mgr.matching_apis("/other") == []
+    assert mgr.get_api_definition("products").api_name == "products"
+
+
+def test_api_group_rule_end_to_end(clk):
+    sph, mgr = make(clk)
+    api_mgr = GatewayApiDefinitionManager()
+    api_mgr.load_api_definitions([
+        ApiDefinition("my_api", (
+            ApiPathPredicateItem("/api/**", URL_MATCH_STRATEGY_PREFIX),))])
+    mgr.load_rules([GatewayFlowRule(
+        resource="my_api", resource_mode=RESOURCE_MODE_CUSTOM_API_NAME,
+        count=3)])
+    parser = GatewayParamParser(mgr)
+    # a gateway adapter resolves path → api names → entry per matched api
+    path = "/api/users"
+    assert api_mgr.matching_apis(path) == ["my_api"]
+    args = parser.parse_parameters("my_api", {"path": path})
+    assert gw_burst(sph, "my_api", 5, args) == (3, 2)
+
+
+def test_user_and_gateway_param_rules_coexist(clk):
+    sph, mgr = make(clk)
+    sph.load_param_flow_rules([stpu.ParamFlowRule(resource="svc", param_idx=0,
+                                                  count=1)])
+    mgr.load_rules([GatewayFlowRule(resource="route-a", count=2,
+                                    param_item=GatewayParamFlowItem(
+                                        parse_strategy=PARAM_PARSE_STRATEGY_CLIENT_IP))])
+    parser = GatewayParamParser(mgr)
+    args = parser.parse_parameters("route-a", {"remote": "8.8.8.8"})
+    assert gw_burst(sph, "route-a", 3, args) == (2, 1)
+    assert gw_burst(sph, "svc", 2, ("k",)) == (1, 1)
+    # reloading user rules keeps gateway rules installed
+    sph.load_param_flow_rules([stpu.ParamFlowRule(resource="svc", param_idx=0,
+                                                  count=5)])
+    assert gw_burst(sph, "route-a", 3, args) == (2, 1)

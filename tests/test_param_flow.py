@@ -262,6 +262,39 @@ def test_key_registry_lru_eviction_resets_state(clk):
     assert burst(sph, "r", 1, args=("k0",)) == (1, 0)
 
 
+def test_thread_pins_survive_lru_pressure(clk):
+    # an in-flight THREAD entry's key row must not be recycled by an intern
+    # flood between entry and exit (pin discipline)
+    sph = make_sentinel(clk, param_table_slots=4)
+    sph.load_param_flow_rules([ParamFlowRule(
+        resource="r", param_idx=0, grade=GRADE_THREAD, count=1)])
+    e1 = sph.entry("r", args=("held",))
+    # flood: > capacity distinct values; "held" must survive (pinned)
+    for i in range(6):
+        with sph.entry("r", args=(f"f{i}",)):
+            pass
+    with pytest.raises(stpu.ParamFlowException):
+        sph.entry("r", args=("held",))   # still at its concurrency cap
+    e1.exit()
+    e2 = sph.entry("r", args=("held",))  # released exactly once
+    e2.exit()
+
+
+def test_override_not_leaked_to_recycled_row(clk):
+    # a pending per-item override queued for an evicted row must not apply to
+    # the row's next occupant
+    sph = make_sentinel(clk, param_table_slots=2)
+    sph.load_param_flow_rules([ParamFlowRule(
+        resource="r", param_idx=0, count=1,
+        param_flow_item_list=[ParamFlowItem(object="vip", count=50)])])
+    # one batch: intern "vip" (queues override), then flood so "vip"'s row is
+    # evicted and re-interned by plain keys before any drain flushes
+    v = sph.entry_batch(["r"] * 4,
+                        args_list=[("vip",), ("a",), ("b",), ("c",)])
+    # plain keys must run at count=1 afterwards, not the vip threshold
+    assert burst(sph, "r", 3, args=("d",)) == (1, 2)
+
+
 def test_rule_reload_resets_buckets(clk):
     sph = make_sentinel(clk)
     sph.load_param_flow_rules([ParamFlowRule(resource="r", param_idx=0, count=1)])
