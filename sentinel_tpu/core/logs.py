@@ -1,0 +1,115 @@
+"""Framework logging: record log + aggregated block log.
+
+Reference: ``sentinel-core/.../log/RecordLog.java`` (``sentinel-record.log``
+in ``${user.home}/logs/csp/``, overridable dir, daily-rolling) and the
+EagleEye-backed block log (``slots/logger/EagleEyeLogUtil.java`` +
+``eagleeye/StatLogger``): block events are NOT written per-occurrence but
+rolled up per (resource, exception, limitApp, origin, ruleId) key every
+second and flushed as one pipe-delimited line — that per-interval rollup is
+what keeps logging off the hot path, and is reproduced here by
+:class:`BlockStatLogger`. Python's stdlib logging plays the ``Logger`` SPI
+role (handlers are swappable, the slf4j-binding analog)."""
+
+from __future__ import annotations
+
+import logging
+import logging.handlers
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+_DEF_DIR = os.path.join(os.path.expanduser("~"), "logs", "csp")
+
+
+def log_base_dir() -> str:
+    return os.environ.get("SENTINEL_TPU_LOG_DIR", _DEF_DIR)
+
+
+_record_logger: Optional[logging.Logger] = None
+_record_lock = threading.Lock()
+
+
+def record_log(to_file: bool = True) -> logging.Logger:
+    """The framework's own diagnostics channel (``RecordLog``)."""
+    global _record_logger
+    with _record_lock:
+        if _record_logger is None:
+            lg = logging.getLogger("sentinel_tpu.record")
+            lg.setLevel(logging.INFO)
+            lg.propagate = False
+            if to_file:
+                try:
+                    os.makedirs(log_base_dir(), exist_ok=True)
+                    h = logging.handlers.TimedRotatingFileHandler(
+                        os.path.join(log_base_dir(), "sentinel-record.log"),
+                        when="midnight", backupCount=7, delay=True)
+                    h.setFormatter(logging.Formatter(
+                        "%(asctime)s %(levelname)s %(message)s"))
+                    lg.addHandler(h)
+                except OSError:   # unwritable home: stderr fallback
+                    lg.addHandler(logging.StreamHandler())
+            else:
+                lg.addHandler(logging.NullHandler())
+            _record_logger = lg
+        return _record_logger
+
+
+class BlockStatLogger:
+    """Per-second rollup of block events → ``sentinel-block.log``.
+
+    Line format mirrors the EagleEye stat line:
+    ``ms|resource,exception,limitApp,origin,ruleId|count`` with at most
+    ``max_entries`` distinct keys per interval (overflow keys are dropped,
+    like the StatLogger's maxEntryCount=6000)."""
+
+    FILE_NAME = "sentinel-block.log"
+
+    def __init__(self, clock, base_dir: Optional[str] = None,
+                 max_entries: int = 6000, max_bytes: int = 300 * 1024 * 1024,
+                 backups: int = 3):
+        self._clock = clock
+        self._dir = base_dir or log_base_dir()
+        self._max_entries = max_entries
+        self._max_bytes = max_bytes
+        self._backups = backups
+        self._lock = threading.Lock()
+        self._bucket_sec = 0
+        self._counts: Dict[Tuple[str, str, str, str, str], int] = {}
+
+    def log(self, resource: str, exception_name: str, limit_app: str = "",
+            origin: str = "", rule_id: str = "", count: int = 1) -> None:
+        sec = self._clock.now_ms() // 1000
+        flush = None
+        with self._lock:
+            if sec != self._bucket_sec and self._counts:
+                flush = (self._bucket_sec, self._counts)
+                self._counts = {}
+            self._bucket_sec = sec
+            key = (resource, exception_name, limit_app, origin, rule_id)
+            if key in self._counts or len(self._counts) < self._max_entries:
+                self._counts[key] = self._counts.get(key, 0) + count
+        if flush:
+            self._write(*flush)
+
+    def flush(self) -> None:
+        with self._lock:
+            pending = (self._bucket_sec, self._counts)
+            self._counts = {}
+        if pending[1]:
+            self._write(*pending)
+
+    def _write(self, sec: int, counts: Dict) -> None:
+        path = os.path.join(self._dir, self.FILE_NAME)
+        try:
+            os.makedirs(self._dir, exist_ok=True)
+            if os.path.exists(path) and os.path.getsize(path) > self._max_bytes:
+                for i in range(self._backups - 1, 0, -1):
+                    src = f"{path}.{i}"
+                    if os.path.exists(src):
+                        os.replace(src, f"{path}.{i + 1}")
+                os.replace(path, f"{path}.1")
+            with open(path, "a", encoding="utf-8") as fh:
+                for (res, exc, la, org, rid), n in counts.items():
+                    fh.write(f"{sec * 1000}|{res},{exc},{la},{org},{rid}|{n}\n")
+        except OSError:   # pragma: no cover — never break the hot path on IO
+            pass

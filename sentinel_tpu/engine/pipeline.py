@@ -303,7 +303,7 @@ def record_exits(
     if spec.minute:
         minute = refresh_rows(spec.minute, state.minute, main_targets, now_idx_m)
         minute = add_rows(spec.minute, minute, main_targets, ev.SUCCESS,
-                          succ_amt, now_idx_m)
+                          succ_amt, now_idx_m, rt_ms=rt2)
         minute = add_rows(spec.minute, minute, main_targets, ev.EXCEPTION,
                           err2, now_idx_m)
 

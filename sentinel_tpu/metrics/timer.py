@@ -1,0 +1,72 @@
+"""Per-second metric aggregation → rolling files.
+
+Reference: ``sentinel-core/.../node/metric/MetricTimerListener.java`` — a 1 s
+scheduled task (started by ``FlowRuleManager``'s static init) that snapshots
+every ClusterNode (+ the global ENTRY_NODE) per whole second and hands the
+nodes to ``MetricWriter``. Here the per-second read is one device gather over
+the minute ring (:func:`sentinel_tpu.stats.window.bucket_snapshot`) and the
+loop is a daemon thread on the runtime's clock (virtual-time friendly:
+``tick()`` is callable directly in tests)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from sentinel_tpu.metrics.writer import MetricWriter
+
+
+class MetricTimerListener:
+    def __init__(self, sentinel, writer: Optional[MetricWriter] = None,
+                 flush_interval_sec: int = 1):
+        cfg = sentinel.cfg
+        self._sentinel = sentinel
+        self.writer = writer or MetricWriter(
+            cfg.metric_dir(), cfg.app_name,
+            single_file_size=cfg.metric_log_single_size,
+            total_file_count=cfg.metric_log_total_count)
+        self._interval = max(flush_interval_sec, 1)
+        self._last_written_sec = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def tick(self) -> int:
+        """Aggregate every completed-but-unwritten second up to now; → number
+        of seconds written. Called by the daemon loop, or directly in tests
+        driving a manual clock."""
+        now_sec = self._sentinel.clock.now_ms() // 1000
+        if self._last_written_sec == 0:
+            self._last_written_sec = now_sec - 1
+        written = 0
+        # catch up at most one minute ring — older buckets have been recycled
+        start = max(self._last_written_sec + 1, now_sec - 59)
+        for sec in range(start, now_sec):   # only COMPLETED seconds
+            nodes = self._sentinel.metrics_snapshot(sec * 1000)
+            if nodes:
+                self.writer.write(sec * 1000, nodes)
+                written += 1
+            self._last_written_sec = sec
+        return written
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self._interval):
+                try:
+                    self.tick()
+                except Exception:   # pragma: no cover — keep the daemon alive
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="sentinel-metric-timer")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+        self.writer.close()

@@ -52,9 +52,10 @@ from sentinel_tpu.rules import degrade as deg_mod
 from sentinel_tpu.rules import flow as flow_mod
 from sentinel_tpu.rules import param_flow as pf_mod
 from sentinel_tpu.rules import system as sys_mod
+from sentinel_tpu.core.logs import BlockStatLogger
 from sentinel_tpu.stats import events as ev
 from sentinel_tpu.stats.window import (
-    MINUTE_SPEC, SECOND_SPEC, WindowSpec, rolling_totals,
+    MINUTE_SPEC, SECOND_SPEC, WindowSpec, bucket_snapshot, rolling_totals,
 )
 
 ENTRY_TYPE_OUT = 0
@@ -73,6 +74,11 @@ def _jitted_steps(spec: EngineSpec):
 # cache stays small (calling jax.jit(...) per drain would re-trace every time)
 _jit_invalidate_param_keys = jax.jit(pf_mod.invalidate_param_keys)
 _jit_apply_overrides = jax.jit(pf_mod.apply_overrides)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_bucket_snapshot(spec: WindowSpec):
+    return jax.jit(functools.partial(bucket_snapshot, spec))
 
 _H1 = 0x9E3779B1
 _H2 = 0x85EBCA6B
@@ -220,6 +226,10 @@ class Sentinel:
 
         self._cpu = _CpuSampler(self.clock)
         self._global_on = True  # reference Constants.ON / setSwitch command
+        # resource → ResourceTypeConstants classification (first writer wins)
+        self.resource_types: dict = {}
+        # per-second rolled-up block log (LogSlot → EagleEyeLogUtil analog)
+        self.block_log = BlockStatLogger(self.clock)
 
         self._jit_decide, self._jit_exit, self._jit_invalidate = _jitted_steps(self.spec)
 

@@ -80,7 +80,9 @@ class WindowSpec:
 
 
 SECOND_SPEC = WindowSpec(buckets=2, win_ms=500)
-MINUTE_SPEC = WindowSpec(buckets=60, win_ms=1000, track_rt=False)
+# rt tracked so the metric-file pipeline can report per-second average RT
+# (the reference's rollingCounterInMinute feeds MetricTimerListener)
+MINUTE_SPEC = WindowSpec(buckets=60, win_ms=1000, track_rt=True)
 
 
 class WindowState(NamedTuple):
@@ -213,6 +215,21 @@ def invalidate_rows(spec: WindowSpec, state: WindowState, rows: jnp.ndarray) -> 
     """
     stamps = state.stamps.at[rows, :].set(NEVER, mode="drop")
     return state._replace(stamps=stamps)
+
+
+def bucket_snapshot(spec: WindowSpec, state: WindowState, idx: jnp.ndarray):
+    """All rows' counters (+ rt sum) for the bucket at window index ``idx`` —
+    zeros where that bucket is dead. The per-second aggregation read the
+    metric-file pipeline makes (``MetricTimerListener`` pulls each node's
+    per-second ``metrics()``)."""
+    k = _bucket_of(spec, idx)
+    live = state.stamps[:, k] == idx                        # [R]
+    counters = jnp.where(live[:, None], state.counters[:, k, :], 0)
+    if spec.track_rt:
+        rt = jnp.where(live, state.rt_sum[:, k], 0.0)
+    else:
+        rt = jnp.zeros(live.shape, jnp.float32)
+    return counters, rt
 
 
 def min_rt_rows(spec: WindowSpec, state: WindowState, rows: jnp.ndarray,
