@@ -1,0 +1,12 @@
+"""Cluster token transport: reference-compatible wire protocol, TCP token
+server, and client SDK (SURVEY §2.3, sentinel-cluster-*)."""
+
+from sentinel_tpu.cluster.codec import (  # noqa: F401
+    MSG_TYPE_PING, MSG_TYPE_FLOW, MSG_TYPE_PARAM_FLOW,
+    MSG_TYPE_CONCURRENT_FLOW_ACQUIRE, MSG_TYPE_CONCURRENT_FLOW_RELEASE,
+    DEFAULT_CLUSTER_SERVER_PORT, DEFAULT_REQUEST_TIMEOUT_MS,
+    FrameAssembler, Request, Response,
+    decode_request, decode_response, encode_request, encode_response,
+)
+from sentinel_tpu.cluster.server import ClusterTokenServer  # noqa: F401
+from sentinel_tpu.cluster.client import ClusterTokenClient, TokenResult  # noqa: F401

@@ -83,6 +83,11 @@ _BY_CODE = {
 }
 
 
+def exception_name_for(code: int) -> str:
+    """Exception class name for a BlockReason code (block-log lines)."""
+    return _BY_CODE.get(int(code), BlockException).__name__
+
+
 def block_exception_for(code: int, resource: str, origin: str = "",
                         wait_ms: int = 0, rule: Optional[Any] = None) -> BlockException:
     cls = _BY_CODE.get(int(code), BlockException)

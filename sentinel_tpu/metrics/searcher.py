@@ -15,7 +15,7 @@ from typing import List, Optional
 from sentinel_tpu.metrics.node import MetricNode
 from sentinel_tpu.metrics.writer import IDX_SUFFIX, list_metric_files
 
-_IDX_ENTRY = struct.Struct(">qq")
+from sentinel_tpu.metrics.writer import _IDX_ENTRY  # single on-disk format def
 MAX_LINES_RETURN = 100_000   # MetricsReader.maxLinesReturn
 
 

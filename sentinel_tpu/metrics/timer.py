@@ -26,7 +26,9 @@ class MetricTimerListener:
             single_file_size=cfg.metric_log_single_size,
             total_file_count=cfg.metric_log_total_count)
         self._interval = max(flush_interval_sec, 1)
-        self._last_written_sec = 0
+        # seconds from construction onward get written (reference: the timer
+        # is started by FlowRuleManager static init, before any traffic)
+        self._last_written_sec = sentinel.clock.now_ms() // 1000 - 1
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -35,8 +37,6 @@ class MetricTimerListener:
         of seconds written. Called by the daemon loop, or directly in tests
         driving a manual clock."""
         now_sec = self._sentinel.clock.now_ms() // 1000
-        if self._last_written_sec == 0:
-            self._last_written_sec = now_sec - 1
         written = 0
         # catch up at most one minute ring — older buckets have been recycled
         start = max(self._last_written_sec + 1, now_sec - 59)

@@ -84,10 +84,16 @@ class ClusterSpec:
     flows_per_shard: int          # L — flow rows owned per shard
     namespaces: int               # NS — namespace slots
     window: WindowSpec = CLUSTER_WINDOW
+    param_keys_per_shard: int = 0  # PK — hot-key rows per shard (0 = off)
+    max_params: int = 4            # PV — values per param request
 
     @property
     def total_rows(self) -> int:
         return self.n_shards * self.flows_per_shard
+
+    @property
+    def total_param_rows(self) -> int:
+        return self.n_shards * max(1, self.param_keys_per_shard)
 
 
 class ClusterRuleTable(NamedTuple):
@@ -104,6 +110,7 @@ class ClusterRuleTable(NamedTuple):
 class ClusterState(NamedTuple):
     flows: WindowState             # rows = S·L (sharded on rows)
     ns: WindowState                # rows = S·NS (sharded: NS local rows/shard)
+    params: WindowState            # rows = S·PK — hot-key counters
 
 
 class TokenBatch(NamedTuple):
@@ -113,6 +120,9 @@ class TokenBatch(NamedTuple):
     acquire: jnp.ndarray       # int32
     prioritized: jnp.ndarray   # bool
     valid: jnp.ndarray         # bool
+    is_param: jnp.ndarray      # bool — PARAM_FLOW request (param path)
+    param_rows: jnp.ndarray    # int32[S·Bl, PV] — local key row; PK = none
+    param_count: jnp.ndarray   # float32[S·Bl, PV] — raw per-value threshold
 
 
 class TokenVerdicts(NamedTuple):
@@ -125,6 +135,7 @@ def init_cluster_state(spec: ClusterSpec) -> ClusterState:
     return ClusterState(
         flows=init_window(spec.window, spec.total_rows),
         ns=init_window(spec.window, spec.n_shards * spec.namespaces),
+        params=init_window(spec.window, spec.total_param_rows),
     )
 
 
@@ -165,28 +176,29 @@ def _shard_step(
     proceed = active & limiter_ok
 
     # ---- per-flow admission (ClusterFlowChecker.acquireClusterToken) ----
+    flow_req = proceed & ~batch.is_param
     latest = window_sum_all(w, state.flows, ev.PASS, now_idx).astype(jnp.float32)  # [L]
     conn = connected[jnp.minimum(table.ns_id, NS - 1)]
     thr_rule = table.count * jnp.where(table.is_global, 1.0, conn) * table.exceed  # [L]
 
-    seg_rows = jnp.where(proceed, rows, L)  # L = never-blocking sentinel segment
+    seg_rows = jnp.where(flow_req, rows, L)  # L = never-blocking sentinel segment
     order = seg.sort_by_keys(seg_rows, jnp.zeros_like(seg_rows))
     rows_s = seg_rows[order]
     starts = seg.segment_starts(rows_s, jnp.zeros_like(rows_s))
     leader = seg.segment_leader_index(starts)
-    acq_s = jnp.where(proceed, batch.acquire, 0).astype(jnp.float32)[order]
+    acq_s = jnp.where(flow_req, batch.acquire, 0).astype(jnp.float32)[order]
     safe_rows_s = jnp.minimum(rows_s, L - 1)
     base_s = latest[safe_rows_s]
     lim_s = jnp.where(rows_s < L, thr_rule[safe_rows_s], jnp.inf)
     admit_s = seg.greedy_admit(base_s, acq_s, lim_s, starts, leader)
     excl_s, _ = seg.segment_prefix_sum(jnp.where(admit_s, acq_s, 0.0), starts, leader)
     remaining_s = lim_s - base_s - excl_s - acq_s
-    admitted = seg.unsort(order, admit_s.astype(jnp.int32)).astype(jnp.bool_) & proceed
+    admitted = seg.unsort(order, admit_s.astype(jnp.int32)).astype(jnp.bool_) & flow_req
     remaining = jnp.where(jnp.isfinite(remaining_s), remaining_s, 0.0)
     remaining = seg.unsort(order, remaining.astype(jnp.int32))
 
     # ---- occupy: prioritized deficit pre-books future windows ----
-    denied = proceed & ~admitted
+    denied = flow_req & ~admitted
     waiting_sum = window_sum_all(w, state.flows, ev.WAITING, now_idx).astype(jnp.float32)
     occupy_open = waiting_sum[rows] <= table.max_occupy[rows] * thr_rule[rows]
     # expiry scan: waiting until bucket k (stamp s_k) rotates out frees its
@@ -210,6 +222,69 @@ def _shard_step(
     wait_ms = jnp.where(should_wait, best_wait, 0)
 
     blocked = denied & ~should_wait
+
+    # ---- hot-param admission (ClusterParamFlowChecker.acquireClusterToken) ----
+    # Per-value avg vs calcGlobalThreshold; a request passes iff EVERY carried
+    # value fits, and only then are all its values counted (reference
+    # semantics; the host resolves per-item threshold overrides into
+    # ``param_count``). Values are hashed onto PK local key rows; within one
+    # batch step concurrent requests on a shared key over-admit — the same
+    # check-then-act class the reference tolerates across threads.
+    PK = spec.param_keys_per_shard
+    is_p = proceed & batch.is_param
+    pstate = state.params
+    if PK:
+        latest_p = window_sum_all(w, pstate, ev.PASS, now_idx).astype(jnp.float32)
+        prow = batch.param_rows                               # [Bl, PV]
+        live = (prow >= 0) & (prow < PK) & is_p[:, None]
+        thr_p = batch.param_count * jnp.where(
+            table.is_global[rows], 1.0, conn[rows])[:, None]  # [Bl, PV]
+        acq_f = batch.acquire.astype(jnp.float32)[:, None]
+
+        # within-batch exact admission: greedy segment admit over flattened
+        # (request × value) rows sharing a key, like the flow path. A value
+        # row admitted for a request that ultimately fails on ANOTHER value
+        # still reserves quota within this batch (bounded under-admission) —
+        # but its count is never recorded, so nothing leaks across steps.
+        flat_keys = jnp.where(live, prow, PK).reshape(-1)     # [Bl·PV]
+        order_p = seg.sort_by_keys(flat_keys, jnp.zeros_like(flat_keys))
+        keys_s = flat_keys[order_p]
+        starts_p = seg.segment_starts(keys_s, jnp.zeros_like(keys_s))
+        leader_p = seg.segment_leader_index(starts_p)
+        acq_flat_s = jnp.where(live, acq_f, 0.0).reshape(-1)[order_p]
+        safe_keys_s = jnp.minimum(keys_s, PK - 1)
+        base_s = latest_p[safe_keys_s]
+        lim_s = jnp.where(keys_s < PK, thr_p.reshape(-1)[order_p], jnp.inf)
+        ok_s = seg.greedy_admit(base_s, acq_flat_s, lim_s, starts_p, leader_p)
+        excl_p, _ = seg.segment_prefix_sum(
+            jnp.where(ok_s, acq_flat_s, 0.0), starts_p, leader_p)
+        rem_flat_s = lim_s - base_s - excl_p - acq_flat_s
+        row_ok = seg.unsort(order_p, ok_s.astype(jnp.int32)).reshape(
+            (Bl, -1)).astype(jnp.bool_)
+        rem_flat = seg.unsort(
+            order_p, jnp.where(jnp.isfinite(rem_flat_s), rem_flat_s, 0.0)
+        ).reshape((Bl, -1))
+
+        any_live = jnp.any(live, axis=1)
+        all_ok = jnp.all(row_ok | ~live, axis=1)
+        param_pass = is_p & (all_ok | ~any_live)
+        param_block = is_p & any_live & ~all_ok
+        # remaining meaningful only for single-value requests (host packs
+        # values densely from column 0); multi-value → -1 like the reference
+        nlive = jnp.sum(live.astype(jnp.int32), axis=1)
+        rem1 = jnp.maximum(rem_flat[:, 0], 0.0)
+        rem_p = jnp.where(nlive == 1, rem1, -1.0).astype(jnp.int32)
+
+        from sentinel_tpu.stats.window import add_rows as _add, refresh_rows as _refresh
+        flat = jnp.where(live & param_pass[:, None], prow, PK).reshape(-1)
+        pstate = _refresh(w, pstate, flat, now_idx)
+        pstate = _add(w, pstate, flat, ev.PASS,
+                      jnp.where(live & param_pass[:, None],
+                                batch.acquire[:, None], 0).reshape(-1), now_idx)
+    else:
+        param_pass = is_p          # param slot disabled: empty-values → OK
+        param_block = jnp.zeros_like(is_p)
+        rem_p = jnp.full((Bl,), -1, jnp.int32)
 
     # ---- record (post-decision, like StatisticSlot ordering) ----
     pad = jnp.int32(L)
@@ -244,12 +319,36 @@ def _shard_step(
     status = jnp.where(blocked, STATUS_BLOCKED, status)
     status = jnp.where(should_wait, STATUS_SHOULD_WAIT, status)
     status = jnp.where(admitted, STATUS_OK, status)
+    status = jnp.where(param_block, STATUS_BLOCKED, status)
+    status = jnp.where(param_pass, STATUS_OK, status)
 
+    remaining = jnp.where(admitted, jnp.maximum(remaining, 0), 0)
+    remaining = jnp.where(param_pass | param_block,
+                          jnp.where(param_pass, rem_p, 0), remaining)
     verdicts = TokenVerdicts(
         status=status,
         wait_ms=wait_ms.astype(jnp.int32),
-        remaining=jnp.where(admitted, jnp.maximum(remaining, 0), 0).astype(jnp.int32))
-    return ClusterState(flows=flows, ns=ns_state), verdicts
+        remaining=remaining.astype(jnp.int32))
+    return ClusterState(flows=flows, ns=ns_state, params=pstate), verdicts
+
+
+@dataclasses.dataclass
+class ClusterParamFlowRule:
+    """Cluster hot-param rule (reference ``ParamFlowRule`` cluster fields:
+    flowId, thresholdType, count, plus exclusive per-item thresholds —
+    ``parsedHotItems``)."""
+
+    flow_id: int
+    count: float
+    threshold_type: int = THRESHOLD_AVG_LOCAL
+    items: Optional[Dict[object, float]] = None
+
+    def value_threshold(self, value: object) -> float:
+        if self.items is not None:
+            override = self.items.get(value)
+            if override is not None:
+                return float(override)
+        return float(self.count)
 
 
 @dataclasses.dataclass
@@ -292,6 +391,7 @@ class ClusterEngine:
         self._ns_ids: Dict[str, int] = {}
         self._flow_ns: Dict[int, str] = {}
         self._rules: Dict[int, ClusterFlowRule] = {}
+        self._param_rules: Dict[int, ClusterParamFlowRule] = {}
         self._connected = np.ones(spec.namespaces, np.float32)
         self._ns_limit = np.full(spec.namespaces, default_ns_qps, np.float32)
         self._next_row_per_shard = [0] * spec.n_shards
@@ -321,9 +421,10 @@ class ClusterEngine:
         body = functools.partial(_shard_step, spec)
         row_spec = P("shard")
         state_specs = ClusterState(
-            flows=WindowState(*([row_spec] * 4)), ns=WindowState(*([row_spec] * 4)))
+            flows=WindowState(*([row_spec] * 4)), ns=WindowState(*([row_spec] * 4)),
+            params=WindowState(*([row_spec] * 4)))
         table_specs = ClusterRuleTable(*([row_spec] * 6))
-        batch_specs = TokenBatch(*([row_spec] * 4))
+        batch_specs = TokenBatch(*([row_spec] * 7))
         sm = _shard_map(
             body, mesh=mesh,
             in_specs=(table_specs, state_specs, batch_specs, P(), P(), P(), P()),
@@ -366,7 +467,8 @@ class ClusterEngine:
             self.namespace_id(namespace)
             freed: List[int] = []
             for fid, ns in list(self._flow_ns.items()):
-                if ns == namespace and fid not in {r.flow_id for r in rules}:
+                if (ns == namespace and fid not in {r.flow_id for r in rules}
+                        and fid not in self._param_rules):
                     row = self._flow_to_row.pop(fid)
                     self._row_to_flow.pop(row, None)
                     self._flow_ns.pop(fid)
@@ -385,6 +487,132 @@ class ClusterEngine:
                     self.spec.window, self.state.flows,
                     jnp.asarray(np.asarray(freed, np.int32))))
             self._rebuild_table()
+
+    def load_param_rules(self, namespace: str,
+                         rules: Sequence["ClusterParamFlowRule"]) -> None:
+        """Replace the namespace's hot-param rules
+        (ClusterParamFlowRuleManager property path). Requires
+        ``spec.param_keys_per_shard > 0``."""
+        if self.spec.param_keys_per_shard <= 0 and rules:
+            raise ValueError("engine built without param key capacity")
+        with self._lock:
+            self.namespace_id(namespace)
+            new_ids = {r.flow_id for r in rules}
+            freed: List[int] = []
+            for fid, ns in list(self._flow_ns.items()):
+                if (ns == namespace and fid in self._param_rules
+                        and fid not in new_ids):
+                    row = self._flow_to_row.pop(fid)
+                    self._row_to_flow.pop(row, None)
+                    self._flow_ns.pop(fid)
+                    self._rules.pop(fid, None)
+                    self._param_rules.pop(fid, None)
+                    self._free_rows[row // self.spec.flows_per_shard].append(row)
+                    freed.append(row)
+            if freed:
+                from sentinel_tpu.stats.window import invalidate_rows
+                self.state = self.state._replace(flows=invalidate_rows(
+                    self.spec.window, self.state.flows,
+                    jnp.asarray(np.asarray(freed, np.int32))))
+            for r in rules:
+                if r.flow_id not in self._flow_to_row:
+                    self._flow_to_row[r.flow_id] = self._alloc_row()
+                    self._row_to_flow[self._flow_to_row[r.flow_id]] = r.flow_id
+                self._flow_ns[r.flow_id] = namespace
+                self._param_rules[r.flow_id] = r
+                # proxy row in the rule table: ns routing + GLOBAL/AVG flag
+                self._rules[r.flow_id] = ClusterFlowRule(
+                    flow_id=r.flow_id, count=r.count,
+                    threshold_type=r.threshold_type)
+            self._rebuild_table()
+
+    def _param_key(self, flow_id: int, value: object) -> int:
+        """Stable (process-independent) hash of a param value onto the owner
+        shard's PK key rows. Type-tagged so ``1`` and ``"1"`` stay distinct."""
+        import hashlib
+
+        tag = f"{flow_id}|{type(value).__name__}|{value!r}".encode()
+        h = hashlib.blake2s(tag, digest_size=8).digest()
+        return int.from_bytes(h, "little") % self.spec.param_keys_per_shard
+
+    def request_param_tokens(self, flow_ids: Sequence[int],
+                             acquire: Sequence[int],
+                             params: Sequence[Sequence[object]],
+                             *, now_ms: int) -> List[Tuple[int, int, int]]:
+        """Batched ``TokenService.requestParamToken`` → ``(status, wait_ms,
+        remaining)`` per request. Values beyond ``spec.max_params`` per
+        request are dropped (cap documented on :class:`ClusterSpec`)."""
+        from sentinel_tpu.core.batching import pad_pow2
+
+        n = len(flow_ids)
+        S = self.spec.n_shards
+        L = self.spec.flows_per_shard
+        PV = self.spec.max_params
+        PK = self.spec.param_keys_per_shard
+
+        with self._lock:
+            per_shard: List[List[int]] = [[] for _ in range(S)]
+            results: List[Optional[Tuple[int, int, int]]] = [None] * n
+            for i, fid in enumerate(flow_ids):
+                rule = self._param_rules.get(int(fid))
+                if acquire[i] <= 0:
+                    results[i] = (STATUS_BAD_REQUEST, 0, 0)
+                elif rule is None:
+                    results[i] = (STATUS_NO_RULE_EXISTS, 0, 0)
+                elif not params[i]:
+                    results[i] = (STATUS_OK, 0, 0)   # empty values pass
+                else:
+                    per_shard[self._flow_to_row[int(fid)] // L].append(i)
+
+            bl = max((len(p) for p in per_shard), default=0)
+            if bl == 0:
+                return [r or (STATUS_FAIL, 0, 0) for r in results]
+            blp = pad_pow2(bl)
+
+            rows = np.zeros((S, blp), np.int32)
+            acq = np.zeros((S, blp), np.int32)
+            valid = np.zeros((S, blp), np.bool_)
+            is_param = np.zeros((S, blp), np.bool_)
+            prow = np.full((S, blp, PV), PK, np.int32)
+            pcnt = np.zeros((S, blp, PV), np.float32)
+            for s in range(S):
+                for k, i in enumerate(per_shard[s]):
+                    fid = int(flow_ids[i])
+                    rule = self._param_rules[fid]
+                    rows[s, k] = self._flow_to_row[fid] % L
+                    acq[s, k] = acquire[i]
+                    valid[s, k] = True
+                    is_param[s, k] = True
+                    for j, v in enumerate(list(params[i])[:PV]):
+                        prow[s, k, j] = self._param_key(fid, v)
+                        pcnt[s, k, j] = rule.value_threshold(v)
+
+            batch = jax.device_put(TokenBatch(
+                local_rows=jnp.asarray(rows.reshape(-1)),
+                acquire=jnp.asarray(acq.reshape(-1)),
+                prioritized=jnp.asarray(np.zeros((S * blp,), np.bool_)),
+                valid=jnp.asarray(valid.reshape(-1)),
+                is_param=jnp.asarray(is_param.reshape(-1)),
+                param_rows=jnp.asarray(prow.reshape(S * blp, PV)),
+                param_count=jnp.asarray(pcnt.reshape(S * blp, PV))),
+                self._sh_rows)
+
+            w = self.spec.window
+            now_idx = jnp.int32(w.index_of(now_ms))
+            in_win = jnp.int32(now_ms % w.win_ms)
+            self.state, verdicts = self._step(
+                self._table, self.state, batch,
+                jax.device_put(jnp.asarray(self._connected), self._sh_rep),
+                jax.device_put(jnp.asarray(self._ns_limit), self._sh_rep),
+                now_idx, in_win)
+
+        st = np.asarray(verdicts.status).reshape(S, blp)
+        wt = np.asarray(verdicts.wait_ms).reshape(S, blp)
+        rm = np.asarray(verdicts.remaining).reshape(S, blp)
+        for s in range(S):
+            for k, i in enumerate(per_shard[s]):
+                results[i] = (int(st[s, k]), int(wt[s, k]), int(rm[s, k]))
+        return [r or (STATUS_FAIL, 0, 0) for r in results]
 
     def _alloc_row(self) -> int:
         L = self.spec.flows_per_shard
@@ -466,11 +694,16 @@ class ClusterEngine:
                     prio[s, k] = bool(prioritized[i])
                     valid[s, k] = True
 
+            PV = self.spec.max_params
+            PK = self.spec.param_keys_per_shard
             batch = jax.device_put(TokenBatch(
                 local_rows=jnp.asarray(rows.reshape(-1)),
                 acquire=jnp.asarray(acq.reshape(-1)),
                 prioritized=jnp.asarray(prio.reshape(-1)),
-                valid=jnp.asarray(valid.reshape(-1))), self._sh_rows)
+                valid=jnp.asarray(valid.reshape(-1)),
+                is_param=jnp.asarray(np.zeros((S * blp,), np.bool_)),
+                param_rows=jnp.full((S * blp, PV), PK, jnp.int32),
+                param_count=jnp.zeros((S * blp, PV), jnp.float32)), self._sh_rows)
 
             w = self.spec.window
             now_idx = jnp.int32(w.index_of(now_ms))

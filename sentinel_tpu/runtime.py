@@ -39,6 +39,7 @@ from sentinel_tpu.core.errors import (
     BlockException, BlockReason, ErrorEntryFreeError, block_exception_for,
     is_block_exception,
 )
+from sentinel_tpu.core import errors as err_mod
 from sentinel_tpu.core.property import SentinelProperty
 from sentinel_tpu.core.registry import (
     ENTRY_NODE_ROW, OriginRegistry, Registry, ResourceRegistry,
@@ -355,7 +356,8 @@ class Sentinel:
 
     def entry(self, resource: str, *, origin: Optional[str] = None,
               acquire: int = 1, entry_type: int = ENTRY_TYPE_IN,
-              prioritized: bool = False, args: Sequence = ()) -> Entry:
+              prioritized: bool = False, args: Sequence = (),
+              resource_type: int = 0) -> Entry:
         """Guard a call. Raises a BlockException subclass when denied;
         sleeps (via the clock) on pass-with-wait verdicts. ``args`` are the
         call's parameters for hot-param rules (``SphU.entry(name, args)``)."""
@@ -368,6 +370,8 @@ class Sentinel:
         # resolve rows ONCE; the same rows feed the verdict and the Entry so
         # an LRU eviction between lookups can't skew exit accounting
         row = self.resources.get_or_create(resource)
+        if resource_type:   # ResourceTypeConstants classification for metrics
+            self.resource_types[resource] = resource_type
         origin_id = self.origins.get_or_create(use_origin) if use_origin else 0
         o_row, c_row = self._alt_rows_for(row, use_origin, ctx.name)
         context_id = (self.contexts.get_or_create(ctx.name)
@@ -387,8 +391,12 @@ class Sentinel:
                 param_rules=pr, param_keys=pk,
                 param_gen=pairs[2] if pairs is not None else -1)
             if not bool(verdict.allow[0]):
-                raise block_exception_for(int(verdict.reason[0]), resource,
+                exc = block_exception_for(int(verdict.reason[0]), resource,
                                           origin=use_origin)
+                # LogSlot: block events roll into sentinel-block.log
+                self.block_log.log(resource, type(exc).__name__,
+                                   origin=use_origin or "")
+                raise exc
         except BaseException:
             if pairs is not None:   # blocked entries never exit → unpin now
                 pairs[3].unpin_rows(pairs[4])
@@ -528,6 +536,16 @@ class Sentinel:
             if blocked.any():
                 registry.unpin_rows(pf_mod.thread_key_rows(
                     compiled, param_rules[blocked], param_keys[blocked]))
+        # LogSlot parity for the batch tier: blocked events roll into
+        # sentinel-block.log (same per-second dedup as the single path)
+        denied = np.nonzero(~np.asarray(verdicts.allow))[0]
+        if denied.size:
+            reasons = np.asarray(verdicts.reason)
+            for i in denied.tolist():
+                self.block_log.log(
+                    resources[i], err_mod.exception_name_for(int(reasons[i])),
+                    origin=(origins[i] if origins is not None
+                            and origins[i] else ""))
         return verdicts
 
     def _pad_pairs(self, arr: Optional[np.ndarray], b: int, fill: int):
@@ -649,6 +667,46 @@ class Sentinel:
     # ------------------------------------------------------------------
     # Introspection (command-surface backing)
     # ------------------------------------------------------------------
+
+    def metrics_snapshot(self, time_ms: int):
+        """Per-resource :class:`MetricNode` list for the completed second
+        containing ``time_ms`` (the ``MetricTimerListener`` pull: reference
+        aggregates every ClusterNode + ENTRY_NODE per whole second —
+        ``node/metric/MetricTimerListener.java:34-40``). Requires the minute
+        ring (per-second buckets); returns [] when it is disabled."""
+        from sentinel_tpu.metrics.node import MetricNode, TOTAL_IN_RESOURCE_NAME
+
+        if self.spec.minute is None:
+            return []
+        idx = jnp.int32(self.spec.minute.index_of(time_ms))
+        with self._lock:
+            counters, rt = _jit_bucket_snapshot(self.spec.minute)(
+                self._state.minute, idx)
+            counters = np.asarray(counters)
+            rt = np.asarray(rt)
+            threads = np.asarray(self._state.threads)
+            items = self.resources.items()
+            rtypes = dict(self.resource_types)
+        sec_ms = (time_ms // 1000) * 1000
+        nodes = []
+        for name, row in items:
+            c = counters[row]
+            if not (c[ev.PASS] or c[ev.BLOCK] or c[ev.SUCCESS]
+                    or c[ev.EXCEPTION]):
+                continue
+            succ = int(c[ev.SUCCESS])
+            nodes.append(MetricNode(
+                timestamp=sec_ms,
+                resource=(TOTAL_IN_RESOURCE_NAME if row == ENTRY_NODE_ROW
+                          else name),
+                pass_qps=int(c[ev.PASS]), block_qps=int(c[ev.BLOCK]),
+                success_qps=succ, exception_qps=int(c[ev.EXCEPTION]),
+                rt=int(rt[row] / succ) if succ else 0,
+                occupied_pass_qps=int(c[ev.OCCUPIED_PASS]),
+                concurrency=int(threads[row]),
+                classification=rtypes.get(name, 0)))
+        nodes.sort(key=lambda n: n.resource)
+        return nodes
 
     def node_totals(self, resource: str) -> dict:
         """Current rolling-second totals for a resource (ClusterNode view)."""

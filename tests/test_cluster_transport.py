@@ -1,0 +1,276 @@
+"""Wire codec round-trips, concurrent tokens, cluster param flow, and an
+in-process server⇄client integration (the reference covers codecs with unit
+tests and the socket path with demos only — SURVEY §4; we cover both)."""
+
+import threading
+import time
+
+import pytest
+
+from sentinel_tpu.cluster import codec
+from sentinel_tpu.cluster.client import ClusterTokenClient
+from sentinel_tpu.cluster.server import ClusterTokenServer
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.parallel.cluster import (
+    STATUS_ALREADY_RELEASE, STATUS_BLOCKED, STATUS_NO_RULE_EXISTS, STATUS_OK,
+    STATUS_RELEASE_OK, THRESHOLD_AVG_LOCAL, THRESHOLD_GLOBAL,
+    ClusterEngine, ClusterFlowRule, ClusterParamFlowRule, ClusterSpec,
+)
+from sentinel_tpu.parallel.concurrent import (
+    ConcurrentFlowRule, ConcurrentTokenManager,
+)
+
+NOW0 = 50_000_000
+
+
+# ----------------------------------------------------------------------
+# Codec
+# ----------------------------------------------------------------------
+
+def _rt_request(req):
+    frame = codec.encode_request(req)
+    frames = codec.FrameAssembler().feed(frame)
+    assert len(frames) == 1
+    return codec.decode_request(frames[0])
+
+
+def _rt_response(resp):
+    frame = codec.encode_response(resp)
+    frames = codec.FrameAssembler().feed(frame)
+    assert len(frames) == 1
+    return codec.decode_response(frames[0])
+
+
+def test_ping_roundtrip():
+    out = _rt_request(codec.Request(7, codec.MSG_TYPE_PING, "my-app"))
+    assert (out.xid, out.type, out.data) == (7, 0, "my-app")
+    r = _rt_response(codec.Response(7, codec.MSG_TYPE_PING, 0, 3))
+    assert (r.xid, r.status, r.data) == (7, 0, 3)
+
+
+def test_flow_roundtrip():
+    out = _rt_request(codec.Request(
+        99, codec.MSG_TYPE_FLOW, (12345678901234, 5, True)))
+    assert out.data == (12345678901234, 5, True)
+    r = _rt_response(codec.Response(99, codec.MSG_TYPE_FLOW, 0, (42, 17)))
+    assert r.data == (42, 17)
+
+
+def test_param_flow_roundtrip_all_tlv_types():
+    params = [3, 2 ** 40, 1.5, "hello-世界", True, False]
+    out = _rt_request(codec.Request(
+        1, codec.MSG_TYPE_PARAM_FLOW, (55, 2, params)))
+    flow_id, count, got = out.data
+    assert (flow_id, count) == (55, 2)
+    assert got == params
+
+
+def test_concurrent_roundtrip():
+    out = _rt_request(codec.Request(
+        3, codec.MSG_TYPE_CONCURRENT_FLOW_ACQUIRE, (77, 2, False)))
+    assert out.data == (77, 2, False)
+    r = _rt_response(codec.Response(
+        3, codec.MSG_TYPE_CONCURRENT_FLOW_ACQUIRE, 0, 987654321))
+    assert r.data == 987654321
+    rel = _rt_request(codec.Request(
+        4, codec.MSG_TYPE_CONCURRENT_FLOW_RELEASE, 987654321))
+    assert rel.data == 987654321
+
+
+def test_frame_assembler_handles_partial_and_coalesced():
+    f1 = codec.encode_request(codec.Request(1, codec.MSG_TYPE_PING, "a"))
+    f2 = codec.encode_request(codec.Request(2, codec.MSG_TYPE_PING, "b"))
+    asm = codec.FrameAssembler()
+    stream = f1 + f2
+    assert asm.feed(stream[:3]) == []
+    frames = asm.feed(stream[3:])
+    assert [codec.decode_request(f).xid for f in frames] == [1, 2]
+
+
+def test_frame_cap_rejected():
+    asm = codec.FrameAssembler()
+    with pytest.raises(ValueError):
+        asm.feed(b"\xff\xff" + b"x" * 10)
+
+
+# ----------------------------------------------------------------------
+# Concurrent tokens (ConcurrentClusterFlowChecker semantics)
+# ----------------------------------------------------------------------
+
+def test_concurrent_acquire_block_release():
+    mgr = ConcurrentTokenManager()
+    mgr.load_rules([ConcurrentFlowRule(flow_id=1, count=2)])
+    s1, t1 = mgr.acquire(1, 1, now_ms=NOW0)
+    s2, t2 = mgr.acquire(1, 1, now_ms=NOW0)
+    s3, t3 = mgr.acquire(1, 1, now_ms=NOW0)
+    assert (s1, s2, s3) == (STATUS_OK, STATUS_OK, STATUS_BLOCKED)
+    assert t1 != t2 and t3 == 0
+    assert mgr.now_calls(1) == 2
+    assert mgr.release(t1) == STATUS_RELEASE_OK
+    assert mgr.release(t1) == STATUS_ALREADY_RELEASE
+    s4, _ = mgr.acquire(1, 1, now_ms=NOW0)
+    assert s4 == STATUS_OK
+
+
+def test_concurrent_avg_local_scales():
+    mgr = ConcurrentTokenManager()
+    mgr.load_rules([ConcurrentFlowRule(
+        flow_id=9, count=2, threshold_type=THRESHOLD_AVG_LOCAL)])
+    mgr.set_connected_count(9, 3)
+    oks = [mgr.acquire(9, 1, now_ms=NOW0)[0] for _ in range(8)]
+    assert oks.count(STATUS_OK) == 6
+
+
+def test_concurrent_lease_expiry_reclaims():
+    mgr = ConcurrentTokenManager()
+    mgr.load_rules([ConcurrentFlowRule(
+        flow_id=5, count=1, resource_timeout_ms=500)])
+    s1, _ = mgr.acquire(5, 1, now_ms=NOW0)
+    assert s1 == STATUS_OK
+    assert mgr.acquire(5, 1, now_ms=NOW0)[0] == STATUS_BLOCKED
+    assert mgr.sweep_expired(now_ms=NOW0 + 400) == 0
+    assert mgr.sweep_expired(now_ms=NOW0 + 600) == 1
+    assert mgr.now_calls(5) == 0
+    assert mgr.acquire(5, 1, now_ms=NOW0 + 600)[0] == STATUS_OK
+
+
+def test_concurrent_unknown_flow_fails():
+    mgr = ConcurrentTokenManager()
+    assert mgr.acquire(404, 1, now_ms=NOW0)[0] < 0  # FAIL
+
+
+# ----------------------------------------------------------------------
+# Cluster param flow (ClusterParamFlowChecker semantics)
+# ----------------------------------------------------------------------
+
+def param_engine():
+    spec = ClusterSpec(n_shards=8, flows_per_shard=8, namespaces=4,
+                       param_keys_per_shard=64)
+    return ClusterEngine(spec)
+
+
+def test_param_flow_per_value_isolation():
+    eng = param_engine()
+    eng.load_param_rules("ns-p", [ClusterParamFlowRule(
+        flow_id=200, count=3, threshold_type=THRESHOLD_GLOBAL)])
+    res = eng.request_param_tokens(
+        [200] * 8, [1] * 8,
+        [["user-a"]] * 5 + [["user-b"]] * 3, now_ms=NOW0)
+    a = [s for s, _, _ in res[:5]]
+    b = [s for s, _, _ in res[5:]]
+    assert a.count(STATUS_OK) == 3 and a.count(STATUS_BLOCKED) == 2
+    assert b.count(STATUS_OK) == 3
+
+
+def test_param_flow_item_override():
+    eng = param_engine()
+    eng.load_param_rules("ns-p", [ClusterParamFlowRule(
+        flow_id=201, count=2, threshold_type=THRESHOLD_GLOBAL,
+        items={"vip": 10.0})])
+    res_vip = eng.request_param_tokens(
+        [201] * 6, [1] * 6, [["vip"]] * 6, now_ms=NOW0)
+    assert sum(1 for s, _, _ in res_vip if s == STATUS_OK) == 6
+    res_norm = eng.request_param_tokens(
+        [201] * 6, [1] * 6, [["pleb"]] * 6, now_ms=NOW0)
+    assert sum(1 for s, _, _ in res_norm if s == STATUS_OK) == 2
+
+
+def test_param_flow_multi_value_all_must_pass():
+    eng = param_engine()
+    eng.load_param_rules("ns-p", [ClusterParamFlowRule(
+        flow_id=202, count=1, threshold_type=THRESHOLD_GLOBAL)])
+    # exhaust value "hot"
+    r1 = eng.request_param_tokens([202], [1], [["hot"]], now_ms=NOW0)
+    assert r1[0][0] == STATUS_OK
+    # request carrying (cold, hot): hot is exhausted → whole request blocked
+    r2 = eng.request_param_tokens([202], [1], [["cold", "hot"]], now_ms=NOW0)
+    assert r2[0][0] == STATUS_BLOCKED
+    # cold alone must still be fresh (blocked request added no counts)
+    r3 = eng.request_param_tokens([202], [1], [["cold"]], now_ms=NOW0)
+    assert r3[0][0] == STATUS_OK
+
+
+def test_param_flow_empty_values_pass_and_unknown_rule():
+    eng = param_engine()
+    eng.load_param_rules("ns-p", [ClusterParamFlowRule(flow_id=203, count=1)])
+    assert eng.request_param_tokens([203], [1], [[]], now_ms=NOW0)[0][0] == STATUS_OK
+    assert eng.request_param_tokens([999], [1], [["x"]],
+                                    now_ms=NOW0)[0][0] == STATUS_NO_RULE_EXISTS
+
+
+def test_param_rules_and_flow_rules_coexist():
+    eng = param_engine()
+    eng.load_rules("ns-p", [ClusterFlowRule(
+        flow_id=300, count=5, threshold_type=THRESHOLD_GLOBAL)])
+    eng.load_param_rules("ns-p", [ClusterParamFlowRule(
+        flow_id=301, count=2, threshold_type=THRESHOLD_GLOBAL)])
+    # reloading flow rules must not evict the param rule
+    eng.load_rules("ns-p", [ClusterFlowRule(
+        flow_id=300, count=5, threshold_type=THRESHOLD_GLOBAL)])
+    res = eng.request_param_tokens([301] * 3, [1] * 3, [["k"]] * 3, now_ms=NOW0)
+    assert sum(1 for s, _, _ in res if s == STATUS_OK) == 2
+    res_f = eng.request_tokens([300] * 6, [1] * 6, now_ms=NOW0)
+    assert sum(1 for s, _, _ in res_f if s == STATUS_OK) == 5
+
+
+# ----------------------------------------------------------------------
+# Server ⇄ client over a real socket
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def served():
+    spec = ClusterSpec(n_shards=8, flows_per_shard=8, namespaces=4,
+                       param_keys_per_shard=64)
+    engine = ClusterEngine(spec)
+    engine.load_rules("it-ns", [ClusterFlowRule(
+        flow_id=401, count=4, threshold_type=THRESHOLD_GLOBAL)])
+    engine.load_param_rules("it-ns", [ClusterParamFlowRule(
+        flow_id=402, count=2, threshold_type=THRESHOLD_GLOBAL)])
+    clock = ManualClock(start_ms=NOW0)
+    server = ClusterTokenServer(engine, clock=clock, host="127.0.0.1", port=0,
+                                batch_window_ms=0.5)
+    server.load_concurrent_rules("it-ns", [ConcurrentFlowRule(
+        flow_id=403, count=1)])
+    server.start()
+    # generous timeout: first request jit-compiles the device step on CPU
+    client = ClusterTokenClient("127.0.0.1", server.port, namespace="it-ns",
+                                request_timeout_ms=60_000,
+                                auto_reconnect=False)
+    client.start()
+    yield server, client, clock
+    client.stop()
+    server.stop()
+
+
+def test_socket_ping_registers_namespace(served):
+    server, client, _ = served
+    assert client.ping() == 1
+    assert server.connection_count("it-ns") == 1
+
+
+def test_socket_flow_tokens(served):
+    _, client, _ = served
+    statuses = [client.request_token(401, 1).status for _ in range(6)]
+    assert statuses.count(STATUS_OK) == 4
+    assert statuses.count(STATUS_BLOCKED) == 2
+
+
+def test_socket_param_tokens(served):
+    _, client, _ = served
+    statuses = [client.request_param_token(402, 1, ["u1"]).status
+                for _ in range(4)]
+    assert statuses.count(STATUS_OK) == 2
+
+
+def test_socket_concurrent_tokens(served):
+    server, client, clock = served
+    r1 = client.acquire_concurrent_token(403, 1)
+    assert r1.status == STATUS_OK and r1.token_id > 0
+    assert client.acquire_concurrent_token(403, 1).status == STATUS_BLOCKED
+    assert client.release_concurrent_token(r1.token_id).status == STATUS_RELEASE_OK
+    assert client.release_concurrent_token(r1.token_id).status == STATUS_ALREADY_RELEASE
+
+
+def test_socket_unknown_flow(served):
+    _, client, _ = served
+    assert client.request_token(40999, 1).status == STATUS_NO_RULE_EXISTS
