@@ -1,0 +1,12 @@
+"""Dynamic rule datasources (reference ``sentinel-datasource-extension``):
+readable sources feed rule properties; writable sources persist dashboard
+pushes (SURVEY §2.2, L5)."""
+
+from sentinel_tpu.datasource.base import (  # noqa: F401
+    AbstractDataSource, AutoRefreshDataSource, FileRefreshableDataSource,
+    FileWritableDataSource, ReadableDataSource, WritableDataSource,
+)
+from sentinel_tpu.datasource.registry import (  # noqa: F401
+    WritableDataSourceRegistry, default_registry,
+)
+from sentinel_tpu.datasource.converters import rule_converter, rule_encoder  # noqa: F401

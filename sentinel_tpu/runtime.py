@@ -57,6 +57,7 @@ from sentinel_tpu.core.logs import BlockStatLogger
 from sentinel_tpu.stats import events as ev
 from sentinel_tpu.stats.window import (
     MINUTE_SPEC, SECOND_SPEC, WindowSpec, bucket_snapshot, rolling_totals,
+    rt_totals,
 )
 
 ENTRY_TYPE_OUT = 0
@@ -225,6 +226,7 @@ class Sentinel:
         self.param_flow_property: SentinelProperty = SentinelProperty()
         self.param_flow_property.add_listener(lambda rs: self.load_param_flow_rules(rs))
 
+        self._sys_rules: List[sys_mod.SystemRule] = []
         self._cpu = _CpuSampler(self.clock)
         self._global_on = True  # reference Constants.ON / setSwitch command
         # resource → ResourceTypeConstants classification (first writer wins)
@@ -320,6 +322,7 @@ class Sentinel:
 
     def load_system_rules(self, rules: Sequence[sys_mod.SystemRule]) -> None:
         with self._lock:
+            self._sys_rules = list(rules)
             self._sys = sys_mod.compile_system_rules(rules)
             self._ruleset = self._build_ruleset()
 
@@ -713,17 +716,104 @@ class Sentinel:
         row = self.resources.lookup(resource)
         if row is None:
             return {}
+        t = self.node_totals_by_row(row)
+        t.pop("avg_rt", None)
+        return t
+
+    def get_flow_rules(self) -> List[flow_mod.FlowRule]:
+        return list(self._flow.rules)
+
+    def get_degrade_rules(self) -> List[deg_mod.DegradeRule]:
+        return list(self._deg.rules)
+
+    def get_authority_rules(self) -> List[auth_mod.AuthorityRule]:
+        return list(self._auth.rules)
+
+    def get_system_rules(self) -> List[sys_mod.SystemRule]:
+        return list(self._sys_rules)
+
+    def get_param_flow_rules(self) -> List[pf_mod.ParamFlowRule]:
+        return list(self._user_param_rules)
+
+    def system_status(self) -> dict:
+        """Live ``systemStatus`` command payload (SystemStatusListener view)."""
+        load, cpu = self._cpu.sample()
+        entry = self.node_totals_by_row(ENTRY_NODE_ROW)
+        return {
+            "rqps": entry.get("pass", 0), "qps": entry.get("pass", 0),
+            "thread": entry.get("threads", 0), "rt": entry.get("avg_rt", 0),
+            "load": load, "cpuUsage": cpu,
+        }
+
+    def _totals_snapshot(self):
+        """One full-table device read → (counters[R,E], rt[R], threads[R])."""
         now = self.clock.now_ms()
         idx_s = jnp.int32(self.spec.second.index_of(now))
         with self._lock:
             tot = np.asarray(rolling_totals(self.spec.second,
-                                            self._state.second, idx_s)[row])
-            threads = int(np.asarray(self._state.threads[row]))
+                                            self._state.second, idx_s))
+            rt = (np.asarray(rt_totals(self.spec.second, self._state.second,
+                                       idx_s))
+                  if self.spec.second.track_rt
+                  else np.zeros(self.spec.rows, np.float32))
+            threads = np.asarray(self._state.threads)
+        return tot, rt, threads
+
+    @staticmethod
+    def _totals_dict(tot_row, rt_row: float, threads_row: int) -> dict:
+        succ = int(tot_row[ev.SUCCESS])
         return {
-            "pass": int(tot[ev.PASS]), "block": int(tot[ev.BLOCK]),
-            "success": int(tot[ev.SUCCESS]), "exception": int(tot[ev.EXCEPTION]),
-            "threads": threads,
+            "pass": int(tot_row[ev.PASS]), "block": int(tot_row[ev.BLOCK]),
+            "success": succ, "exception": int(tot_row[ev.EXCEPTION]),
+            "threads": int(threads_row),
+            "avg_rt": (float(rt_row) / succ) if succ else 0.0,
         }
+
+    def node_totals_by_row(self, row: int) -> dict:
+        tot, rt, threads = self._totals_snapshot()
+        return self._totals_dict(tot[row], rt[row], threads[row])
+
+    def all_node_totals(self) -> List[Tuple[str, int, dict]]:
+        """(name, row, totals) for every registered resource — ONE device
+        snapshot regardless of resource count (clusterNode/tree commands)."""
+        items = self.resources.items()
+        tot, rt, threads = self._totals_snapshot()
+        return [(name, row,
+                 self._totals_dict(tot[row], rt[row], threads[row]))
+                for name, row in items]
+
+    def origin_totals(self, resource: str) -> List[dict]:
+        """Per-origin rolling-second stats of one resource (the ``origin``
+        command — reference ClusterNode.getOriginCountMap view). Origins are
+        hashed rows in the alt table, so attribution is per (resource×origin)
+        hash cell; collisions merge rows (bounded inaccuracy by design)."""
+        row = self.resources.lookup(resource)
+        if row is None:
+            return []
+        now = self.clock.now_ms()
+        idx_s = jnp.int32(self.spec.second.index_of(now))
+        with self._lock:
+            touched = set(self._alt_rows_by_row.get(row, ()))
+            origins = self.origins.items()
+            tot = np.asarray(rolling_totals(self.spec.second,
+                                            self._state.alt_second, idx_s))
+            threads = np.asarray(self._state.alt_threads)
+        out = []
+        for name, oid in origins:
+            if not name:
+                continue
+            r = _alt_hash(row, 0, oid, self.spec.alt_rows)
+            if r not in touched:
+                continue
+            t = tot[r]
+            out.append({
+                "origin": name, "passQps": int(t[ev.PASS]),
+                "blockQps": int(t[ev.BLOCK]),
+                "successQps": int(t[ev.SUCCESS]),
+                "exceptionQps": int(t[ev.EXCEPTION]),
+                "threadNum": int(threads[r]),
+            })
+        return out
 
     def breaker_states(self) -> List[int]:
         with self._lock:

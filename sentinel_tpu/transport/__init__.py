@@ -1,0 +1,9 @@
+"""Command plane: HTTP command center + heartbeat (reference
+``sentinel-transport/*`` rebuilt on the stdlib http server)."""
+
+from sentinel_tpu.transport.command import (  # noqa: F401
+    CommandCenter, CommandRequest, CommandResponse, command_mapping,
+)
+from sentinel_tpu.transport.handlers import register_default_handlers  # noqa: F401
+from sentinel_tpu.transport.http_server import SimpleHttpCommandCenter  # noqa: F401
+from sentinel_tpu.transport.heartbeat import HeartbeatSender  # noqa: F401

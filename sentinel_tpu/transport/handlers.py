@@ -1,0 +1,247 @@
+"""The built-in command handlers (reference
+``sentinel-transport-common/.../command/handler/*.java`` — the 18 commands
+the dashboard drives agents with, SURVEY §2.4).
+
+Each handler closes over a :class:`~sentinel_tpu.runtime.Sentinel` instance
+(plus optional metric searcher / cluster hooks) and is registered into a
+:class:`~sentinel_tpu.transport.command.CommandCenter`.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, Optional
+
+from sentinel_tpu import __version__
+from sentinel_tpu.core.registry import ENTRY_NODE_ROW
+from sentinel_tpu.metrics.node import TOTAL_IN_RESOURCE_NAME
+from sentinel_tpu.metrics.searcher import MetricSearcher
+from sentinel_tpu.rules import codec
+from sentinel_tpu.transport.command import (
+    CommandCenter, CommandRequest, CommandResponse,
+)
+
+# ClusterStateManager.java: CLUSTER_NOT_STARTED=-1, CLIENT=0, SERVER=1
+CLUSTER_NOT_STARTED = -1
+CLUSTER_CLIENT = 0
+CLUSTER_SERVER = 1
+
+_MAX_METRIC_LINES = 12000  # SendMetricCommandHandler maxLines/FETCH cap
+
+
+class ClusterModeState:
+    """Per-process cluster mode cell (``ClusterStateManager`` analog).
+
+    ``on_change(mode)`` hooks let the embedding app start/stop its token
+    client/server when the dashboard flips the mode.
+    """
+
+    def __init__(self) -> None:
+        self.mode = CLUSTER_NOT_STARTED
+        self.last_modified_ms = 0
+        self._observers: list = []
+
+    def add_observer(self, fn: Callable[[int], None]) -> None:
+        self._observers.append(fn)
+
+    def set_mode(self, mode: int, now_ms: int = 0) -> None:
+        self.mode = mode
+        self.last_modified_ms = now_ms
+        for fn in list(self._observers):
+            fn(mode)
+
+
+def register_default_handlers(
+    center: CommandCenter,
+    sentinel,
+    *,
+    metric_searcher: Optional[MetricSearcher] = None,
+    cluster_state: Optional[ClusterModeState] = None,
+    extra_info: Optional[Dict[str, Any]] = None,
+    writable_registry=None,
+) -> ClusterModeState:
+    """Bind the full default command surface for one Sentinel instance."""
+    from sentinel_tpu.datasource.registry import default_registry
+
+    s = sentinel
+    cstate = cluster_state or ClusterModeState()
+    wreg = writable_registry if writable_registry is not None else default_registry
+
+    # ---- meta ------------------------------------------------------------
+
+    def cmd_version(req: CommandRequest) -> CommandResponse:
+        return CommandResponse.of_success(__version__)
+
+    def cmd_api(req: CommandRequest) -> CommandResponse:
+        return CommandResponse.of_success(json.dumps(
+            [{"url": f"/{name}", "desc": desc}
+             for name, desc in sorted(center.names().items())]))
+
+    def cmd_basic_info(req: CommandRequest) -> CommandResponse:
+        info = {
+            "appName": s.cfg.app_name, "appType": s.cfg.app_type,
+            "version": __version__, "apiPort": s.cfg.api_port,
+            "maxResources": s.cfg.max_resources,
+        }
+        info.update(extra_info or {})
+        return CommandResponse.of_success(json.dumps(info))
+
+    # ---- rules -----------------------------------------------------------
+
+    _GET = {"flow": s.get_flow_rules, "degrade": s.get_degrade_rules,
+            "system": s.get_system_rules, "authority": s.get_authority_rules,
+            "paramFlow": s.get_param_flow_rules}
+    _LOAD = {"flow": s.load_flow_rules, "degrade": s.load_degrade_rules,
+             "system": s.load_system_rules,
+             "authority": s.load_authority_rules,
+             "paramFlow": s.load_param_flow_rules}
+
+    def cmd_get_rules(req: CommandRequest) -> CommandResponse:
+        rtype = req.param("type")
+        getter = _GET.get(rtype)
+        if getter is None:
+            return CommandResponse.of_failure("invalid type", 400)
+        return CommandResponse.of_success(codec.rules_to_json(rtype, getter()))
+
+    def cmd_set_rules(req: CommandRequest) -> CommandResponse:
+        rtype = req.param("type")
+        loader = _LOAD.get(rtype)
+        if loader is None:
+            return CommandResponse.of_failure("invalid type", 400)
+        data = req.param("data")
+        if not data and req.body:
+            data = req.body.decode("utf-8")
+        try:
+            rules = codec.rules_from_json(rtype, data or "[]")
+        except (ValueError, KeyError, TypeError) as exc:
+            return CommandResponse.of_failure(f"decode rules error: {exc}", 400)
+        loader(rules)
+        # ModifyRulesCommandHandler persists through the registered writable
+        # datasource after a successful in-memory load
+        wreg.write_if_registered(rtype, rules)
+        return CommandResponse.of_success("success")
+
+    # ---- switch ----------------------------------------------------------
+
+    def cmd_get_switch(req: CommandRequest) -> CommandResponse:
+        return CommandResponse.of_success(
+            f"Sentinel switch value: {'true' if s._global_on else 'false'}")
+
+    def cmd_set_switch(req: CommandRequest) -> CommandResponse:
+        value = req.param("value").lower()
+        if value not in ("true", "false"):
+            return CommandResponse.of_failure("invalid parameter", 400)
+        s.set_global_switch(value == "true")
+        return CommandResponse.of_success("success")
+
+    # ---- metrics ---------------------------------------------------------
+
+    def cmd_metric(req: CommandRequest) -> CommandResponse:
+        if metric_searcher is None:
+            return CommandResponse.of_success("")
+        try:
+            begin = int(req.param("startTime", "0") or 0)
+        except ValueError:
+            begin = 0
+        end_raw = req.param("endTime", "")
+        end = int(end_raw) if end_raw.isdigit() else None
+        identity = req.param("identity", "")
+        nodes = metric_searcher.find(begin, end, identity=identity or None,
+                                     max_lines=_MAX_METRIC_LINES)
+        if not identity:
+            # SendMetricCommandHandler hides the global inbound node unless
+            # asked for by name
+            nodes = [n for n in nodes if n.resource != TOTAL_IN_RESOURCE_NAME]
+        return CommandResponse.of_success(
+            "".join(n.to_thin_string() + "\n" for n in nodes))
+
+    # ---- node tree -------------------------------------------------------
+
+    def _node_dicts():
+        out = []
+        for name, row, t in s.all_node_totals():
+            if not (t["pass"] or t["block"] or t["success"] or t["threads"]):
+                continue
+            out.append({
+                "id": row,
+                "resource": TOTAL_IN_RESOURCE_NAME if row == ENTRY_NODE_ROW
+                else name,
+                "threadNum": t["threads"], "passQps": t["pass"],
+                "blockQps": t["block"], "totalQps": t["pass"] + t["block"],
+                "successQps": t["success"], "exceptionQps": t["exception"],
+                "averageRt": round(t["avg_rt"], 2),
+            })
+        return out
+
+    def cmd_cluster_node(req: CommandRequest) -> CommandResponse:
+        return CommandResponse.of_success(json.dumps(_node_dicts()))
+
+    def cmd_cluster_node_by_id(req: CommandRequest) -> CommandResponse:
+        rid = req.param("id")
+        nodes = [n for n in _node_dicts() if n["resource"] == rid]
+        return CommandResponse.of_success(json.dumps(nodes))
+
+    def cmd_origin(req: CommandRequest) -> CommandResponse:
+        rid = req.param("id")
+        if not rid:
+            return CommandResponse.of_failure("invalid parameter: id", 400)
+        return CommandResponse.of_success(json.dumps(s.origin_totals(rid)))
+
+    def cmd_tree(req: CommandRequest) -> CommandResponse:
+        lines = ["EntranceNode: machine-root"]
+        for n in _node_dicts():
+            lines.append(
+                f"-{n['resource']}({n['threadNum']}/{n['totalQps']}/"
+                f"{n['passQps']}/{n['blockQps']}/{n['successQps']}/"
+                f"{n['averageRt']})")
+        return CommandResponse.of_success("\n".join(lines) + "\n")
+
+    def cmd_json_tree(req: CommandRequest) -> CommandResponse:
+        return CommandResponse.of_success(json.dumps(_node_dicts()))
+
+    # ---- system ----------------------------------------------------------
+
+    def cmd_system_status(req: CommandRequest) -> CommandResponse:
+        return CommandResponse.of_success(json.dumps(s.system_status()))
+
+    # ---- cluster mode ----------------------------------------------------
+
+    def cmd_get_cluster_mode(req: CommandRequest) -> CommandResponse:
+        return CommandResponse.of_success(json.dumps({
+            "mode": cstate.mode,
+            "lastModified": cstate.last_modified_ms,
+            "clientAvailable": True, "serverAvailable": True,
+        }))
+
+    def cmd_set_cluster_mode(req: CommandRequest) -> CommandResponse:
+        try:
+            mode = int(req.param("mode"))
+        except ValueError:
+            return CommandResponse.of_failure("invalid mode", 400)
+        if mode not in (CLUSTER_NOT_STARTED, CLUSTER_CLIENT, CLUSTER_SERVER):
+            return CommandResponse.of_failure("invalid mode", 400)
+        cstate.set_mode(mode, s.clock.now_ms())
+        return CommandResponse.of_success("success")
+
+    for name, desc, fn in [
+        ("version", "get sentinel version", cmd_version),
+        ("api", "list available commands", cmd_api),
+        ("basicInfo", "get app basic info", cmd_basic_info),
+        ("getRules", "get rules by type", cmd_get_rules),
+        ("setRules", "load rules by type", cmd_set_rules),
+        ("getSwitch", "get global switch", cmd_get_switch),
+        ("setSwitch", "set global switch", cmd_set_switch),
+        ("metric", "search metric logs", cmd_metric),
+        ("clusterNode", "all resource nodes", cmd_cluster_node),
+        ("clusterNodeById", "resource node by name", cmd_cluster_node_by_id),
+        ("cnode", "resource node by name", cmd_cluster_node_by_id),
+        ("origin", "per-origin stats of a resource", cmd_origin),
+        ("tree", "node tree (text)", cmd_tree),
+        ("jsonTree", "node tree (json)", cmd_json_tree),
+        ("systemStatus", "system adaptive status", cmd_system_status),
+        ("getClusterMode", "get cluster mode", cmd_get_cluster_mode),
+        ("setClusterMode", "set cluster mode", cmd_set_cluster_mode),
+    ]:
+        center.register(fn, name, desc)
+
+    return cstate

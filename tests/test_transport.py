@@ -1,0 +1,290 @@
+"""Transport/command plane + datasource layer.
+
+Command surface parity targets (SURVEY §2.4): the 18 built-in handlers over
+an HTTP command center with port auto-increment, heartbeat message shape,
+setRules→load→writable-datasource persistence, and file datasources driving
+rule properties (SURVEY §2.2 / §3.5 convergence paths).
+"""
+
+import json
+import os
+import urllib.parse
+import urllib.request
+
+import pytest
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.datasource import (
+    FileRefreshableDataSource, FileWritableDataSource,
+    default_registry, rule_converter, rule_encoder,
+)
+from sentinel_tpu.rules import codec
+from sentinel_tpu.rules.flow import FlowRule
+from sentinel_tpu.rules.degrade import DegradeRule, GRADE_EXCEPTION_RATIO
+from sentinel_tpu.rules.param_flow import ParamFlowItem, ParamFlowRule
+from sentinel_tpu.rules.system import SystemRule
+from sentinel_tpu.rules.authority import AuthorityRule
+from sentinel_tpu.transport import (
+    CommandCenter, CommandRequest, SimpleHttpCommandCenter,
+    HeartbeatSender, register_default_handlers,
+)
+
+T0 = 1_785_000_000_000
+
+
+@pytest.fixture
+def clk():
+    return ManualClock(start_ms=T0)
+
+
+@pytest.fixture
+def sentinel(clk):
+    cfg = stpu.load_config(max_resources=64, max_flow_rules=16,
+                           max_degrade_rules=16, max_authority_rules=16)
+    return stpu.Sentinel(config=cfg, clock=clk)
+
+
+@pytest.fixture
+def center(sentinel):
+    c = CommandCenter()
+    register_default_handlers(c, sentinel)
+    return c
+
+
+def _ok(resp):
+    assert resp.success, resp.result
+    return resp.result
+
+
+# ---------------------------------------------------------------- codecs
+
+
+def test_rule_codec_roundtrip_all_types():
+    cases = {
+        "flow": [FlowRule(resource="a", count=10, control_behavior=1,
+                          warm_up_period_sec=5, limit_app="app1",
+                          cluster_mode=True, cluster_flow_id=7,
+                          cluster_threshold_type=1)],
+        "degrade": [DegradeRule(resource="a", grade=GRADE_EXCEPTION_RATIO,
+                                count=0.5, time_window=10,
+                                min_request_amount=3)],
+        "system": [SystemRule(qps=100.0, highest_cpu_usage=0.8)],
+        "authority": [AuthorityRule(resource="a", limit_app="x,y",
+                                    strategy=1)],
+        "paramFlow": [ParamFlowRule(
+            resource="a", param_idx=1, count=5.0,
+            param_flow_item_list=[ParamFlowItem(object=9, count=100,
+                                                class_type="int")])],
+    }
+    for rtype, rules in cases.items():
+        text = codec.rules_to_json(rtype, rules)
+        back = codec.rules_from_json(rtype, text)
+        assert back == rules, rtype
+
+
+def test_param_item_object_type_recovery():
+    r = ParamFlowRule(resource="a", count=1.0, param_flow_item_list=[
+        ParamFlowItem(object=5, count=10, class_type="int"),
+        ParamFlowItem(object=True, count=20),
+        ParamFlowItem(object=2.5, count=30)])
+    back = codec.rules_from_json("paramFlow",
+                                 codec.rules_to_json("paramFlow", [r]))
+    items = back[0].param_flow_item_list
+    assert items[0].object == 5 and isinstance(items[0].object, int)
+    assert items[1].object is True          # Python type name survives
+    assert items[2].object == 2.5
+
+
+# ---------------------------------------------------------------- commands
+
+
+def test_version_api_basic_info(center, sentinel):
+    assert _ok(center.handle("version", CommandRequest()))
+    cmds = json.loads(_ok(center.handle("api", CommandRequest())))
+    names = {c["url"] for c in cmds}
+    for want in ("/getRules", "/setRules", "/metric", "/clusterNode",
+                 "/systemStatus", "/setClusterMode", "/tree", "/origin"):
+        assert want in names
+    info = json.loads(_ok(center.handle("basicInfo", CommandRequest())))
+    assert info["appName"] == sentinel.cfg.app_name
+
+
+def test_get_set_rules_roundtrip(center, sentinel):
+    rules = [FlowRule(resource="svc", count=5.0)]
+    resp = center.handle("setRules", CommandRequest(parameters={
+        "type": "flow", "data": codec.rules_to_json("flow", rules)}))
+    _ok(resp)
+    assert sentinel.get_flow_rules() == rules
+    got = codec.rules_from_json(
+        "flow", _ok(center.handle("getRules",
+                                  CommandRequest(parameters={"type": "flow"}))))
+    assert got == rules
+    # and the rules actually enforce
+    for _ in range(5):
+        with sentinel.entry("svc"):
+            pass
+    with pytest.raises(stpu.BlockException):
+        with sentinel.entry("svc"):
+            pass
+
+
+def test_set_rules_bad_payloads(center):
+    assert not center.handle("setRules", CommandRequest(
+        parameters={"type": "nope", "data": "[]"})).success
+    assert not center.handle("setRules", CommandRequest(
+        parameters={"type": "flow", "data": "{not json"})).success
+
+
+def test_switch_command_gates_checks(center, sentinel):
+    sentinel.load_flow_rules([FlowRule(resource="sw", count=0.0)])
+    with pytest.raises(stpu.BlockException):
+        with sentinel.entry("sw"):
+            pass
+    _ok(center.handle("setSwitch",
+                      CommandRequest(parameters={"value": "false"})))
+    with sentinel.entry("sw"):   # switch off → everything passes
+        pass
+    assert "false" in _ok(center.handle("getSwitch", CommandRequest()))
+    _ok(center.handle("setSwitch",
+                      CommandRequest(parameters={"value": "true"})))
+
+
+def test_node_tree_and_origin_commands(center, sentinel):
+    with sentinel.entry("api-a", origin="caller-1"):
+        pass
+    with sentinel.entry("api-a", origin="caller-2"):
+        pass
+    nodes = json.loads(_ok(center.handle("clusterNode", CommandRequest())))
+    by_name = {n["resource"]: n for n in nodes}
+    assert by_name["api-a"]["passQps"] == 2
+    one = json.loads(_ok(center.handle(
+        "cnode", CommandRequest(parameters={"id": "api-a"}))))
+    assert one and one[0]["passQps"] == 2
+    origins = json.loads(_ok(center.handle(
+        "origin", CommandRequest(parameters={"id": "api-a"}))))
+    assert {o["origin"] for o in origins} == {"caller-1", "caller-2"}
+    tree = _ok(center.handle("tree", CommandRequest()))
+    assert "api-a" in tree and tree.startswith("EntranceNode")
+
+
+def test_system_status_and_cluster_mode(center, sentinel):
+    st = json.loads(_ok(center.handle("systemStatus", CommandRequest())))
+    assert "load" in st and "cpuUsage" in st
+    mode = json.loads(_ok(center.handle("getClusterMode", CommandRequest())))
+    assert mode["mode"] == -1
+    _ok(center.handle("setClusterMode",
+                      CommandRequest(parameters={"mode": "0"})))
+    mode = json.loads(_ok(center.handle("getClusterMode", CommandRequest())))
+    assert mode["mode"] == 0
+
+
+def test_unknown_command_404(center):
+    resp = center.handle("nope", CommandRequest())
+    assert not resp.success and resp.code == 404
+
+
+# ---------------------------------------------------------------- HTTP
+
+
+def test_http_server_end_to_end(center):
+    srv = SimpleHttpCommandCenter(center, host="127.0.0.1", port=18719)
+    port = srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/version", timeout=3) as r:
+            assert r.status == 200 and r.read()
+        # POST form-encoded setRules like the dashboard does
+        data = urllib.parse.urlencode({
+            "type": "flow",
+            "data": codec.rules_to_json(
+                "flow", [FlowRule(resource="http-svc", count=3.0)]),
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/setRules", data=data,
+            headers={"Content-Type": "application/x-www-form-urlencoded"})
+        with urllib.request.urlopen(req, timeout=3) as r:
+            assert r.read() == b"success"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/getRules?type=flow", timeout=3) as r:
+            assert json.loads(r.read())[0]["resource"] == "http-svc"
+        # unknown command → 404
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/nope", timeout=3)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv.stop()
+
+
+def test_http_port_auto_increment(center):
+    a = SimpleHttpCommandCenter(center, host="127.0.0.1", port=18725)
+    b = SimpleHttpCommandCenter(center, host="127.0.0.1", port=18725)
+    pa = a.start()
+    try:
+        pb = b.start()
+        assert pb == pa + 1
+        b.stop()
+    finally:
+        a.stop()
+
+
+# ---------------------------------------------------------------- heartbeat
+
+
+def test_heartbeat_message_shape(clk):
+    hb = HeartbeatSender("127.0.0.1:9", app_name="my-app", api_port=8719,
+                         clock=clk)
+    msg = hb.message()
+    for key in ("hostname", "ip", "port", "app", "v", "version"):
+        assert key in msg
+    assert msg["app"] == "my-app" and msg["port"] == "8719"
+    assert not hb.send_once(timeout=0.2)   # nothing listening → False, no raise
+
+
+# ---------------------------------------------------------------- datasource
+
+
+def test_file_refreshable_datasource_drives_rules(tmp_path, sentinel):
+    path = tmp_path / "flow.json"
+    path.write_text(codec.rules_to_json(
+        "flow", [FlowRule(resource="ds-svc", count=9.0)]))
+    ds = FileRefreshableDataSource(str(path), rule_converter("flow"),
+                                   start_thread=False)
+    ds.get_property().add_listener(sentinel.load_flow_rules)
+    # registration replays current value in the reference property contract
+    sentinel.load_flow_rules(ds.load_config())
+    assert sentinel.get_flow_rules()[0].resource == "ds-svc"
+    # file change → refresh picks it up (mtime must differ)
+    path.write_text(codec.rules_to_json(
+        "flow", [FlowRule(resource="ds-svc", count=2.0)]))
+    os.utime(path, (os.path.getmtime(path) + 5,) * 2)
+    assert ds.refresh_now()
+    assert sentinel.get_flow_rules()[0].count == 2.0
+    # unchanged file → no reload
+    assert not ds.refresh_now()
+    ds.close()
+
+
+def test_writable_datasource_persists_set_rules(tmp_path, center, sentinel):
+    out = tmp_path / "persisted.json"
+    default_registry.register(
+        "flow", FileWritableDataSource(str(out), rule_encoder("flow")))
+    try:
+        _ok(center.handle("setRules", CommandRequest(parameters={
+            "type": "flow",
+            "data": codec.rules_to_json(
+                "flow", [FlowRule(resource="persist-me", count=1.0)])})))
+        stored = codec.rules_from_json("flow", out.read_text())
+        assert stored[0].resource == "persist-me"
+    finally:
+        default_registry.clear()
+
+
+def test_missing_file_datasource_returns_empty(tmp_path):
+    ds = FileRefreshableDataSource(str(tmp_path / "absent.json"),
+                                   rule_converter("degrade"),
+                                   start_thread=False)
+    assert ds.load_config() == []
+    ds.close()
