@@ -1,0 +1,99 @@
+"""HTTP client to agent command planes (reference
+``sentinel-dashboard/.../client/SentinelApiClient.java:397-593``).
+
+Every operation maps to one agent command (SURVEY §2.4): ``getRules`` /
+``setRules`` per type, ``metric`` with a time range, ``clusterNode`` /
+``jsonTree`` for live node views, ``getClusterMode`` / ``setClusterMode``,
+``version`` and ``systemStatus``.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from sentinel_tpu.metrics.node import MetricNode
+
+DEFAULT_TIMEOUT_S = 3.0
+
+
+class AgentUnreachable(Exception):
+    pass
+
+
+class SentinelApiClient:
+    def __init__(self, timeout_s: float = DEFAULT_TIMEOUT_S):
+        self.timeout_s = timeout_s
+
+    # ------------------------------------------------------------- plumbing
+    def _get(self, ip: str, port: int, command: str,
+             params: Optional[Dict[str, str]] = None) -> str:
+        qs = ("?" + urllib.parse.urlencode(params)) if params else ""
+        url = f"http://{ip}:{port}/{command}{qs}"
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout_s) as r:
+                return r.read().decode("utf-8")
+        except (urllib.error.URLError, OSError) as exc:
+            raise AgentUnreachable(f"{url}: {exc}") from exc
+
+    def _post(self, ip: str, port: int, command: str,
+              params: Dict[str, str]) -> str:
+        url = f"http://{ip}:{port}/{command}"
+        data = urllib.parse.urlencode(params).encode("utf-8")
+        try:
+            with urllib.request.urlopen(url, data=data,
+                                        timeout=self.timeout_s) as r:
+                return r.read().decode("utf-8")
+        except (urllib.error.URLError, OSError) as exc:
+            raise AgentUnreachable(f"{url}: {exc}") from exc
+
+    # ------------------------------------------------------------- commands
+    def version(self, ip: str, port: int) -> str:
+        return self._get(ip, port, "version").strip()
+
+    def fetch_rules(self, ip: str, port: int,
+                    rule_type: str) -> List[Dict[str, Any]]:
+        text = self._get(ip, port, "getRules", {"type": rule_type})
+        return json.loads(text or "[]")
+
+    def set_rules(self, ip: str, port: int, rule_type: str,
+                  rules: List[Dict[str, Any]]) -> bool:
+        resp = self._post(ip, port, "setRules", {
+            "type": rule_type, "data": json.dumps(rules)})
+        return "success" in resp
+
+    def fetch_metrics(self, ip: str, port: int, start_ms: int,
+                      end_ms: int) -> List[MetricNode]:
+        text = self._get(ip, port, "metric", {
+            "startTime": str(start_ms), "endTime": str(end_ms)})
+        nodes = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line or line == "No metrics":
+                continue
+            try:
+                # agents serve the thin line format (SendMetricCommandHandler
+                # returns MetricNode.toThinString)
+                nodes.append(MetricNode.from_thin_string(line))
+            except (ValueError, IndexError):
+                continue
+        return nodes
+
+    def fetch_cluster_nodes(self, ip: str, port: int) -> List[Dict[str, Any]]:
+        return json.loads(self._get(ip, port, "clusterNode") or "[]")
+
+    def fetch_json_tree(self, ip: str, port: int) -> List[Dict[str, Any]]:
+        return json.loads(self._get(ip, port, "jsonTree") or "[]")
+
+    def fetch_system_status(self, ip: str, port: int) -> Dict[str, Any]:
+        return json.loads(self._get(ip, port, "systemStatus") or "{}")
+
+    def get_cluster_mode(self, ip: str, port: int) -> Dict[str, Any]:
+        return json.loads(self._get(ip, port, "getClusterMode") or "{}")
+
+    def set_cluster_mode(self, ip: str, port: int, mode: int) -> bool:
+        resp = self._post(ip, port, "setClusterMode", {"mode": str(mode)})
+        return "success" in resp

@@ -7,3 +7,6 @@ from sentinel_tpu.transport.command import (  # noqa: F401
 from sentinel_tpu.transport.handlers import register_default_handlers  # noqa: F401
 from sentinel_tpu.transport.http_server import SimpleHttpCommandCenter  # noqa: F401
 from sentinel_tpu.transport.heartbeat import HeartbeatSender  # noqa: F401
+from sentinel_tpu.transport.bootstrap import (  # noqa: F401
+    TransportRuntime, start_transport,
+)

@@ -1,0 +1,64 @@
+"""One-call agent transport bootstrap.
+
+The reference binds its command server first and then stores the *actual*
+port back into ``TransportConfig`` so heartbeats advertise the right address
+after port auto-increment (``SimpleHttpCommandCenter.java:48-80`` +
+``TransportConfig.setRuntimePort``). This helper reproduces that ordering:
+start command center → learn bound port → advertise it in both the
+heartbeat message and the ``basicInfo`` command.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from sentinel_tpu.transport.command import CommandCenter
+from sentinel_tpu.transport.handlers import (
+    ClusterModeState, register_default_handlers,
+)
+from sentinel_tpu.transport.heartbeat import HeartbeatSender
+from sentinel_tpu.transport.http_server import SimpleHttpCommandCenter
+
+
+@dataclasses.dataclass
+class TransportRuntime:
+    center: CommandCenter
+    http: SimpleHttpCommandCenter
+    heartbeat: Optional[HeartbeatSender]
+    cluster_state: ClusterModeState
+    port: int
+
+    def stop(self) -> None:
+        if self.heartbeat is not None:
+            self.heartbeat.stop()
+        self.http.stop()
+
+
+def start_transport(sentinel, *, host: str = "0.0.0.0", port: int = 8719,
+                    dashboard_addr: Optional[str] = None,
+                    metric_searcher=None, writable_registry=None,
+                    heartbeat_interval_ms: int = 10_000,
+                    clock=None) -> TransportRuntime:
+    """Start the HTTP command center (with port auto-increment) and, when a
+    dashboard address is given, a heartbeat loop advertising the port that
+    was actually bound."""
+    center = CommandCenter()
+    extra: dict = {}
+    cstate = register_default_handlers(
+        center, sentinel, metric_searcher=metric_searcher,
+        extra_info=extra, writable_registry=writable_registry)
+    http = SimpleHttpCommandCenter(center, host=host, port=port)
+    bound = http.start()
+    extra["apiPort"] = bound          # basicInfo reflects the bound port
+
+    hb = None
+    if dashboard_addr:
+        hb = HeartbeatSender(
+            dashboard_addr, app_name=sentinel.cfg.app_name,
+            app_type=sentinel.cfg.app_type, api_port=bound,
+            interval_ms=heartbeat_interval_ms,
+            clock=clock if clock is not None else sentinel.clock)
+        hb.start()
+    return TransportRuntime(center=center, http=http, heartbeat=hb,
+                            cluster_state=cstate, port=bound)

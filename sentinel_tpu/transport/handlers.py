@@ -13,6 +13,7 @@ import json
 from typing import Any, Callable, Dict, Optional
 
 from sentinel_tpu import __version__
+from sentinel_tpu.core.logs import record_log
 from sentinel_tpu.core.registry import ENTRY_NODE_ROW
 from sentinel_tpu.metrics.node import TOTAL_IN_RESOURCE_NAME
 from sentinel_tpu.metrics.searcher import MetricSearcher
@@ -108,17 +109,21 @@ def register_default_handlers(
         loader = _LOAD.get(rtype)
         if loader is None:
             return CommandResponse.of_failure("invalid type", 400)
-        data = req.param("data")
-        if not data and req.body:
-            data = req.body.decode("utf-8")
         try:
+            data = req.param("data")
+            if not data and req.body:
+                data = req.body.decode("utf-8")   # UnicodeDecodeError ⊂ ValueError
             rules = codec.rules_from_json(rtype, data or "[]")
         except (ValueError, KeyError, TypeError) as exc:
             return CommandResponse.of_failure(f"decode rules error: {exc}", 400)
         loader(rules)
         # ModifyRulesCommandHandler persists through the registered writable
-        # datasource after a successful in-memory load
-        wreg.write_if_registered(rtype, rules)
+        # datasource after a successful in-memory load; a failed write does
+        # not undo the live rules, so still report success
+        try:
+            wreg.write_if_registered(rtype, rules)
+        except OSError as exc:
+            record_log().warning("setRules: datasource write failed: %s", exc)
         return CommandResponse.of_success("success")
 
     # ---- switch ----------------------------------------------------------

@@ -33,7 +33,18 @@ class _Handler(BaseHTTPRequestHandler):
                   urllib.parse.parse_qs(parsed.query).items()}
         ctype = self.headers.get("Content-Type", "")
         if body and "application/x-www-form-urlencoded" in ctype:
-            for k, v in urllib.parse.parse_qs(body.decode("utf-8")).items():
+            try:
+                decoded = body.decode("utf-8")
+            except UnicodeDecodeError:
+                resp = CommandResponse.of_failure("invalid request body", 400)
+                payload = resp.result.encode("utf-8")
+                self.send_response(400)
+                self.send_header("Content-Type", "text/plain; charset=utf-8")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+                return
+            for k, v in urllib.parse.parse_qs(decoded).items():
                 params[k] = v[-1]
         if not name:
             resp = CommandResponse.of_failure(

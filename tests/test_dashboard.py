@@ -1,0 +1,250 @@
+"""Dashboard control plane end-to-end: heartbeat discovery, metric fetch →
+in-memory ring → query API, rule CRUD writing through to a live agent, auth.
+
+Reference flows (SURVEY §2.5, §3.4, §3.5): agent heartbeat →
+``MachineRegistryController`` → ``AppManagement``; ``MetricFetcher`` 6s poll
+→ ``InMemoryMetricsRepository``; dashboard controller →
+``SentinelApiClient.setRules`` → agent ``ModifyRulesCommandHandler``.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.dashboard import (
+    Dashboard, DashboardServer, MetricEntity, SentinelApiClient,
+)
+from sentinel_tpu.dashboard.repository import InMemoryMetricsRepository
+from sentinel_tpu.metrics.searcher import MetricSearcher
+from sentinel_tpu.metrics.timer import MetricTimerListener
+from sentinel_tpu.metrics.writer import MetricWriter, form_metric_file_name
+from sentinel_tpu.transport import (
+    CommandCenter, HeartbeatSender, SimpleHttpCommandCenter,
+    register_default_handlers,
+)
+
+T0 = 1_785_000_000_000
+
+
+@pytest.fixture
+def clk():
+    return ManualClock(start_ms=T0)
+
+
+@pytest.fixture
+def agent(clk, tmp_path):
+    """A live agent: Sentinel + metric pipeline + HTTP command center."""
+    cfg = stpu.load_config(max_resources=64, max_flow_rules=16,
+                           max_degrade_rules=16, max_authority_rules=16,
+                           minute_enabled=True)
+    sph = stpu.Sentinel(config=cfg, clock=clk)
+    writer = MetricWriter(str(tmp_path), cfg.app_name)
+    timer = MetricTimerListener(sph, writer=writer)
+    searcher = MetricSearcher(str(tmp_path), form_metric_file_name(cfg.app_name))
+    center = CommandCenter()
+    register_default_handlers(center, sph, metric_searcher=searcher)
+    # 0.0.0.0: heartbeats advertise the machine's interface IP, and the
+    # dashboard connects back to that address
+    http = SimpleHttpCommandCenter(center, host="0.0.0.0", port=0)
+    port = http.start()
+    yield sph, timer, port
+    http.stop()
+
+
+@pytest.fixture
+def dash(clk):
+    server = DashboardServer(
+        Dashboard(password="", clock=clk), host="127.0.0.1", port=0)
+    port = server.start(fetch=False)     # fetch loops driven manually
+    yield server.dashboard, port
+    server.stop()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+        return json.loads(r.read().decode())
+
+
+def _send(port, path, method="POST", body=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read().decode())
+
+
+def _beat(agent_port, dash_port, clk):
+    hb = HeartbeatSender(f"127.0.0.1:{dash_port}", app_name="sentinel-tpu",
+                         api_port=agent_port, clock=clk)
+    assert hb.send_once()
+    return hb
+
+
+# ------------------------------------------------------------------ discovery
+
+def test_heartbeat_registers_machine(agent, dash, clk):
+    _sph, _timer, aport = agent
+    d, dport = dash
+    _beat(aport, dport, clk)
+    names = _get(dport, "/app/names.json")
+    assert names["data"] == ["sentinel-tpu"]
+    machines = _get(dport, "/app/sentinel-tpu/machines.json")["data"]
+    assert machines[0]["port"] == aport and machines[0]["healthy"]
+
+
+def test_machine_goes_unhealthy_by_heartbeat_age(dash, clk):
+    d, dport = dash
+    d.receive_heartbeat({"app": "a", "ip": "1.2.3.4", "port": "8719"})
+    assert d.apps.healthy_machines("a", d._now_ms())
+    clk.advance_ms(120_000)
+    assert not d.apps.healthy_machines("a", d._now_ms())
+
+
+# ------------------------------------------------------------------ rule CRUD
+
+def test_rule_crud_writes_through_to_agent(agent, dash, clk):
+    sph, _timer, aport = agent
+    d, dport = dash
+    _beat(aport, dport, clk)
+
+    out = _send(dport, "/v1/flow/rule", body={
+        "app": "sentinel-tpu", "resource": "svc", "grade": 1, "count": 5.0})
+    assert out["success"], out
+    rid = out["data"]["id"]
+
+    # the rule must be live on the agent
+    rules = sph.get_flow_rules()
+    assert len(rules) == 1 and rules[0].resource == "svc"
+    assert rules[0].count == 5.0
+
+    # GET pulls from the machine and preserves the repo id
+    got = _get(dport, "/v1/flow/rules?app=sentinel-tpu")["data"]
+    assert len(got) == 1 and got[0]["id"] == rid
+
+    # update → republished
+    up = _send(dport, f"/v1/flow/rule/{rid}", method="PUT",
+               body={"count": 9.0})
+    assert up["success"], up
+    assert sph.get_flow_rules()[0].count == 9.0
+
+    # delete → removed from the agent
+    _send(dport, f"/v1/flow/rule/{rid}", method="DELETE")
+    assert sph.get_flow_rules() == []
+
+
+def test_degrade_and_system_rule_publish(agent, dash, clk):
+    sph, _timer, aport = agent
+    d, dport = dash
+    _beat(aport, dport, clk)
+    assert _send(dport, "/v1/degrade/rule", body={
+        "app": "sentinel-tpu", "resource": "svc", "grade": 2,
+        "count": 3, "timeWindow": 10})["success"]
+    assert len(sph.get_degrade_rules()) == 1
+    assert _send(dport, "/v1/system/rule", body={
+        "app": "sentinel-tpu", "qps": 100})["success"]
+    assert len(sph.get_system_rules()) == 1
+
+
+def test_add_rule_without_machines_reports_publish_failure(dash):
+    d, dport = dash
+    out = _send(dport, "/v1/flow/rule", body={
+        "app": "ghost", "resource": "svc", "count": 1.0})
+    assert not out["success"] and out["code"] == -2
+
+
+# ------------------------------------------------------------------ metrics
+
+def test_metric_fetch_aggregates_into_repo(agent, dash, clk):
+    sph, timer, aport = agent
+    d, dport = dash
+    _beat(aport, dport, clk)
+
+    sph.load_flow_rules([stpu.FlowRule(resource="svc", count=4)])
+    for _ in range(6):
+        try:
+            with sph.entry("svc"):
+                pass
+        except stpu.BlockException:
+            pass
+    clk.advance_ms(2100)
+    assert timer.tick() >= 1          # write the completed second to disk
+
+    clk.advance_ms(3000)              # put [T0] inside the fetch window
+    assert d.fetcher.fetch_once("sentinel-tpu") >= 1
+    res = _get(dport, "/metric/resources.json?app=sentinel-tpu")["data"]
+    assert "svc" in res
+    pts = _get(dport, "/metric/queryByAppAndResource.json?app=sentinel-tpu"
+               f"&identity=svc&startTime={T0 - 1000}&endTime={T0 + 9000}")
+    svc = [p for p in pts["data"] if p["timestamp"] == T0]
+    assert svc and svc[0]["passQps"] == 4 and svc[0]["blockQps"] == 2
+
+
+def test_repo_two_machine_aggregation_and_retention():
+    repo = InMemoryMetricsRepository()
+    for rt in (10.0, 30.0):
+        repo.save(MetricEntity(app="a", timestamp=1000, resource="r",
+                               pass_qps=5, rt=rt, count=1), now_ms=2000)
+    got = repo.query("a", "r", 0, 5000)
+    assert got[0].pass_qps == 10 and got[0].rt == 20.0 and got[0].count == 2
+    # entries older than the retention window are evicted on save
+    repo.save(MetricEntity(app="a", timestamp=10_000_000, resource="r",
+                           pass_qps=1, count=1), now_ms=10_000_000)
+    assert repo.query("a", "r", 0, 5000) == []
+
+
+# ------------------------------------------------------------------ live views
+
+def test_machine_resource_view(agent, dash, clk):
+    sph, _timer, aport = agent
+    d, dport = dash
+    _beat(aport, dport, clk)
+    with sph.entry("svc"):
+        pass
+    out = _get(dport, f"/resource/machineResource.json?ip=127.0.0.1&port={aport}")
+    assert out["success"]
+    assert any(n.get("resource") == "svc" for n in out["data"])
+
+
+# ------------------------------------------------------------------ auth
+
+def test_auth_required_when_password_set(clk):
+    server = DashboardServer(Dashboard(password="s3cret", clock=clk),
+                             host="127.0.0.1", port=0)
+    port = server.start(fetch=False)
+    try:
+        out = _get(port, "/app/names.json")
+        assert not out["success"] and out["code"] == 401
+
+        # login sets a session cookie that unlocks the API
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/auth/login", method="POST",
+            data=json.dumps({"username": "sentinel",
+                             "password": "s3cret"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            cookie = r.headers["Set-Cookie"].split(";")[0]
+            assert json.loads(r.read().decode())["success"]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/app/names.json",
+            headers={"Cookie": cookie})
+        with urllib.request.urlopen(req) as r:
+            assert json.loads(r.read().decode())["success"]
+
+        # wrong password rejected
+        bad = _send(port, "/auth/login",
+                    body={"username": "sentinel", "password": "nope"})
+        assert not bad["success"] and bad["code"] == 401
+    finally:
+        server.stop()
+
+
+def test_index_page_served(dash):
+    _d, dport = dash
+    with urllib.request.urlopen(f"http://127.0.0.1:{dport}/") as r:
+        body = r.read().decode()
+    assert "Sentinel-TPU Dashboard" in body

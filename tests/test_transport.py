@@ -8,6 +8,7 @@ rule properties (SURVEY §2.2 / §3.5 convergence paths).
 
 import json
 import os
+import urllib.error
 import urllib.parse
 import urllib.request
 
@@ -288,3 +289,45 @@ def test_missing_file_datasource_returns_empty(tmp_path):
                                    start_thread=False)
     assert ds.load_config() == []
     ds.close()
+
+
+def test_bootstrap_advertises_bound_port(sentinel, clk):
+    """Port auto-increment must propagate into heartbeat + basicInfo
+    (reference TransportConfig runtime-port behavior)."""
+    from sentinel_tpu.transport import start_transport
+
+    rt1 = start_transport(sentinel, host="127.0.0.1", port=0)
+    try:
+        # second agent asking for the same bound port gets port+1 via the
+        # auto-increment loop; both must advertise what they actually bound
+        rt2 = start_transport(sentinel, host="127.0.0.1", port=rt1.port,
+                              dashboard_addr="127.0.0.1:1")   # no dashboard
+        try:
+            assert rt2.port == rt1.port + 1
+            assert rt2.heartbeat is not None
+            assert rt2.heartbeat.message()["port"] == str(rt2.port)
+            info = json.loads(
+                rt2.center.handle("basicInfo",
+                                  CommandRequest(parameters={})).result)
+            assert info["apiPort"] == rt2.port
+        finally:
+            rt2.stop()
+    finally:
+        rt1.stop()
+
+
+def test_form_body_invalid_utf8_returns_400(sentinel):
+    from sentinel_tpu.transport import start_transport
+
+    rt = start_transport(sentinel, host="127.0.0.1", port=0)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{rt.port}/setRules", data=b"\xff\xfe\xfd",
+            headers={"Content-Type": "application/x-www-form-urlencoded"})
+        try:
+            urllib.request.urlopen(req)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 400
+    finally:
+        rt.stop()
