@@ -1,0 +1,31 @@
+"""Integration adapters (reference ``sentinel-adapter/*``, SURVEY §2.6).
+
+Every reference adapter reduces to the same shape: derive a resource name
+(+ parse the caller origin), ``ContextUtil.enter``, ``SphU.entry``, invoke,
+``Tracer.traceEntry`` on exception, ``entry.exit()``. These are the Python
+ecosystem's equivalents:
+
+- :mod:`.decorator` — ``@sentinel_resource`` with block_handler/fallback
+  (``sentinel-annotation-aspectj`` ``SentinelResourceAspect``)
+- :mod:`.wsgi` — WSGI middleware (``sentinel-web-servlet`` ``CommonFilter``)
+- :mod:`.asgi` — ASGI 3 middleware, async (``sentinel-spring-webflux-adapter``)
+- :mod:`.grpc_interceptor` — gRPC server/client interceptors
+  (``sentinel-grpc-adapter``)
+- :mod:`.http_client` — ``requests`` session + ``urllib`` opener guards
+  (``sentinel-okhttp-adapter`` / ``sentinel-apache-httpclient-adapter``)
+- :mod:`.asyncio_support` — async entry helper (``sentinel-reactor-adapter``
+  ``AsyncEntry`` analog for asyncio)
+"""
+
+from sentinel_tpu.adapters.decorator import sentinel_resource
+from sentinel_tpu.adapters.wsgi import SentinelWSGIMiddleware
+from sentinel_tpu.adapters.asgi import SentinelASGIMiddleware
+from sentinel_tpu.adapters.asyncio_support import async_entry
+from sentinel_tpu.adapters.http_client import (
+    SentinelSession, guarded_urlopen,
+)
+
+__all__ = [
+    "sentinel_resource", "SentinelWSGIMiddleware", "SentinelASGIMiddleware",
+    "async_entry", "SentinelSession", "guarded_urlopen",
+]

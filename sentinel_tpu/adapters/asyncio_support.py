@@ -1,0 +1,47 @@
+"""Async entry helper (reference ``sentinel-reactor-adapter``
+``SentinelReactorTransformer`` — wrap an async operation in an entry whose
+pacing wait is awaited, not slept).
+
+``async with async_entry(sph, "resource"):`` is the asyncio analog of
+``try (Entry e = SphU.entry(...))``; on deny the BlockException raises out
+of ``__aenter__`` before the body runs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional, Sequence
+
+
+class async_entry:
+    def __init__(self, sentinel, resource: str, *,
+                 origin: Optional[str] = None, acquire: int = 1,
+                 entry_type: int = 1, prioritized: bool = False,
+                 args: Sequence = (), resource_type: int = 0):
+        self._sentinel = sentinel
+        self._kw = dict(origin=origin, acquire=acquire, entry_type=entry_type,
+                        prioritized=prioritized, args=args,
+                        resource_type=resource_type)
+        self._resource = resource
+        self.entry = None
+
+    async def __aenter__(self):
+        # the decide step itself is fast + non-blocking; only the pacing
+        # wait must move onto the event loop
+        self.entry = self._sentinel.entry(self._resource, sleep=False,
+                                          **self._kw)
+        if self.entry.wait_ms > 0:
+            try:
+                await asyncio.sleep(self.entry.wait_ms / 1000.0)
+            except BaseException:
+                # cancelled during the pacing wait: __aexit__ will never
+                # run, so the entry must be exited here
+                self.entry.exit()
+                raise
+        return self.entry
+
+    async def __aexit__(self, exc_type, exc, tb) -> bool:
+        if exc is not None:
+            self.entry.trace(exc)
+        self.entry.exit()
+        return False

@@ -1,0 +1,71 @@
+"""Outbound HTTP client guards (reference ``sentinel-okhttp-adapter``
+``SentinelOkHttpInterceptor`` and ``sentinel-apache-httpclient-adapter``
+``SentinelApacheHttpClientExecChainHandler``).
+
+Resource defaults to ``httpclient:METHOD:host/path-sans-query`` like the
+reference's ``OkHttpResourceExtractor``; override via ``resource_extractor``.
+"""
+
+from __future__ import annotations
+
+import urllib.parse
+import urllib.request
+from typing import Callable, Optional
+
+from sentinel_tpu.core.errors import BlockException
+from sentinel_tpu.metrics.node import TYPE_COMMON
+
+
+def default_resource(method: str, url: str) -> str:
+    p = urllib.parse.urlsplit(url)
+    return f"httpclient:{method.upper()}:{p.netloc}{p.path}"
+
+
+class SentinelSession:
+    """A ``requests.Session`` subclass guarding every request.
+
+    Defined lazily so importing this module never requires requests."""
+
+    def __new__(cls, sentinel, *,
+                resource_extractor: Optional[Callable[[str, str], str]] = None,
+                **kw):
+        import requests
+
+        class _Session(requests.Session):
+            def request(self, method, url, *a, **k):
+                resource = (resource_extractor or default_resource)(
+                    method, url)
+                e = sentinel.entry(resource, entry_type=0,
+                                   resource_type=TYPE_COMMON)
+                try:
+                    resp = super().request(method, url, *a, **k)
+                except BaseException as exc:
+                    e.trace(exc)
+                    e.exit()
+                    raise
+                if resp.status_code >= 500:
+                    e.trace(RuntimeError(f"http {resp.status_code}"))
+                e.exit()
+                return resp
+
+        return _Session(**kw)
+
+
+def guarded_urlopen(sentinel, url, *args,
+                    resource_extractor: Optional[Callable] = None,
+                    **kwargs):
+    """stdlib variant: ``urllib.request.urlopen`` under an entry. Raises
+    BlockException when denied (callers treat it like a connection error)."""
+    req_url = url.full_url if isinstance(url, urllib.request.Request) else url
+    method = (url.get_method()
+              if isinstance(url, urllib.request.Request) else "GET")
+    resource = (resource_extractor or default_resource)(method, req_url)
+    e = sentinel.entry(resource, entry_type=0, resource_type=TYPE_COMMON)
+    try:
+        resp = urllib.request.urlopen(url, *args, **kwargs)
+    except BaseException as exc:
+        e.trace(exc)
+        e.exit()
+        raise
+    e.exit()
+    return resp

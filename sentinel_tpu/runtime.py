@@ -135,7 +135,7 @@ class Entry:
 
     __slots__ = ("_rt", "resource", "row", "origin_row", "chain_row",
                  "acquire", "is_in", "create_ms", "error", "_exited",
-                 "param_pairs")
+                 "param_pairs", "wait_ms")
 
     def __init__(self, rt: "Sentinel", resource: str, row: int, origin_row: int,
                  chain_row: int, acquire: int, is_in: bool, create_ms: int,
@@ -151,6 +151,7 @@ class Entry:
         self.param_pairs = param_pairs   # (rules [PV], keys [PV]) or None
         self.error: Optional[BaseException] = None
         self._exited = False
+        self.wait_ms = 0   # pacing verdict; >0 only with entry(sleep=False)
 
     def trace(self, exc: BaseException) -> None:
         """Reference ``Tracer.trace`` — mark a business exception so it feeds
@@ -360,10 +361,13 @@ class Sentinel:
     def entry(self, resource: str, *, origin: Optional[str] = None,
               acquire: int = 1, entry_type: int = ENTRY_TYPE_IN,
               prioritized: bool = False, args: Sequence = (),
-              resource_type: int = 0) -> Entry:
+              resource_type: int = 0, sleep: bool = True) -> Entry:
         """Guard a call. Raises a BlockException subclass when denied;
         sleeps (via the clock) on pass-with-wait verdicts. ``args`` are the
-        call's parameters for hot-param rules (``SphU.entry(name, args)``)."""
+        call's parameters for hot-param rules (``SphU.entry(name, args)``).
+        ``sleep=False`` skips the pacing sleep and instead reports it on
+        ``Entry.wait_ms`` so async callers can await it (the cluster
+        protocol's ``TokenResult.waitInMs`` pattern generalized locally)."""
         if not self._global_on:
             now = self.clock.now_ms()
             return Entry(self, resource, -1, -1, -1, acquire,
@@ -405,11 +409,16 @@ class Sentinel:
                 pairs[3].unpin_rows(pairs[4])
             raise
         wait = int(verdict.wait_ms[0])
-        if wait > 0:
+        if wait > 0 and sleep:
             self.clock.sleep_ms(wait)
         now = self.clock.now_ms()
-        return Entry(self, resource, row, o_row, c_row, acquire, is_in, now,
-                     param_pairs=pairs)
+        # sleep=False: project create_ms past the wait the caller will await,
+        # so rt excludes pacing delay exactly like the sleep=True path
+        e = Entry(self, resource, row, o_row, c_row, acquire, is_in,
+                  now if sleep else now + wait, param_pairs=pairs)
+        if not sleep:
+            e.wait_ms = wait
+        return e
 
     def _resolve_param_pairs_one(self, row: int, args: Sequence):
         """→ (rules [PV], keys [PV], generation, registry), or None when the

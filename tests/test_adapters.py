@@ -1,0 +1,292 @@
+"""Adapter tests (reference pattern, SURVEY §4: per-framework in-process
+servers/mocks — issue request → assert node counters / block behavior)."""
+
+import asyncio
+import io
+import json
+
+import pytest
+
+import sentinel_tpu as stpu
+from sentinel_tpu.adapters import (
+    SentinelASGIMiddleware, SentinelWSGIMiddleware, async_entry,
+    guarded_urlopen, sentinel_resource,
+)
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.core.errors import BlockException
+
+T0 = 1_785_000_000_000
+
+
+@pytest.fixture
+def clk():
+    return ManualClock(start_ms=T0)
+
+
+@pytest.fixture
+def sph(clk):
+    cfg = stpu.load_config(max_resources=64, max_flow_rules=16,
+                           max_degrade_rules=16, max_authority_rules=16)
+    return stpu.Sentinel(config=cfg, clock=clk)
+
+
+# ------------------------------------------------------------------ decorator
+
+def test_decorator_passes_and_blocks(sph):
+    sph.load_flow_rules([stpu.FlowRule(resource="api", count=2)])
+
+    @sentinel_resource("api", sentinel=sph)
+    def handler(x):
+        return x * 2
+
+    assert handler(3) == 6 and handler(4) == 8
+    with pytest.raises(BlockException):
+        handler(5)
+
+
+def test_decorator_block_handler(sph):
+    sph.load_flow_rules([stpu.FlowRule(resource="api", count=1)])
+
+    @sentinel_resource("api", sentinel=sph,
+                       block_handler=lambda x, exc: f"blocked:{x}")
+    def handler(x):
+        return f"ok:{x}"
+
+    assert handler(1) == "ok:1"
+    assert handler(2) == "blocked:2"
+
+
+def test_decorator_fallback_and_ignore(sph):
+    calls = []
+
+    @sentinel_resource("fb", sentinel=sph,
+                       fallback=lambda x, exc: f"fb:{x}",
+                       exceptions_to_ignore=(KeyError,))
+    def handler(x):
+        calls.append(x)
+        if x == "key":
+            raise KeyError(x)
+        raise ValueError(x)
+
+    assert handler("v") == "fb:v"          # business error → fallback
+    with pytest.raises(KeyError):
+        handler("key")                     # ignored → propagates untraced
+    t = sph.node_totals("fb")
+    assert t["exception"] == 1             # only the ValueError traced
+
+
+def test_decorator_exception_feeds_breaker(sph):
+    sph.load_degrade_rules([stpu.DegradeRule(
+        resource="flaky", grade=stpu.GRADE_EXCEPTION_COUNT, count=2,
+        time_window=10, min_request_amount=1, stat_interval_ms=1000)])
+
+    @sentinel_resource("flaky", sentinel=sph)
+    def handler():
+        raise ValueError("boom")
+
+    for _ in range(3):
+        with pytest.raises((ValueError, BlockException)):
+            handler()
+    # breaker is OPEN now: the call is denied before the body runs
+    with pytest.raises(BlockException):
+        handler()
+
+
+def test_decorator_default_name_and_late_binding(sph):
+    @sentinel_resource(sentinel=lambda: sph)
+    def my_func():
+        return 1
+
+    assert my_func() == 1
+    assert "my_func" in my_func.__sentinel_resource__
+
+
+# ------------------------------------------------------------------ WSGI
+
+def _wsgi_call(app, path="/", method="GET", headers=None):
+    environ = {"REQUEST_METHOD": method, "PATH_INFO": path,
+               "wsgi.input": io.BytesIO(b"")}
+    environ.update(headers or {})
+    status_headers = {}
+
+    def start_response(status, headers_list):
+        status_headers["status"] = status
+        status_headers["headers"] = headers_list
+
+    body = b"".join(app(environ, start_response))
+    return status_headers["status"], body
+
+
+def test_wsgi_pass_block_and_counters(sph):
+    def inner(environ, start_response):
+        start_response("200 OK", [("Content-Type", "text/plain")])
+        return [b"hello"]
+
+    app = SentinelWSGIMiddleware(inner, sph)
+    sph.load_flow_rules([stpu.FlowRule(resource="GET:/hi", count=2)])
+    for _ in range(2):
+        status, body = _wsgi_call(app, "/hi")
+        assert status.startswith("200") and body == b"hello"
+    status, body = _wsgi_call(app, "/hi")
+    assert status.startswith("429") and b"Blocked" in body
+    t = sph.node_totals("GET:/hi")
+    assert t["pass"] == 2 and t["block"] == 1
+
+
+def test_wsgi_url_cleaner_and_origin(sph):
+    def inner(environ, start_response):
+        start_response("200 OK", [])
+        return [b"ok"]
+
+    app = SentinelWSGIMiddleware(
+        inner, sph,
+        url_cleaner=lambda p: "/order/{id}" if p.startswith("/order/") else p,
+        origin_parser=lambda env: env.get("HTTP_S_USER", ""))
+    sph.load_authority_rules([stpu.AuthorityRule(
+        resource="GET:/order/{id}", limit_app="evil",
+        strategy=stpu.STRATEGY_BLACK)])
+    status, _ = _wsgi_call(app, "/order/123")
+    assert status.startswith("200")
+    status, _ = _wsgi_call(app, "/order/456",
+                           headers={"HTTP_S_USER": "evil"})
+    assert status.startswith("429")
+    # both URLs collapsed into one resource row
+    assert sph.node_totals("GET:/order/{id}")["pass"] == 1
+
+
+def test_wsgi_traces_app_exception(sph):
+    def inner(environ, start_response):
+        raise RuntimeError("app broke")
+
+    app = SentinelWSGIMiddleware(inner, sph)
+    with pytest.raises(RuntimeError):
+        _wsgi_call(app, "/boom")
+    assert sph.node_totals("GET:/boom")["exception"] == 1
+
+
+# ------------------------------------------------------------------ ASGI
+
+def _asgi_call(app, path="/", method="GET"):
+    scope = {"type": "http", "method": method, "path": path, "headers": []}
+    sent = []
+
+    async def receive():
+        return {"type": "http.request", "body": b""}
+
+    async def send(msg):
+        sent.append(msg)
+
+    asyncio.run(app(scope, receive, send))
+    status = next(m["status"] for m in sent
+                  if m["type"] == "http.response.start")
+    body = b"".join(m.get("body", b"") for m in sent
+                    if m["type"] == "http.response.body")
+    return status, body
+
+
+def test_asgi_pass_and_block(sph):
+    async def inner(scope, receive, send):
+        await send({"type": "http.response.start", "status": 200,
+                    "headers": []})
+        await send({"type": "http.response.body", "body": b"async-ok"})
+
+    app = SentinelASGIMiddleware(inner, sph)
+    sph.load_flow_rules([stpu.FlowRule(resource="GET:/a", count=1)])
+    status, body = _asgi_call(app, "/a")
+    assert status == 200 and body == b"async-ok"
+    status, body = _asgi_call(app, "/a")
+    assert status == 429 and b"Blocked" in body
+    t = sph.node_totals("GET:/a")
+    assert t["pass"] == 1 and t["block"] == 1
+
+
+def test_asgi_non_http_passthrough(sph):
+    seen = []
+
+    async def inner(scope, receive, send):
+        seen.append(scope["type"])
+
+    app = SentinelASGIMiddleware(inner, sph)
+    asyncio.run(app({"type": "lifespan"}, None, None))
+    assert seen == ["lifespan"]
+
+
+# ------------------------------------------------------------------ asyncio
+
+def test_async_entry_block_and_trace(sph):
+    sph.load_flow_rules([stpu.FlowRule(resource="aio", count=1)])
+
+    async def work():
+        async with async_entry(sph, "aio"):
+            return "done"
+
+    assert asyncio.run(work()) == "done"
+    with pytest.raises(BlockException):
+        asyncio.run(work())
+
+    async def failing():
+        async with async_entry(sph, "aio2"):
+            raise ValueError("x")
+
+    with pytest.raises(ValueError):
+        asyncio.run(failing())
+    assert sph.node_totals("aio2")["exception"] == 1
+
+
+# ------------------------------------------------------------------ grpc
+
+def test_grpc_server_interceptor_blocks():
+    grpc = pytest.importorskip("grpc")
+    from concurrent import futures
+    from sentinel_tpu.adapters.grpc_interceptor import (
+        SentinelServerInterceptor,
+    )
+
+    clk = ManualClock(start_ms=T0)
+    cfg = stpu.load_config(max_resources=64, max_flow_rules=16,
+                           max_degrade_rules=16, max_authority_rules=16)
+    sph = stpu.Sentinel(config=cfg, clock=clk)
+
+    method = "/test.Echo/Say"
+    sph.load_flow_rules([stpu.FlowRule(resource=method, count=2)])
+
+    def say(request, context):
+        return request + b"!"
+
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=2),
+        interceptors=[SentinelServerInterceptor(sph)])
+    handler = grpc.method_handlers_generic_handler(
+        "test.Echo", {"Say": grpc.unary_unary_rpc_method_handler(
+            say,
+            request_deserializer=lambda b: b,
+            response_serializer=lambda b: b)})
+    server.add_generic_rpc_handlers((handler,))
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    try:
+        with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+            stub = ch.unary_unary(method,
+                                  request_serializer=lambda b: b,
+                                  response_deserializer=lambda b: b)
+            assert stub(b"hi") == b"hi!"
+            assert stub(b"yo") == b"yo!"
+            with pytest.raises(grpc.RpcError) as exc_info:
+                stub(b"third")
+            assert (exc_info.value.code()
+                    == grpc.StatusCode.RESOURCE_EXHAUSTED)
+        t = sph.node_totals(method)
+        assert t["pass"] == 2 and t["block"] == 1
+    finally:
+        server.stop(None)
+
+
+# ------------------------------------------------------------------ urllib
+
+def test_guarded_urlopen_blocks_before_connecting(sph):
+    sph.load_flow_rules([stpu.FlowRule(
+        resource="httpclient:GET:127.0.0.1:1/x", count=0)])
+    # blocked before any socket is opened → BlockException, not URLError
+    with pytest.raises(BlockException):
+        guarded_urlopen(sph, "http://127.0.0.1:1/x", timeout=0.2)
+    assert sph.node_totals("httpclient:GET:127.0.0.1:1/x")["block"] == 1
