@@ -23,6 +23,7 @@ from sentinel_tpu.core.errors import (
     BlockException,
     BlockReason,
     DegradeException,
+    ErrorEntryFreeError,
     FlowException,
     ParamFlowException,
     SystemBlockException,
@@ -62,7 +63,7 @@ __all__ = [
     "ParamFlowRule", "ParamFlowItem", "PARAM_BEHAVIOR_RATE_LIMITER",
     "BlockException", "FlowException", "DegradeException",
     "SystemBlockException", "AuthorityException", "ParamFlowException",
-    "BlockReason",
+    "BlockReason", "ErrorEntryFreeError",
     "GRADE_QPS", "GRADE_THREAD", "GRADE_RT", "GRADE_EXCEPTION_RATIO",
     "GRADE_EXCEPTION_COUNT",
     "BEHAVIOR_DEFAULT", "BEHAVIOR_WARM_UP", "BEHAVIOR_RATE_LIMITER",

@@ -1,0 +1,96 @@
+"""Prometheus metric exporter (reference
+``sentinel-extension/sentinel-metric-exporter``: ``MetricExporterInit`` →
+``JMXMetricExporter`` exposing per-resource ``MetricBean`` MXBeans —
+rebuilt as the Python ecosystem's idiom, a prometheus_client collector).
+
+One custom collector snapshots every resource's rolling-second totals in a
+single device fetch (``all_node_totals``) at scrape time — no background
+thread, no per-resource device round-trips. Exposes::
+
+    sentinel_pass_qps{resource=...}        rolling-second pass count
+    sentinel_block_qps{resource=...}
+    sentinel_success_qps{resource=...}
+    sentinel_exception_qps{resource=...}
+    sentinel_avg_rt_ms{resource=...}
+    sentinel_concurrency{resource=...}     live thread/inflight count
+    sentinel_breaker_state{resource=...}   0 closed / 1 open / 2 half-open
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from prometheus_client import start_http_server
+from prometheus_client.core import GaugeMetricFamily
+from prometheus_client.registry import REGISTRY
+
+
+class SentinelCollector:
+    """Register with ``prometheus_client``'s registry; each scrape pulls one
+    consistent snapshot of all resources."""
+
+    def __init__(self, sentinel, namespace: str = "sentinel"):
+        self.sentinel = sentinel
+        self.namespace = namespace
+
+    def collect(self):
+        ns = self.namespace
+        gauges = {
+            "pass": GaugeMetricFamily(
+                f"{ns}_pass_qps", "Rolling-second pass count",
+                labels=["resource"]),
+            "block": GaugeMetricFamily(
+                f"{ns}_block_qps", "Rolling-second block count",
+                labels=["resource"]),
+            "success": GaugeMetricFamily(
+                f"{ns}_success_qps", "Rolling-second success count",
+                labels=["resource"]),
+            "exception": GaugeMetricFamily(
+                f"{ns}_exception_qps", "Rolling-second exception count",
+                labels=["resource"]),
+            "avg_rt": GaugeMetricFamily(
+                f"{ns}_avg_rt_ms", "Rolling-second average RT (ms)",
+                labels=["resource"]),
+            "threads": GaugeMetricFamily(
+                f"{ns}_concurrency", "Live in-flight count",
+                labels=["resource"]),
+        }
+        breaker = GaugeMetricFamily(
+            f"{ns}_breaker_state",
+            "Circuit state: 0 closed, 1 open, 2 half-open",
+            labels=["resource"])
+
+        totals = self.sentinel.all_node_totals()
+        for name, _row, t in totals:
+            for key, fam in gauges.items():
+                fam.add_metric([name], float(t.get(key, 0) or 0))
+        for res, state in self.sentinel.breaker_resources():
+            breaker.add_metric([res], float(state))
+        yield from gauges.values()
+        yield breaker
+
+
+class PrometheusExporter:
+    """Convenience wrapper: register the collector and (optionally) serve
+    ``/metrics`` on its own port (``MetricExporterInit`` analog)."""
+
+    def __init__(self, sentinel, *, registry=REGISTRY,
+                 namespace: str = "sentinel"):
+        self.collector = SentinelCollector(sentinel, namespace)
+        self.registry = registry
+        self._server = None
+        registry.register(self.collector)
+
+    def serve(self, port: int = 9464, addr: str = "0.0.0.0") -> None:
+        self._server, _ = start_http_server(
+            port, addr=addr, registry=self.registry)
+
+    def close(self) -> None:
+        try:
+            self.registry.unregister(self.collector)
+        except KeyError:
+            pass
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()   # release the listening socket now
+            self._server = None

@@ -135,7 +135,7 @@ class Entry:
 
     __slots__ = ("_rt", "resource", "row", "origin_row", "chain_row",
                  "acquire", "is_in", "create_ms", "error", "_exited",
-                 "param_pairs", "wait_ms")
+                 "param_pairs", "wait_ms", "_terminate_handlers")
 
     def __init__(self, rt: "Sentinel", resource: str, row: int, origin_row: int,
                  chain_row: int, acquire: int, is_in: bool, create_ms: int,
@@ -152,6 +152,7 @@ class Entry:
         self.error: Optional[BaseException] = None
         self._exited = False
         self.wait_ms = 0   # pacing verdict; >0 only with entry(sleep=False)
+        self._terminate_handlers = None   # CtEntry.whenTerminate callbacks
 
     def trace(self, exc: BaseException) -> None:
         """Reference ``Tracer.trace`` — mark a business exception so it feeds
@@ -159,11 +160,22 @@ class Entry:
         if exc is not None and not is_block_exception(exc):
             self.error = exc
 
+    def when_terminate(self, fn) -> None:
+        """Register ``fn(entry)`` to run after exit (reference
+        ``CtEntry.whenTerminate`` — the hook HALF_OPEN probes and the api
+        facade's entry stack use)."""
+        if self._terminate_handlers is None:
+            self._terminate_handlers = []
+        self._terminate_handlers.append(fn)
+
     def exit(self) -> None:
         if self._exited:
             raise ErrorEntryFreeError(f"entry for {self.resource!r} exited twice")
         self._exited = True
         self._rt._exit_one(self)
+        if self._terminate_handlers:
+            for fn in self._terminate_handlers:
+                fn(self)
 
     def __enter__(self) -> "Entry":
         return self
@@ -827,3 +839,15 @@ class Sentinel:
     def breaker_states(self) -> List[int]:
         with self._lock:
             return [int(s) for s in np.asarray(self._state.breakers.state[:-1])]
+
+    def breaker_resources(self) -> List[Tuple[str, int]]:
+        """(resource, state) per loaded degrade rule, rule-slot order
+        (EventObserverRegistry/observability view). States and rules are
+        snapshotted under one lock so a concurrent rule reload can't pair
+        new rules with another generation's states."""
+        with self._lock:
+            states = [int(s)
+                      for s in np.asarray(self._state.breakers.state[:-1])]
+            rules = list(self._deg.rules)
+        return [(r.resource, states[j]) for j, r in enumerate(rules)
+                if j < len(states)]

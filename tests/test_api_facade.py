@@ -1,0 +1,88 @@
+"""Static facade: SphU/SphO/Tracer ergonomics over a process-global
+instance (reference ``SphU.java``/``SphO.java``/``Tracer.java``)."""
+
+import pytest
+
+import sentinel_tpu as stpu
+import sentinel_tpu.api as sph
+from sentinel_tpu.core.clock import ManualClock
+
+T0 = 1_785_000_000_000
+
+
+@pytest.fixture(autouse=True)
+def fresh_instance():
+    cfg = stpu.load_config(max_resources=64, max_flow_rules=16,
+                           max_degrade_rules=16, max_authority_rules=16)
+    sph.init(cfg, clock=ManualClock(start_ms=T0))
+    yield
+    sph.reset()
+
+
+def test_entry_context_manager_and_block():
+    sph.instance().load_flow_rules([stpu.FlowRule(resource="r", count=1)])
+    with sph.entry("r"):
+        pass
+    with pytest.raises(stpu.BlockException):
+        with sph.entry("r"):
+            pass
+
+
+def test_try_entry_boolean_no_raise():
+    sph.instance().load_flow_rules([stpu.FlowRule(resource="b", count=2)])
+    results = []
+    for _ in range(4):
+        if sph.try_entry("b"):
+            results.append("pass")
+            sph.exit()
+        else:
+            results.append("block")
+    assert results == ["pass", "pass", "block", "block"]
+    t = sph.instance().node_totals("b")
+    assert t["pass"] == 2 and t["block"] == 2 and t["threads"] == 0
+
+
+def test_trace_feeds_innermost_entry():
+    e = sph.entry("outer")
+    sph.entry("inner")
+    sph.trace(ValueError("boom"))
+    sph.exit(2)
+    assert sph.current_entry() is None
+    assert sph.instance().node_totals("inner")["exception"] == 1
+    assert sph.instance().node_totals("outer")["exception"] == 0
+    assert e._exited
+
+
+def test_nested_exit_unwinds_in_order():
+    e1 = sph.entry("a")
+    e2 = sph.entry("b")
+    assert sph.current_entry() is e2
+    sph.exit()
+    assert sph.current_entry() is e1
+    sph.exit()
+    assert sph.current_entry() is None
+
+
+def test_when_terminate_hook_runs_once():
+    fired = []
+    e = sph.entry("hooked")
+    e.when_terminate(lambda entry: fired.append(entry.resource))
+    e.exit()
+    assert fired == ["hooked"]
+    with pytest.raises(stpu.ErrorEntryFreeError):
+        e.exit()
+    assert fired == ["hooked"]
+
+
+def test_lazy_default_instance():
+    # pin virtual time so the rolling second can't slide between the lazy
+    # instance's first compile (seconds of XLA work) and the assertion
+    prev = stpu.set_global_clock(ManualClock(start_ms=T0))
+    try:
+        sph.reset()
+        with sph.entry("lazy"):
+            pass
+        assert sph.instance().node_totals("lazy")["pass"] == 1
+    finally:
+        stpu.set_global_clock(prev)
+        sph.reset()

@@ -1,0 +1,74 @@
+"""Prometheus exporter: scrape-time snapshot of resource totals + breaker
+states (reference sentinel-metric-exporter JMX beans, SURVEY §2.2)."""
+
+import urllib.request
+
+import pytest
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+
+prometheus_client = pytest.importorskip("prometheus_client")
+from prometheus_client import CollectorRegistry, generate_latest  # noqa: E402
+
+from sentinel_tpu.metrics.exporter import PrometheusExporter  # noqa: E402
+
+T0 = 1_785_000_000_000
+
+
+@pytest.fixture
+def sph():
+    cfg = stpu.load_config(max_resources=64, max_flow_rules=16,
+                           max_degrade_rules=16, max_authority_rules=16)
+    return stpu.Sentinel(config=cfg, clock=ManualClock(start_ms=T0))
+
+
+def _scrape(registry) -> str:
+    return generate_latest(registry).decode("utf-8")
+
+
+def test_exporter_reports_pass_block_and_breaker(sph):
+    registry = CollectorRegistry()
+    exp = PrometheusExporter(sph, registry=registry)
+    try:
+        sph.load_flow_rules([stpu.FlowRule(resource="svc", count=2)])
+        sph.load_degrade_rules([stpu.DegradeRule(
+            resource="svc", grade=stpu.GRADE_EXCEPTION_RATIO, count=0.5,
+            time_window=10)])
+        for _ in range(4):
+            try:
+                with sph.entry("svc"):
+                    pass
+            except stpu.BlockException:
+                pass
+        text = _scrape(registry)
+        assert 'sentinel_pass_qps{resource="svc"} 2.0' in text
+        assert 'sentinel_block_qps{resource="svc"} 2.0' in text
+        assert 'sentinel_breaker_state{resource="svc"} 0.0' in text
+    finally:
+        exp.close()
+
+
+def test_exporter_http_endpoint(sph):
+    registry = CollectorRegistry()
+    exp = PrometheusExporter(sph, registry=registry)
+    try:
+        with sph.entry("ping"):
+            pass
+        # port 0 → ephemeral
+        exp.serve(port=0, addr="127.0.0.1")
+        port = exp._server.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics") as r:
+            body = r.read().decode("utf-8")
+        assert 'sentinel_pass_qps{resource="ping"} 1.0' in body
+    finally:
+        exp.close()
+
+
+def test_exporter_unregister_is_idempotent(sph):
+    registry = CollectorRegistry()
+    exp = PrometheusExporter(sph, registry=registry)
+    exp.close()
+    exp.close()
+    assert "sentinel_pass_qps" not in _scrape(registry)
