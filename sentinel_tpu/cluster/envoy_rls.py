@@ -24,6 +24,7 @@ import dataclasses
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from sentinel_tpu.core.clock import Clock
 from sentinel_tpu.parallel.cluster import (
     STATUS_BLOCKED, STATUS_TOO_MANY_REQUEST, THRESHOLD_GLOBAL,
     ClusterEngine, ClusterFlowRule,
@@ -79,6 +80,7 @@ class EnvoyRlsRuleManager:
         self._lock = threading.Lock()
         self._flow_ids: Dict[str, int] = {}       # identifier → flow id
         self._limits: Dict[int, float] = {}       # flow id → count
+        self._loaded_domains: set = set()         # exact domains in engine
 
     def load_rules(self, rules: Sequence[EnvoyRlsRule]) -> None:
         """Replace all RLS rules (grouped per domain = namespace)."""
@@ -104,13 +106,13 @@ class EnvoyRlsRuleManager:
             # races a drop resolves to NO_RULE_EXISTS which reads as OK.
             for domain, crules in by_domain.items():
                 self.engine.load_rules(domain, crules)
-            for stale in (set(self._domains()) - set(by_domain)):
+            # exact loaded-domain set (identifiers can't be split back —
+            # domains may themselves contain the separator)
+            for stale in (self._loaded_domains - set(by_domain)):
                 self.engine.load_rules(stale, [])
+            self._loaded_domains = set(by_domain)
             self._flow_ids = flow_ids
             self._limits = limits
-
-    def _domains(self) -> List[str]:
-        return sorted({i.split(SEPARATOR, 1)[0] for i in self._flow_ids})
 
     def lookup(self, domain: str,
                entries: Sequence[Tuple[str, str]]) -> Optional[int]:
@@ -134,16 +136,14 @@ class EnvoyRlsService:
     without gRPC and reusable behind an HTTP frontend)."""
 
     def __init__(self, engine: ClusterEngine,
-                 rules: Optional[EnvoyRlsRuleManager] = None, clock=None):
+                 rules: Optional[EnvoyRlsRuleManager] = None,
+                 clock: Optional[Clock] = None):
         self.engine = engine
         self.rules = rules or EnvoyRlsRuleManager(engine)
-        self._clock = clock
+        self._clock = clock or Clock()
 
     def _now_ms(self) -> int:
-        if self._clock is not None:
-            return self._clock.now_ms()
-        import time
-        return int(time.time() * 1000)
+        return self._clock.now_ms()
 
     def should_rate_limit(
             self, domain: str,

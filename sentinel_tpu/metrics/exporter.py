@@ -29,32 +29,36 @@ class SentinelCollector:
     """Register with ``prometheus_client``'s registry; each scrape pulls one
     consistent snapshot of all resources."""
 
+    _GAUGES = (
+        ("pass", "pass_qps", "Rolling-second pass count"),
+        ("block", "block_qps", "Rolling-second block count"),
+        ("success", "success_qps", "Rolling-second success count"),
+        ("exception", "exception_qps", "Rolling-second exception count"),
+        ("avg_rt", "avg_rt_ms", "Rolling-second average RT (ms)"),
+        ("threads", "concurrency", "Live in-flight count"),
+    )
+
     def __init__(self, sentinel, namespace: str = "sentinel"):
         self.sentinel = sentinel
         self.namespace = namespace
 
+    def describe(self):
+        """Static family list so Registry.register doesn't trigger a full
+        collect (device snapshot + first-compile) at construction time."""
+        ns = self.namespace
+        for _key, suffix, doc in self._GAUGES:
+            yield GaugeMetricFamily(f"{ns}_{suffix}", doc,
+                                    labels=["resource"])
+        yield GaugeMetricFamily(
+            f"{ns}_breaker_state",
+            "Circuit state: 0 closed, 1 open, 2 half-open",
+            labels=["resource"])
+
     def collect(self):
         ns = self.namespace
-        gauges = {
-            "pass": GaugeMetricFamily(
-                f"{ns}_pass_qps", "Rolling-second pass count",
-                labels=["resource"]),
-            "block": GaugeMetricFamily(
-                f"{ns}_block_qps", "Rolling-second block count",
-                labels=["resource"]),
-            "success": GaugeMetricFamily(
-                f"{ns}_success_qps", "Rolling-second success count",
-                labels=["resource"]),
-            "exception": GaugeMetricFamily(
-                f"{ns}_exception_qps", "Rolling-second exception count",
-                labels=["resource"]),
-            "avg_rt": GaugeMetricFamily(
-                f"{ns}_avg_rt_ms", "Rolling-second average RT (ms)",
-                labels=["resource"]),
-            "threads": GaugeMetricFamily(
-                f"{ns}_concurrency", "Live in-flight count",
-                labels=["resource"]),
-        }
+        gauges = {key: GaugeMetricFamily(f"{ns}_{suffix}", doc,
+                                         labels=["resource"])
+                  for key, suffix, doc in self._GAUGES}
         breaker = GaugeMetricFamily(
             f"{ns}_breaker_state",
             "Circuit state: 0 closed, 1 open, 2 half-open",
@@ -64,7 +68,16 @@ class SentinelCollector:
         for name, _row, t in totals:
             for key, fam in gauges.items():
                 fam.add_metric([name], float(t.get(key, 0) or 0))
+        # several rules may guard one resource; one sample per label set
+        # (duplicates make Prometheus reject the whole scrape) — report the
+        # most-degraded state (OPEN > HALF_OPEN > CLOSED)
+        by_res: dict = {}
         for res, state in self.sentinel.breaker_resources():
+            rank = {0: 0, 2: 1, 1: 2}.get(state, 0)
+            cur = by_res.get(res)
+            if cur is None or rank > cur[0]:
+                by_res[res] = (rank, state)
+        for res, (_rank, state) in by_res.items():
             breaker.add_metric([res], float(state))
         yield from gauges.values()
         yield breaker

@@ -9,24 +9,17 @@ from sentinel_tpu.cluster.envoy_rls import (
     EnvoyRlsService, RlsDescriptorRule, SentinelRlsGrpcServer,
     descriptor_identifier, identifier_flow_id,
 )
+from sentinel_tpu.core.clock import ManualClock
 from sentinel_tpu.parallel.cluster import ClusterEngine, ClusterSpec
 
 NOW0 = 10_000_000
-
-
-class _FixedClock:
-    def __init__(self, ms):
-        self.ms = ms
-
-    def now_ms(self):
-        return self.ms
 
 
 @pytest.fixture
 def service():
     engine = ClusterEngine(ClusterSpec(n_shards=8, flows_per_shard=16,
                                        namespaces=4))
-    svc = EnvoyRlsService(engine, clock=_FixedClock(NOW0))
+    svc = EnvoyRlsService(engine, clock=ManualClock(start_ms=NOW0))
     svc.rules.load_rules([EnvoyRlsRule(domain="apis", descriptors=[
         RlsDescriptorRule(entries=[("generic_key", "checkout")], count=3),
         RlsDescriptorRule(entries=[("header_match", "mobile"),

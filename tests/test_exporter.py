@@ -72,3 +72,34 @@ def test_exporter_unregister_is_idempotent(sph):
     exp.close()
     exp.close()
     assert "sentinel_pass_qps" not in _scrape(registry)
+
+
+def test_breaker_states_dedup_per_resource(sph):
+    """Two rules on one resource must yield ONE breaker sample (duplicate
+    label sets would make Prometheus reject the whole scrape)."""
+    registry = CollectorRegistry()
+    exp = PrometheusExporter(sph, registry=registry)
+    try:
+        sph.load_degrade_rules([
+            stpu.DegradeRule(resource="svc", grade=stpu.GRADE_RT,
+                             count=50, time_window=10),
+            stpu.DegradeRule(resource="svc",
+                             grade=stpu.GRADE_EXCEPTION_RATIO,
+                             count=0.5, time_window=10),
+        ])
+        text = _scrape(registry)
+        assert text.count('sentinel_breaker_state{resource="svc"}') == 1
+    finally:
+        exp.close()
+
+
+def test_describe_avoids_collect_on_register(sph):
+    calls = []
+    orig = sph.all_node_totals
+    sph.all_node_totals = lambda: calls.append(1) or orig()
+    registry = CollectorRegistry()
+    exp = PrometheusExporter(sph, registry=registry)
+    try:
+        assert calls == []          # register used describe(), not collect()
+    finally:
+        exp.close()
