@@ -1,0 +1,32 @@
+"""Decorator demo (reference ``sentinel-demo-annotation-spring-aop``:
+@SentinelResource with blockHandler + fallback)."""
+
+import sentinel_tpu as stpu
+from sentinel_tpu.adapters import sentinel_resource
+from sentinel_tpu.core.clock import ManualClock
+
+
+def main() -> None:
+    clk = ManualClock(start_ms=1_785_000_000_000)
+    sph = stpu.Sentinel(stpu.load_config(max_resources=64, max_flow_rules=16,
+                                         max_degrade_rules=16,
+                                         max_authority_rules=16), clock=clk)
+    sph.load_flow_rules([stpu.FlowRule(resource="getUser", count=2)])
+
+    @sentinel_resource("getUser", sentinel=sph,
+                       block_handler=lambda uid, exc: {"id": uid,
+                                                      "from": "cache"},
+                       fallback=lambda uid, exc: {"id": uid,
+                                                  "from": "fallback"})
+    def get_user(uid: int) -> dict:
+        if uid < 0:
+            raise ValueError("bad id")
+        return {"id": uid, "from": "db"}
+
+    print([get_user(i) for i in range(4)])   # 2 from db, then blockHandler
+    clk.advance_ms(1000)                     # fresh second: not rate-limited
+    print(get_user(-1))                      # business error → fallback
+
+
+if __name__ == "__main__":
+    main()

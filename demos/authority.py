@@ -1,0 +1,36 @@
+"""Origin authority rules (reference AuthorityDemo: black/white lists keyed
+on the caller origin set via ContextUtil.enter)."""
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+
+
+def call(sph, origin: str) -> str:
+    try:
+        with stpu.ContextScope("entrance", origin=origin):
+            with sph.entry("admin-api"):
+                return "ok"
+    except stpu.AuthorityException:
+        return "denied"
+
+
+def main() -> None:
+    clk = ManualClock(start_ms=1_785_000_000_000)
+    sph = stpu.Sentinel(stpu.load_config(max_resources=64, max_flow_rules=16,
+                                         max_degrade_rules=16,
+                                         max_authority_rules=16), clock=clk)
+    sph.load_authority_rules([stpu.AuthorityRule(
+        resource="admin-api", limit_app="gateway,cron",
+        strategy=stpu.STRATEGY_WHITE)])
+    for origin in ("gateway", "cron", "random-svc"):
+        print(f"origin={origin!r}: {call(sph, origin)}")
+
+    sph.load_authority_rules([stpu.AuthorityRule(
+        resource="admin-api", limit_app="abuser",
+        strategy=stpu.STRATEGY_BLACK)])
+    for origin in ("abuser", "anyone-else"):
+        print(f"blacklist, origin={origin!r}: {call(sph, origin)}")
+
+
+if __name__ == "__main__":
+    main()

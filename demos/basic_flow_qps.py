@@ -1,0 +1,32 @@
+"""QPS flow limiting (reference ``sentinel-demo-basic`` FlowQpsDemo:
+20 QPS cap on "HelloWorld"; offered load far above it)."""
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+
+
+def main() -> None:
+    clk = ManualClock(start_ms=1_785_000_000_000)
+    sph = stpu.Sentinel(stpu.load_config(max_resources=64, max_flow_rules=16,
+                                         max_degrade_rules=16,
+                                         max_authority_rules=16), clock=clk)
+    sph.load_flow_rules([stpu.FlowRule(resource="HelloWorld", count=20)])
+
+    for second in range(3):
+        passed = blocked = 0
+        for _ in range(100):                 # 100 offered per second
+            try:
+                with sph.entry("HelloWorld"):
+                    passed += 1
+            except stpu.BlockException:
+                blocked += 1
+        print(f"second {second}: pass={passed} block={blocked}")
+        if second < 2:
+            clk.advance_ms(1000)
+
+    t = sph.node_totals("HelloWorld")      # still inside the last second
+    print("totals (rolling second):", t)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Concurrency (GRADE_THREAD) limiting (reference FlowThreadDemo: cap the
+number of in-flight calls rather than the rate)."""
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+
+
+def main() -> None:
+    clk = ManualClock(start_ms=1_785_000_000_000)
+    sph = stpu.Sentinel(stpu.load_config(max_resources=64, max_flow_rules=16,
+                                         max_degrade_rules=16,
+                                         max_authority_rules=16), clock=clk)
+    sph.load_flow_rules([stpu.FlowRule(resource="slow-io",
+                                       grade=stpu.GRADE_THREAD, count=3)])
+
+    held = []
+    admitted = 0
+    for i in range(6):
+        try:
+            held.append(sph.entry("slow-io"))
+            admitted += 1
+        except stpu.BlockException:
+            print(f"call {i}: blocked (3 already in flight)")
+    print(f"admitted={admitted} in-flight={sph.node_totals('slow-io')['threads']}")
+
+    for e in held:          # work completes → capacity returns
+        e.exit()
+    with sph.entry("slow-io"):
+        print("after exits: admitted again")
+
+
+if __name__ == "__main__":
+    main()

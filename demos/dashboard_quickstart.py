@@ -1,0 +1,65 @@
+"""Dashboard quick start (reference sentinel-dashboard README flow): start
+an agent + the dashboard, push a rule from the dashboard REST API, watch it
+enforce, then leave both up so you can open the UI in a browser.
+
+Run, then visit http://127.0.0.1:8080 (no password in this demo).
+"""
+
+import json
+import time
+import urllib.request
+
+import sentinel_tpu as stpu
+from sentinel_tpu.dashboard import Dashboard, DashboardServer
+from sentinel_tpu.transport import start_transport
+
+
+def main() -> None:
+    sph = stpu.Sentinel(stpu.load_config(max_resources=256,
+                                         max_flow_rules=32,
+                                         max_degrade_rules=32,
+                                         max_authority_rules=32))
+    dash = DashboardServer(Dashboard(password=""), host="127.0.0.1",
+                           port=8080)
+    dport = dash.start()
+    agent = start_transport(sph, host="0.0.0.0", port=8719,
+                            dashboard_addr=f"127.0.0.1:{dport}",
+                            heartbeat_interval_ms=2000)
+    print(f"dashboard: http://127.0.0.1:{dport}  agent command port: {agent.port}")
+    time.sleep(1.0)                         # first heartbeat lands
+
+    app = sph.cfg.app_name
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{dport}/v1/flow/rule", method="POST",
+        data=json.dumps({"app": app, "resource": "checkout",
+                         "count": 5.0}).encode(),
+        headers={"Content-Type": "application/json"})
+    print("push rule:", json.loads(urllib.request.urlopen(req).read())["success"])
+
+    passed = blocked = 0
+    for _ in range(20):
+        try:
+            with sph.entry("checkout"):
+                passed += 1
+        except stpu.BlockException:
+            blocked += 1
+    print(f"traffic under dashboard-pushed rule: pass={passed} block={blocked}")
+    print("press Ctrl-C to stop")
+    try:
+        while True:
+            for _ in range(3):
+                try:
+                    with sph.entry("checkout"):
+                        pass
+                except stpu.BlockException:
+                    pass
+            time.sleep(1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        agent.stop()
+        dash.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""Circuit breaking (reference ``sentinel-demo-degrade``: exception-ratio
+breaker opens under failures, rejects during the cooldown window, probes in
+HALF_OPEN, and closes again once the probe succeeds)."""
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+
+
+def call(sph, fail: bool) -> str:
+    try:
+        with sph.entry("backend") as e:
+            if fail:
+                exc = RuntimeError("backend 500")
+                e.trace(exc)
+                return "error"
+            return "ok"
+    except stpu.BlockException:
+        return "rejected"
+
+
+def main() -> None:
+    clk = ManualClock(start_ms=1_785_000_000_000)
+    sph = stpu.Sentinel(stpu.load_config(max_resources=64, max_flow_rules=16,
+                                         max_degrade_rules=16,
+                                         max_authority_rules=16), clock=clk)
+    sph.load_degrade_rules([stpu.DegradeRule(
+        resource="backend", grade=stpu.GRADE_EXCEPTION_RATIO,
+        count=0.5, time_window=5, min_request_amount=5,
+        stat_interval_ms=1000)])
+
+    print("failing backend:",
+          [call(sph, fail=True) for _ in range(6)])       # trips the breaker
+    print("breaker open:", [call(sph, fail=False) for _ in range(3)])
+    clk.advance_ms(5100)                                  # cooldown elapses
+    print("half-open probe + recovery:",
+          [call(sph, fail=False) for _ in range(3)])
+
+
+if __name__ == "__main__":
+    main()

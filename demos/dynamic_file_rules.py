@@ -1,0 +1,49 @@
+"""Dynamic rule reload from a file datasource (reference
+``sentinel-demo-dynamic-file-rule``: edit the JSON file → rules converge
+through the property pipeline without a restart)."""
+
+import json
+import tempfile
+from pathlib import Path
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.datasource import FileRefreshableDataSource, rule_converter
+
+
+def offered(sph, n=10) -> int:
+    ok = 0
+    for _ in range(n):
+        try:
+            with sph.entry("HelloWorld"):
+                ok += 1
+        except stpu.BlockException:
+            pass
+    return ok
+
+
+def main() -> None:
+    clk = ManualClock(start_ms=1_785_000_000_000)
+    sph = stpu.Sentinel(stpu.load_config(max_resources=64, max_flow_rules=16,
+                                         max_degrade_rules=16,
+                                         max_authority_rules=16), clock=clk)
+
+    path = Path(tempfile.mkdtemp()) / "flow-rules.json"
+    path.write_text(json.dumps([{"resource": "HelloWorld", "count": 3}]))
+
+    ds = FileRefreshableDataSource(str(path), rule_converter("flow"),
+                                   start_thread=False)
+    ds.get_property().add_listener(
+        lambda rules: sph.load_flow_rules(rules or []))
+
+    print("initial cap 3 →", offered(sph), "of 10 admitted")
+
+    path.write_text(json.dumps([{"resource": "HelloWorld", "count": 8}]))
+    ds.refresh_now()                      # poll loop does this every 3s
+    clk.advance_ms(1000)                  # fresh second
+    print("after file edit to 8 →", offered(sph), "of 10 admitted")
+    ds.close()
+
+
+if __name__ == "__main__":
+    main()

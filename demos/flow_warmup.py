@@ -1,0 +1,33 @@
+"""Warm-up (cold start) shaping (reference WarmUpFlowDemo: capacity ramps
+from count/coldFactor up to the full count over warm_up_period_sec, so a
+cold system isn't slammed by a burst)."""
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+
+
+def main() -> None:
+    clk = ManualClock(start_ms=1_785_000_000_000)
+    sph = stpu.Sentinel(stpu.load_config(max_resources=64, max_flow_rules=16,
+                                         max_degrade_rules=16,
+                                         max_authority_rules=16), clock=clk)
+    sph.load_flow_rules([stpu.FlowRule(
+        resource="warm", count=100,
+        control_behavior=stpu.BEHAVIOR_WARM_UP, warm_up_period_sec=10)])
+
+    # sustained load: pausing would let tokens refill and re-cool the ramp
+    for second in range(12):
+        passed = blocked = 0
+        for _ in range(120):
+            try:
+                with sph.entry("warm"):
+                    passed += 1
+            except stpu.BlockException:
+                blocked += 1
+        if second in (0, 2, 5, 8, 10, 11):
+            print(f"t={second:>2}s: admitted {passed:>3}/120 this second")
+        clk.advance_ms(1000)
+
+
+if __name__ == "__main__":
+    main()

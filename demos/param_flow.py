@@ -1,0 +1,35 @@
+"""Hot-parameter throttling (reference
+``sentinel-demo-parameter-flow-control``: per-key token buckets — a hot key
+is limited without starving the others; per-item overrides raise one VIP
+key's cap)."""
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+
+
+def main() -> None:
+    clk = ManualClock(start_ms=1_785_000_000_000)
+    sph = stpu.Sentinel(stpu.load_config(max_resources=64, max_flow_rules=16,
+                                         max_degrade_rules=16,
+                                         max_authority_rules=16), clock=clk)
+    sph.load_param_flow_rules([stpu.ParamFlowRule(
+        resource="query", param_idx=0, count=2,
+        param_flow_item_list=[
+            stpu.ParamFlowItem(object="vip-user", count=10,
+                               class_type="String")])])
+
+    results = {}
+    for user in ("alice", "bob", "vip-user"):
+        ok = 0
+        for _ in range(6):
+            try:
+                with sph.entry("query", args=(user,)):
+                    ok += 1
+            except stpu.BlockException:
+                pass
+        results[user] = ok
+    print("admitted per key (cap 2, vip override 10):", results)
+
+
+if __name__ == "__main__":
+    main()

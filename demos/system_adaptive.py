@@ -1,0 +1,34 @@
+"""System adaptive protection (reference ``sentinel-demo-system``:
+global inbound gates on QPS / concurrency / load, BBR-style)."""
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+
+
+def main() -> None:
+    clk = ManualClock(start_ms=1_785_000_000_000)
+    sph = stpu.Sentinel(stpu.load_config(max_resources=64, max_flow_rules=16,
+                                         max_degrade_rules=16,
+                                         max_authority_rules=16), clock=clk)
+    sph.load_system_rules([stpu.SystemRule(qps=10)])   # global inbound cap
+
+    passed = blocked = 0
+    for _ in range(25):
+        try:
+            with sph.entry("any-inbound", entry_type=stpu.ENTRY_TYPE_IN):
+                passed += 1
+        except stpu.SystemBlockException:
+            blocked += 1
+    print(f"inbound QPS gate 10: pass={passed} block={blocked}")
+
+    # outbound traffic is exempt (EntryType.OUT skips SystemSlot)
+    out_ok = 0
+    for _ in range(5):
+        with sph.entry("outbound-call", entry_type=stpu.ENTRY_TYPE_OUT):
+            out_ok += 1
+    print(f"outbound exempt from system rules: {out_ok}/5 passed")
+    print("system status:", sph.system_status())
+
+
+if __name__ == "__main__":
+    main()

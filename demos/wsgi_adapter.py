@@ -1,0 +1,30 @@
+"""WSGI middleware demo (reference servlet CommonFilter demos): any WSGI
+app gains flow control without code changes; blocked requests get 429."""
+
+from wsgiref.simple_server import make_server
+
+import sentinel_tpu as stpu
+from sentinel_tpu.adapters import SentinelWSGIMiddleware
+
+
+def app(environ, start_response):
+    start_response("200 OK", [("Content-Type", "text/plain")])
+    return [b"hello from the app\n"]
+
+
+def main() -> None:
+    sph = stpu.Sentinel(stpu.load_config(max_resources=64, max_flow_rules=16,
+                                         max_degrade_rules=16,
+                                         max_authority_rules=16))
+    sph.load_flow_rules([stpu.FlowRule(resource="GET:/", count=5)])
+    guarded = SentinelWSGIMiddleware(app, sph)
+
+    with make_server("127.0.0.1", 8000, guarded) as srv:
+        print("serving on http://127.0.0.1:8000 — try "
+              "`for i in $(seq 10); do curl -s -o /dev/null -w '%{http_code} ' "
+              "http://127.0.0.1:8000/; done` (expect five 200s then 429s)")
+        srv.serve_forever()
+
+
+if __name__ == "__main__":
+    main()
