@@ -136,3 +136,24 @@ class OriginRegistry(Registry):
 
     def __init__(self, capacity: int):
         super().__init__(capacity, reserved=("",))
+
+
+def make_registry(capacity: int, reserved: Iterable[str] = ()):
+    """Registry factory: the C++ table when buildable (g++, cached .so),
+    else the pure-Python implementation — identical semantics either way.
+    ``SENTINEL_TPU_NATIVE=0`` forces Python."""
+    try:
+        from sentinel_tpu.native import NativeRegistry, native_available
+        if native_available():
+            return NativeRegistry(capacity, reserved)
+    except Exception:
+        pass
+    return Registry(capacity, reserved)
+
+
+def make_resource_registry(capacity: int):
+    return make_registry(capacity, reserved=(ENTRY_NODE_NAME,))
+
+
+def make_origin_registry(capacity: int):
+    return make_registry(capacity, reserved=("",))

@@ -1,0 +1,115 @@
+"""Pallas TPU kernels for the streaming counter update (the hot op).
+
+The engine's per-batch counter update is a high-fan-in scatter-add: N events
+→ ``counters[K, E]`` (hot-param key tables, cluster per-flow tables, and —
+tiled over row blocks — the main ``[R, B, E]`` tensor). XLA lowers scatter
+on TPU to a serialized loop; the TPU-native formulation is **one-hot matmul
+accumulation on the MXU**::
+
+    counters[K, E] += onehot(keys)[N, K]ᵀ · (onehot(events)[N, E] · amounts)
+
+This kernel tiles K across the grid, builds both one-hots in VMEM per tile,
+and accumulates with ``jnp.dot`` — no atomics, no serialization, deterministic
+(the reference's LongAdder striping solves contention on the JVM; the MXU
+formulation removes contention entirely, SURVEY §2.8.1 → §7 Phase 1).
+
+On CPU (tests, virtual mesh) the kernel runs in interpret mode; callers can
+also use :func:`scatter_add_xla` (same semantics, ``.at[].add``) — the
+engine picks per backend.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# lane width: last-dim tiles are 128 on TPU
+_LANE = 128
+
+
+def scatter_add_xla(counters: jnp.ndarray, keys: jnp.ndarray,
+                    events: jnp.ndarray,
+                    amounts: jnp.ndarray) -> jnp.ndarray:
+    """Reference semantics: ``counters[K, E] += Σ`` over the event stream.
+    Out-of-range keys (>= K, e.g. padding) are dropped."""
+    return counters.at[keys, events].add(amounts, mode="drop")
+
+
+def _tile_kernel(keys_ref, events_ref, amounts_ref, counters_ref, out_ref,
+                 *, tile_k: int, num_events: int):
+    """One grid step owns rows [t*tile_k, (t+1)*tile_k) of the counter table.
+
+    one_hot_k: [N, tile_k]  — event i hits local key column (keys[i] - base)
+    one_hot_e: [N, E]       — event i's event lane, scaled by amounts[i]
+    partial = one_hot_kᵀ @ one_hot_e  → [tile_k, E] on the MXU.
+    """
+    t = pl.program_id(0)
+    base = t * tile_k
+    keys = keys_ref[:]                       # [N]
+    events = events_ref[:]                   # [N]
+    amounts = amounts_ref[:]                 # [N]
+    n = keys.shape[0]
+
+    local = keys - base                      # [N]
+    in_tile = (local >= 0) & (local < tile_k)
+    local = jnp.where(in_tile, local, 0)
+
+    col_k = jax.lax.broadcasted_iota(jnp.int32, (n, tile_k), 1)
+    one_hot_k = ((col_k == local[:, None]) & in_tile[:, None])
+
+    col_e = jax.lax.broadcasted_iota(jnp.int32, (n, num_events), 1)
+    one_hot_e = jnp.where(col_e == events[:, None],
+                          amounts[:, None], 0)
+
+    partial = jnp.dot(one_hot_k.astype(jnp.float32).T,
+                      one_hot_e.astype(jnp.float32),
+                      preferred_element_type=jnp.float32)
+    out_ref[:, :] = counters_ref[:, :] + partial.astype(counters_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def scatter_add_pallas(counters: jnp.ndarray, keys: jnp.ndarray,
+                       events: jnp.ndarray, amounts: jnp.ndarray,
+                       *, interpret: bool = False) -> jnp.ndarray:
+    """MXU scatter-add: ``counters[K, E] += stream``. K must be a multiple
+    of the tile (pad the table, harmless); out-of-range keys are dropped
+    because no tile claims them."""
+    orig_k, e = counters.shape
+    tile_k = min(orig_k, 512)
+    k = ((orig_k + tile_k - 1) // tile_k) * tile_k
+    if k != orig_k:
+        # pad the table to a tile multiple and route any out-of-range key
+        # (padding convention: key >= orig_k) past the padded rows too
+        counters = jnp.pad(counters, ((0, k - orig_k), (0, 0)))
+        keys = jnp.where(keys < orig_k, keys, k)
+    grid = (k // tile_k,)
+
+    kernel = functools.partial(_tile_kernel, tile_k=tile_k, num_events=e)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(keys.shape, lambda t: (0,)),       # whole stream
+            pl.BlockSpec(events.shape, lambda t: (0,)),
+            pl.BlockSpec(amounts.shape, lambda t: (0,)),
+            pl.BlockSpec((tile_k, e), lambda t: (t, 0)),    # my tile
+        ],
+        out_specs=pl.BlockSpec((tile_k, e), lambda t: (t, 0)),
+        out_shape=jax.ShapeDtypeStruct(counters.shape, counters.dtype),
+        interpret=interpret,
+    )(keys, events, amounts, counters)
+    return out[:orig_k] if k != orig_k else out
+
+
+
+def scatter_add(counters: jnp.ndarray, keys: jnp.ndarray,
+                events: jnp.ndarray, amounts: jnp.ndarray) -> jnp.ndarray:
+    """Backend dispatch: the Pallas MXU kernel on TPU, XLA scatter elsewhere
+    (interpret-mode Pallas is for tests, not production CPU)."""
+    platform = jax.devices()[0].platform
+    if platform == "tpu":
+        return scatter_add_pallas(counters, keys, events, amounts)
+    return scatter_add_xla(counters, keys, events, amounts)

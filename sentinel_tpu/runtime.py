@@ -43,6 +43,7 @@ from sentinel_tpu.core import errors as err_mod
 from sentinel_tpu.core.property import SentinelProperty
 from sentinel_tpu.core.registry import (
     ENTRY_NODE_ROW, OriginRegistry, Registry, ResourceRegistry,
+    make_origin_registry, make_registry, make_resource_registry,
 )
 from sentinel_tpu.engine.pipeline import (
     EngineSpec, EntryBatch, ExitBatch, RuleSet, SentinelState, Verdicts,
@@ -196,9 +197,11 @@ class Sentinel:
         self.clock = clock or global_clock()
         cfg = self.cfg
 
-        self.resources = ResourceRegistry(cfg.max_resources)
-        self.origins = OriginRegistry(cfg.max_origins)
-        self.contexts = Registry(2048, reserved=("sentinel_default_context",))
+        # factories pick the native C++ interning table when buildable
+        self.resources = make_resource_registry(cfg.max_resources)
+        self.origins = make_origin_registry(cfg.max_origins)
+        self.contexts = make_registry(2048,
+                                      reserved=("sentinel_default_context",))
 
         self.spec = EngineSpec(
             rows=cfg.max_resources,
@@ -506,8 +509,13 @@ class Sentinel:
                     prioritized: Optional[Sequence[bool]] = None,
                     args_list: Optional[Sequence[Sequence]] = None) -> Verdicts:
         n = len(resources)
-        rows = np.fromiter((self.resources.get_or_create(r) for r in resources),
-                           np.int32, count=n)
+        batch_intern = getattr(self.resources, "get_or_create_batch", None)
+        if batch_intern is not None:      # native table: one FFI call, no GIL
+            rows = batch_intern(resources)
+        else:
+            rows = np.fromiter(
+                (self.resources.get_or_create(r) for r in resources),
+                np.int32, count=n)
         param_rules = param_keys = None
         param_gen = -1
         with self._lock:
