@@ -10,3 +10,6 @@ from sentinel_tpu.datasource.registry import (  # noqa: F401
     WritableDataSourceRegistry, default_registry,
 )
 from sentinel_tpu.datasource.converters import rule_converter, rule_encoder  # noqa: F401
+from sentinel_tpu.datasource.http import (  # noqa: F401
+    HttpLongPollDataSource, HttpRefreshableDataSource, InProcessDataSource,
+)

@@ -1,0 +1,124 @@
+"""HTTP datasources against an in-process server: conditional-GET pull,
+long-poll index handoff, and the in-process push source (reference pull/push
+datasource behaviors, SURVEY §2.2/§3.5)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from sentinel_tpu.datasource import (
+    HttpLongPollDataSource, HttpRefreshableDataSource, InProcessDataSource,
+    rule_converter,
+)
+from sentinel_tpu.rules.flow import FlowRule
+
+
+class _ConfigHandler(BaseHTTPRequestHandler):
+    state = {"body": "[]", "etag": "v1", "index": "1",
+             "requests": [], "hold": None}
+
+    def do_GET(self):  # noqa: N802
+        st = self.state
+        st["requests"].append(self.path)
+        if st["hold"]:
+            st["hold"].wait(2.0)
+        if self.headers.get("If-None-Match") == st["etag"]:
+            self.send_response(304)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        body = st["body"].encode()
+        self.send_response(200)
+        self.send_header("ETag", st["etag"])
+        self.send_header("X-Consul-Index", st["index"])
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):
+        pass
+
+
+@pytest.fixture
+def server():
+    _ConfigHandler.state = {"body": "[]", "etag": "v1", "index": "1",
+                            "requests": [], "hold": None}
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _ConfigHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv, _ConfigHandler.state
+    srv.shutdown()
+    srv.server_close()
+
+
+def _flow_json(count):
+    return json.dumps([{"resource": "r", "count": count}])
+
+
+def test_http_pull_updates_only_on_change(server):
+    srv, state = server
+    state["body"] = _flow_json(3)
+    url = f"http://127.0.0.1:{srv.server_address[1]}/rules"
+    ds = HttpRefreshableDataSource(url, rule_converter("flow"),
+                                   start_thread=False)
+    try:
+        rules = ds.get_property().get()
+        assert isinstance(rules[0], FlowRule) and rules[0].count == 3
+
+        # unchanged content (304 via ETag): no property update
+        assert ds.refresh_now() is False
+
+        seen = []
+        ds.get_property().add_listener(lambda v: seen.append(v))
+        state["body"] = _flow_json(9)
+        state["etag"] = "v2"
+        assert ds.refresh_now() is True
+        assert seen[-1][0].count == 9
+    finally:
+        ds.close()
+
+
+def test_http_pull_survives_server_error(server):
+    srv, state = server
+    url = f"http://127.0.0.1:{srv.server_address[1] + 1}/unreachable"
+    ds = HttpRefreshableDataSource(url, rule_converter("flow"),
+                                   start_thread=False, timeout_s=0.3)
+    try:
+        assert ds.refresh_now() is False      # logged, not raised
+        assert ds.get_property().get() is None
+    finally:
+        ds.close()
+
+
+def test_long_poll_passes_index(server):
+    srv, state = server
+    state["body"] = _flow_json(1)
+    url = f"http://127.0.0.1:{srv.server_address[1]}/watch"
+    ds = HttpLongPollDataSource(url, rule_converter("flow"),
+                                start_thread=False)
+    try:
+        assert ds.get_property().get()[0].count == 1
+        state["index"] = "42"
+        state["etag"] = "v2"
+        state["body"] = _flow_json(2)
+        ds.refresh_now()
+        # the follow-up request carried the blocking-query index
+        assert any("index=1" in p and "wait=" in p
+                   for p in state["requests"])
+        assert ds.get_property().get()[0].count == 2
+    finally:
+        ds.close()
+
+
+def test_in_process_push():
+    ds = InProcessDataSource(rule_converter("flow"))
+    seen = []
+    ds.get_property().add_listener(lambda v: seen.append(v))
+    ds.push(_flow_json(7))
+    assert seen[-1][0].count == 7
+    # pushing identical rules doesn't refire (property only fires on change)
+    n = len(seen)
+    ds.push(_flow_json(7))
+    assert len(seen) == n
