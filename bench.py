@@ -111,21 +111,22 @@ def main() -> None:
     def scalars(i):
         now = t0_ms + i * 2  # 2 ms per step → windows rotate during the run
         return (jnp.int32(spec.second.index_of(now)), jnp.int32(0),
-                jnp.int32(now - t0_ms))
+                jnp.int32(now - t0_ms),
+                jnp.int32(now % spec.second.win_ms))
 
     print(f"bench: R={R} B={B} steps={STEPS} on {jax.devices()[0]}",
           file=sys.stderr)
     for i in range(WARMUP):
-        idx_s, idx_m, rel = scalars(i)
+        idx_s, idx_m, rel, in_win = scalars(i)
         state, verdicts = step(ruleset, state, batches[i % n_batches],
-                               idx_s, idx_m, rel, load1, cpu)
+                               idx_s, idx_m, rel, load1, cpu, in_win)
     jax.block_until_ready(state)
 
     start = time.perf_counter()
     for i in range(STEPS):
-        idx_s, idx_m, rel = scalars(WARMUP + i)
+        idx_s, idx_m, rel, in_win = scalars(WARMUP + i)
         state, verdicts = step(ruleset, state, batches[i % n_batches],
-                               idx_s, idx_m, rel, load1, cpu)
+                               idx_s, idx_m, rel, load1, cpu, in_win)
     jax.block_until_ready((state, verdicts))
     elapsed = time.perf_counter() - start
 

@@ -48,10 +48,12 @@ class HttpRefreshableDataSource(AutoRefreshDataSource[str, T]):
         try:
             with urllib.request.urlopen(self._request(),
                                         timeout=self.timeout_s) as r:
+                body = r.read().decode("utf-8")
+                # commit validators only after the body arrived intact — a
+                # failed read must not pin future polls to 304/stale-body
                 self._etag = r.headers.get("ETag") or self._etag
                 self._last_modified = (r.headers.get("Last-Modified")
                                        or self._last_modified)
-                body = r.read().decode("utf-8")
                 self._last_body = body
                 return body
         except urllib.error.HTTPError as exc:
@@ -67,6 +69,10 @@ class HttpRefreshableDataSource(AutoRefreshDataSource[str, T]):
         try:
             before = self._last_body
             body = self.read_source()
+            # a blocking read (long-poll) can outlive close(): a response
+            # arriving after stop must not fire listeners
+            if self._stop.is_set():
+                return False
             if body == before:
                 return False
             return self.property.update_value(self.converter(body))
@@ -106,7 +112,6 @@ class HttpLongPollDataSource(HttpRefreshableDataSource[T]):
             self._last_body = body
             return body
 
-
 class InProcessDataSource(AutoRefreshDataSource[object, T]):
     """Push source for embedding apps (reference push datasources collapse
     to this when the transport is in-process): call :meth:`push` with the
@@ -116,7 +121,8 @@ class InProcessDataSource(AutoRefreshDataSource[object, T]):
     def __init__(self, converter: Converter, initial=None):
         self._value = initial
         super().__init__(converter, refresh_ms=3_600_000, start_thread=False)
-        self.initialize()
+        if initial is not None:      # no spurious converter(None) at init
+            self.initialize()
 
     def read_source(self):
         return self._value

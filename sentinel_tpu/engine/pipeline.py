@@ -57,6 +57,7 @@ class EngineSpec:
     statistic_max_rt: int
     param_keys: int = 0       # PK — hot-key rows (0 = param flow disabled)
     param_pairs: int = 0      # PV — (rule, value) checks per event
+    occupy_timeout_ms: int = 500   # OccupyTimeoutProperty default (0 = off)
 
 
 class SentinelState(NamedTuple):
@@ -100,6 +101,10 @@ class EntryBatch(NamedTuple):
     valid: jnp.ndarray          # bool[B]
     param_rules: Optional[jnp.ndarray] = None   # int32[B, PV] (param slot off: None)
     param_keys: Optional[jnp.ndarray] = None    # int32[B, PV]
+    # events whose cluster token request failed and whose rule says
+    # fallbackToLocalWhenFail: their cluster-mode rules check LOCALLY
+    # (FlowRuleChecker.fallbackToLocalOrPass); None = all False
+    cluster_fallback: Optional[jnp.ndarray] = None   # bool[B]
 
 
 class ExitBatch(NamedTuple):
@@ -130,10 +135,25 @@ def init_state(spec: EngineSpec, nf: int, nd: int) -> SentinelState:
         alt_second=init_window(spec.second, spec.alt_rows),
         threads=jnp.zeros((spec.rows,), jnp.int32),
         alt_threads=jnp.zeros((spec.alt_rows,), jnp.int32),
-        flow_dyn=flow_mod.init_flow_dyn(nf),
+        flow_dyn=flow_mod.init_flow_dyn(nf, spec.second.buckets, spec.rows),
         breakers=deg_mod.init_breaker_state(nd),
         param_dyn=pf_mod.init_param_dyn(spec.param_keys),
     )
+
+
+def _stat_targets(spec: EngineSpec, rows, origin_rows, chain_rows, valid,
+                  is_in):
+    """Recording target rows shared by entry/block recorders: the event row
+    + the global ENTRY row (IN only) in the main table, the origin + chain
+    rows in the alt table; padding = one-past-the-end (dropped scatters)."""
+    pad_r = jnp.int32(spec.rows)
+    pad_a = jnp.int32(spec.alt_rows)
+    main_rows = jnp.where(valid, rows, pad_r)
+    entry_rows = jnp.where(valid & is_in, jnp.int32(ENTRY_NODE_ROW), pad_r)
+    alt_o = jnp.where(valid, origin_rows, pad_a)
+    alt_c = jnp.where(valid, chain_rows, pad_a)
+    return (jnp.concatenate([main_rows, entry_rows]),
+            jnp.concatenate([alt_o, alt_c]))
 
 
 def decide_entries(
@@ -146,6 +166,7 @@ def decide_entries(
     rel_now_ms: jnp.ndarray,
     load1: jnp.ndarray,
     cpu_usage: jnp.ndarray,
+    in_win_ms: Optional[jnp.ndarray] = None,   # now % second-win (occupy)
 ) -> Tuple[SentinelState, Verdicts]:
     """One device step: decide a batch, then record post-decision statistics."""
     R = spec.rows
@@ -178,22 +199,31 @@ def decide_entries(
         param_ok = jnp.ones_like(live2)
         param_wait = jnp.zeros(live2.shape, jnp.int32)
 
+    cl_fb = (batch.cluster_fallback if batch.cluster_fallback is not None
+             else jnp.zeros_like(batch.valid))
     fview = flow_mod.FlowBatchView(
         rows=batch.rows, origin_ids=batch.origin_ids,
         origin_rows=batch.origin_rows, context_ids=batch.context_ids,
-        chain_rows=batch.chain_rows, acquire=batch.acquire, valid=live2)
-    flow_dyn, flow_ok, wait_ms = flow_mod.flow_check(
+        chain_rows=batch.chain_rows, acquire=batch.acquire, valid=live2,
+        prioritized=batch.prioritized, cluster_fallback=cl_fb)
+    flow_dyn, flow_ok, wait_ms, occupied = flow_mod.flow_check(
         rules.flow_table, state.flow_dyn, rules.flow_idx, spec.second,
         state.second, state.alt_second, state.threads, state.alt_threads,
         fview, now_idx_s, rel_now_ms,
         minute_spec=spec.minute,
         main_minute=state.minute if spec.minute else None,
-        now_idx_m=now_idx_m)
+        now_idx_m=now_idx_m,
+        in_win_ms=in_win_ms,
+        occupy_timeout_ms=spec.occupy_timeout_ms)
     live3 = live2 & flow_ok
 
+    # occupied (PriorityWait) events bypass the degrade slot entirely —
+    # in the reference the PriorityWaitException aborts the slot chain
+    # before DegradeSlot.entry runs, and the booking is already committed
     breakers, deg_ok = deg_mod.degrade_entry_check(
-        rules.deg_table, state.breakers, rules.deg_idx, batch.rows, live3,
-        rel_now_ms)
+        rules.deg_table, state.breakers, rules.deg_idx, batch.rows,
+        live3 & ~occupied, rel_now_ms)
+    deg_ok = deg_ok | occupied
 
     allow = live & auth_ok & sys_ok & param_ok & flow_ok & deg_ok
     reason = jnp.zeros(batch.rows.shape, jnp.int8)
@@ -208,25 +238,32 @@ def decide_entries(
     # ---- StatisticSlot.entry (post-decision recording) ----
     passed = allow & batch.valid
     blocked = ~allow & batch.valid
+    # occupied (PriorityWait) entries don't count PASS now — their pass
+    # belongs to the next window (virtual booking in flow dyn state); they
+    # still hold a thread and show up as OCCUPIED_PASS in this second's
+    # metrics (half-a-window earlier than the reference's landing-time
+    # accounting; admission math is unaffected)
+    pass_now = passed & ~occupied
+    occupied = occupied & passed      # occupied implies admitted; belt-and-
+    # braces so a blocked event can never record OCCUPIED_PASS
     pad_r = jnp.int32(R)
     pad_a = jnp.int32(RA)
 
-    # target rows: event row, ENTRY row (IN only), origin row, chain row
-    main_rows = jnp.where(batch.valid, batch.rows, pad_r)
-    entry_rows = jnp.where(batch.valid & batch.is_in,
-                           jnp.int32(ENTRY_NODE_ROW), pad_r)
-    alt_o = jnp.where(batch.valid, batch.origin_rows, pad_a)
-    alt_c = jnp.where(batch.valid, batch.chain_rows, pad_a)
-
-    main_targets = jnp.concatenate([main_rows, entry_rows])
-    alt_targets = jnp.concatenate([alt_o, alt_c])
+    main_targets, alt_targets = _stat_targets(
+        spec, batch.rows, batch.origin_rows, batch.chain_rows, batch.valid,
+        batch.is_in)
     pass2 = jnp.concatenate([passed, passed])
+    pass_now2 = jnp.concatenate([pass_now, pass_now])
+    occ2 = jnp.concatenate([occupied, occupied])
     acq2 = jnp.concatenate([batch.acquire, batch.acquire])
-    pass_amt = jnp.where(pass2, acq2, 0)
+    pass_amt = jnp.where(pass_now2, acq2, 0)
+    occ_amt = jnp.where(occ2, acq2, 0)
     block_amt = jnp.where(jnp.concatenate([blocked, blocked]), acq2, 0)
 
     second = refresh_rows(spec.second, state.second, main_targets, now_idx_s)
     second = add_rows(spec.second, second, main_targets, ev.PASS, pass_amt, now_idx_s)
+    second = add_rows(spec.second, second, main_targets, ev.OCCUPIED_PASS,
+                      occ_amt, now_idx_s)
     second = add_rows(spec.second, second, main_targets, ev.BLOCK, block_amt, now_idx_s)
 
     alt_second = refresh_rows(spec.second, state.alt_second, alt_targets, now_idx_s)
@@ -237,6 +274,8 @@ def decide_entries(
     if spec.minute:
         minute = refresh_rows(spec.minute, state.minute, main_targets, now_idx_m)
         minute = add_rows(spec.minute, minute, main_targets, ev.PASS, pass_amt, now_idx_m)
+        minute = add_rows(spec.minute, minute, main_targets, ev.OCCUPIED_PASS,
+                          occ_amt, now_idx_m)
         minute = add_rows(spec.minute, minute, main_targets, ev.BLOCK, block_amt, now_idx_m)
 
     thr_amt = jnp.where(pass2, 1, 0)  # +1 per entry (reference curThreadNum)
@@ -327,6 +366,41 @@ def record_exits(
         second=second, minute=minute, alt_second=alt_second,
         threads=threads, alt_threads=alt_threads,
         flow_dyn=state.flow_dyn, breakers=breakers, param_dyn=param_dyn)
+
+
+def record_blocks(
+    spec: EngineSpec,
+    state: SentinelState,
+    rows: jnp.ndarray,
+    origin_rows: jnp.ndarray,
+    chain_rows: jnp.ndarray,
+    acquire: jnp.ndarray,
+    is_in: jnp.ndarray,
+    valid: jnp.ndarray,
+    now_idx_s: jnp.ndarray,
+    now_idx_m: jnp.ndarray,
+) -> SentinelState:
+    """Record BLOCK events decided OUTSIDE the local pipeline (cluster token
+    denials: the reference's StatisticSlot counts a cluster BLOCKED like any
+    other BlockException)."""
+    main_targets, alt_targets = _stat_targets(
+        spec, rows, origin_rows, chain_rows, valid, is_in)
+    amt = jnp.where(valid, acquire, 0)
+    amt2 = jnp.concatenate([amt, amt])
+    second = refresh_rows(spec.second, state.second, main_targets, now_idx_s)
+    second = add_rows(spec.second, second, main_targets, ev.BLOCK, amt2,
+                      now_idx_s)
+    alt_second = refresh_rows(spec.second, state.alt_second, alt_targets,
+                              now_idx_s)
+    alt_second = add_rows(spec.second, alt_second, alt_targets, ev.BLOCK,
+                          amt2, now_idx_s)
+    minute = state.minute
+    if spec.minute:
+        minute = refresh_rows(spec.minute, state.minute, main_targets,
+                              now_idx_m)
+        minute = add_rows(spec.minute, minute, main_targets, ev.BLOCK, amt2,
+                          now_idx_m)
+    return state._replace(second=second, alt_second=alt_second, minute=minute)
 
 
 def invalidate_resource_rows(spec: EngineSpec, state: SentinelState,

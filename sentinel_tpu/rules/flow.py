@@ -129,6 +129,16 @@ class FlowDynState(NamedTuple):
     latest_passed_ms: jnp.ndarray   # int32[NF+1] — rel-ms pacing clock
     stored_tokens: jnp.ndarray      # float32[NF+1]
     last_filled_sec: jnp.ndarray    # int32[NF+1] — rel seconds
+    # occupy ("borrow-from-future", OccupiableBucketLeapArray rebuilt as
+    # virtual bookings keyed by RESOURCE ROW — shared by every rule on the
+    # node like the reference's future buckets): slot s holds tokens booked
+    # for window occupied_window[r, s]; a booking keeps counting toward the
+    # rolling admission sum for B windows after it lands. A booking made at
+    # W targets W+1 and stays live through W+B, so B+1 consecutive windows
+    # can hold live bookings — the slot ring has B+1 slots (window mod B+1)
+    # so a new booking never clobbers a live one.
+    occupied_count: jnp.ndarray     # float32[R, B+1]
+    occupied_window: jnp.ndarray    # int32[R, B+1]
 
 
 class CompiledFlowRules(NamedTuple):
@@ -140,11 +150,14 @@ class CompiledFlowRules(NamedTuple):
     num_active: int
 
 
-def init_flow_dyn(nf: int) -> FlowDynState:
+def init_flow_dyn(nf: int, buckets: int = 2, rows: int = 1) -> FlowDynState:
     return FlowDynState(
         latest_passed_ms=jnp.full((nf + 1,), -(2 ** 30), jnp.int32),
         stored_tokens=jnp.zeros((nf + 1,), jnp.float32),
         last_filled_sec=jnp.full((nf + 1,), -(2 ** 30), jnp.int32),
+        occupied_count=jnp.zeros((rows, buckets + 1), jnp.float32),
+        occupied_window=jnp.full((rows, buckets + 1), -(2 ** 30),
+                                 jnp.int32),
     )
 
 
@@ -266,6 +279,8 @@ class FlowBatchView(NamedTuple):
     chain_rows: jnp.ndarray    # int32[B] alt-table row, >= RA when absent
     acquire: jnp.ndarray       # int32[B]
     valid: jnp.ndarray         # bool[B]
+    prioritized: jnp.ndarray   # bool[B] — entryWithPriority (occupy eligible)
+    cluster_fallback: jnp.ndarray  # bool[B] — enable cluster rules locally
 
 
 def flow_check(
@@ -283,11 +298,17 @@ def flow_check(
     minute_spec: Optional[WindowSpec] = None,
     main_minute: Optional[WindowState] = None,
     now_idx_m: Optional[jnp.ndarray] = None,
-) -> Tuple[FlowDynState, jnp.ndarray, jnp.ndarray]:
-    """→ (dyn', allow bool[B], wait_ms int32[B]).
+    in_win_ms: Optional[jnp.ndarray] = None,   # int32 scalar, now % win_ms
+    occupy_timeout_ms: int = 500,
+) -> Tuple[FlowDynState, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """→ (dyn', allow bool[B], wait_ms int32[B], occupied bool[B]).
 
     ``allow[i]`` False means blocked by some flow rule. ``wait_ms`` > 0 with
     ``allow`` True = rate-limiter pass-after-wait (host SDK sleeps).
+    ``occupied[i]`` True = prioritized event admitted by borrowing from the
+    NEXT window (``tryOccupyNext`` → ``PriorityWaitException``): the caller
+    sleeps ``wait_ms`` and the pass is accounted to the future window — the
+    recorder must log OCCUPIED_PASS, not PASS, for these events.
     """
     B = batch.rows.shape[0]
     K = rule_idx.shape[1]
@@ -315,6 +336,11 @@ def flow_check(
     app_specific = lim == origin_bk
     app_other = (lim == LIMIT_OTHER) & (~specific_hit_bk) & (origin_bk != 0)
     applicable = act & (app_default | app_specific | app_other)
+    # cluster-mode rules are enforced by the token server, not locally —
+    # EXCEPT for events whose token request failed with fallbackToLocal
+    # (FlowRuleChecker.passClusterCheck / fallbackToLocalOrPass)
+    applicable = applicable & (
+        ~table.cluster_mode[rj] | jnp.repeat(batch.cluster_fallback, K))
     # CHAIN additionally requires the event's context to match refResource
     kind = table.sel_kind[rj]
     applicable = applicable & jnp.where(
@@ -369,8 +395,32 @@ def flow_check(
     starts = seg.segment_starts(rj_s, row_s)
     leader = seg.segment_leader_index(starts)
 
+    # --- occupy bookings (virtual OccupiableBucketLeapArray) ---
+    # bookings are keyed by resource ROW (shared by all rules on the node,
+    # like the reference's future buckets). Landed bookings (window already
+    # reached) count toward the rolling admission sum for B windows,
+    # exactly as seeded borrowed PASS would.
+    occ_cnt = dyn.occupied_count             # [R, S]
+    occ_win = dyn.occupied_window            # [R, S]
+    safe_main_occ = jnp.minimum(sel_main_row, R - 1)
+    occ_age_bk = now_idx_s - occ_win[safe_main_occ]          # [BK, S]
+    occ_cnt_bk = occ_cnt[safe_main_occ]                      # [BK, S]
+    landed_bk = jnp.sum(
+        jnp.where((occ_age_bk >= 0) & (occ_age_bk < spec.buckets),
+                  occ_cnt_bk, 0.0), axis=1)
+    # bookings that will still be live in the NEXT window (pending or
+    # recently landed) — the budget already spoken for when occupying more
+    nextw_bk = jnp.sum(
+        jnp.where((occ_age_bk >= -1) & (occ_age_bk < spec.buckets - 1),
+                  occ_cnt_bk, 0.0), axis=1)
+    # only main-row selections see bookings (occupy is main-row-only)
+    no_book = use_alt | (sel_main_row >= R)
+    landed_bk = jnp.where(no_book, 0.0, landed_bk)
+    nextw_bk = jnp.where(no_book, 0.0, nextw_bk)
+
     grade_s = table.grade[rj_s]
-    base_s = jnp.where(grade_s == GRADE_QPS, cur_pass[order], cur_thr[order])
+    base_s = jnp.where(grade_s == GRADE_QPS,
+                       cur_pass[order] + landed_bk[order], cur_thr[order])
     limit_s = eff_limit[order]
     behavior_s = table.behavior[rj_s]
 
@@ -402,10 +452,78 @@ def flow_check(
         # zero-count rate limiter blocks everything (count<=0 → block)
         pass_rl_s = pass_rl_s & (table.count[rj_s] > 0)
 
-    pair_pass_s = jnp.where(is_rl, pass_rl_s, pass_default_s)
+    # --- occupy attempt (tryOccupyNext, DefaultController prioritized path) ---
+    # A denied prioritized request may pre-book the NEXT window when the pass
+    # count surviving into it (current bucket + live bookings) leaves room
+    # under the threshold, and the wait fits OccupyTimeout (default 500 ms).
     inapplicable_s = rj_s == NF
+    if in_win_ms is not None and occupy_timeout_ms > 0:
+        wait_next = (jnp.int32(spec.win_ms) - in_win_ms).astype(jnp.int32)
+        can_time = wait_next <= occupy_timeout_ms
+        # passes that SURVIVE into window now+1: every bucket whose stamp
+        # is within the last B-1 windows (0 <= now - stamp <= B-2) — the
+        # oldest live bucket expires at the edge, the rest carry over
+        safe_main = jnp.minimum(sel_main_row, R - 1)
+        srow_stamps = main_second.stamps[safe_main]            # [BK, B]
+        sdelta = now_idx_s - srow_stamps
+        survive_mask = (sdelta >= 0) & (sdelta <= spec.buckets - 2)
+        surviving_bk = jnp.sum(
+            jnp.where(survive_mask,
+                      main_second.counters[safe_main, :, ev.PASS], 0),
+            axis=1).astype(jnp.float32)
+        prio_s = jnp.repeat(batch.prioritized, K)[order]
+        eligible_s = (prio_s & (grade_s == GRADE_QPS)
+                      & (behavior_s == BEHAVIOR_DEFAULT)
+                      & ~pass_default_s & ~inapplicable_s
+                      & ~use_alt[order] & can_time)
+        occ_base_s = surviving_bk[order] + nextw_bk[order]
+        occ_amt_s = jnp.where(eligible_s, acq_s, 0.0)
+        occ_admit_s = seg.greedy_admit(occ_base_s, occ_amt_s, limit_s,
+                                       starts, leader) & eligible_s
+
+        # event-level gate BEFORE committing bookings: a booking is only
+        # real if the whole event is admitted by the flow slot — every
+        # failing pair of the event must itself be occupy-admitted
+        # (reference: PriorityWaitException is the admission)
+        pair_ok_tmp = jnp.where(is_rl, pass_rl_s,
+                                pass_default_s | occ_admit_s) | inapplicable_s
+        occ_admit_pairs = seg.unsort(
+            order, occ_admit_s.astype(jnp.int32)).astype(jnp.bool_)
+        pair_ok_pairs = seg.unsort(
+            order, pair_ok_tmp.astype(jnp.int32)).astype(jnp.bool_)
+        event_ok = jnp.all(pair_ok_pairs.reshape(B, K), axis=1)     # [B]
+        event_occ = (jnp.any(occ_admit_pairs.reshape(B, K), axis=1)
+                     & event_ok & batch.valid)                      # [B]
+
+        # book ONE grant per admitted event on its resource row (the
+        # reference's first denying rule throws PriorityWait and books on
+        # the node once), slot ring keyed by window now+1
+        slots_n = occ_cnt.shape[1]
+        slot = (now_idx_s + 1) % slots_n
+        grants = jnp.zeros(occ_cnt.shape[0], jnp.float32).at[
+            jnp.where(event_occ, batch.rows, occ_cnt.shape[0])].add(
+            jnp.where(event_occ, batch.acquire, 0).astype(jnp.float32),
+            mode="drop")
+        granted_row = grants > 0
+        slot_keep = occ_win[:, slot] == now_idx_s + 1
+        new_cnt = jnp.where(granted_row,
+                            jnp.where(slot_keep, occ_cnt[:, slot], 0.0)
+                            + grants,
+                            occ_cnt[:, slot])
+        new_win = jnp.where(granted_row, now_idx_s + 1, occ_win[:, slot])
+        dyn = dyn._replace(
+            occupied_count=occ_cnt.at[:, slot].set(new_cnt),
+            occupied_window=occ_win.at[:, slot].set(new_win))
+        occ_admit_s = occ_admit_s & jnp.repeat(event_occ, K)[order]
+    else:
+        occ_admit_s = jnp.zeros_like(pass_default_s).astype(jnp.bool_)
+        wait_next = jnp.int32(0)
+
+    pair_pass_s = jnp.where(is_rl, pass_rl_s, pass_default_s | occ_admit_s)
     pair_pass_s = pair_pass_s | inapplicable_s
     pair_wait_s = jnp.where(is_rl & pair_pass_s & ~inapplicable_s, wait_s, 0)
+    pair_wait_s = jnp.maximum(pair_wait_s,
+                              jnp.where(occ_admit_s, wait_next, 0))
 
     # update pacing clocks: last passing element's latest per rule segment
     new_latest = jnp.where(is_rl & pair_pass_s & ~inapplicable_s,
@@ -416,10 +534,12 @@ def flow_check(
     # --- combine back to events ---
     pair_pass = seg.unsort(order, pair_pass_s.astype(jnp.int32)).astype(jnp.bool_)
     pair_wait = seg.unsort(order, pair_wait_s.astype(jnp.int32))
+    pair_occ = seg.unsort(order, occ_admit_s.astype(jnp.int32)).astype(jnp.bool_)
     allow = jnp.all(pair_pass.reshape(B, K), axis=1)
     wait_ms = jnp.max(pair_wait.reshape(B, K), axis=1)
+    occupied = jnp.any(pair_occ.reshape(B, K), axis=1) & allow & batch.valid
     allow = allow | ~batch.valid
-    return dyn, allow, wait_ms.astype(jnp.int32)
+    return dyn, allow, wait_ms.astype(jnp.int32), occupied
 
 
 def _warmup_sync_and_limits(

@@ -47,13 +47,15 @@ from sentinel_tpu.core.registry import (
 )
 from sentinel_tpu.engine.pipeline import (
     EngineSpec, EntryBatch, ExitBatch, RuleSet, SentinelState, Verdicts,
-    decide_entries, init_state, invalidate_resource_rows, record_exits,
+    decide_entries, init_state, invalidate_resource_rows, record_blocks,
+    record_exits,
 )
 from sentinel_tpu.rules import authority as auth_mod
 from sentinel_tpu.rules import degrade as deg_mod
 from sentinel_tpu.rules import flow as flow_mod
 from sentinel_tpu.rules import param_flow as pf_mod
 from sentinel_tpu.rules import system as sys_mod
+from sentinel_tpu.core.callbacks import StatisticCallbackRegistry
 from sentinel_tpu.core.logs import BlockStatLogger
 from sentinel_tpu.stats import events as ev
 from sentinel_tpu.stats.window import (
@@ -71,7 +73,8 @@ def _jitted_steps(spec: EngineSpec):
     (EngineSpec is a frozen, hashable dataclass)."""
     return (jax.jit(functools.partial(decide_entries, spec)),
             jax.jit(functools.partial(record_exits, spec)),
-            jax.jit(functools.partial(invalidate_resource_rows, spec)))
+            jax.jit(functools.partial(invalidate_resource_rows, spec)),
+            jax.jit(functools.partial(record_blocks, spec)))
 
 # jitted once at import; shapes are padded to powers of two so the trace
 # cache stays small (calling jax.jit(...) per drain would re-trace every time)
@@ -212,6 +215,7 @@ class Sentinel:
             statistic_max_rt=cfg.statistic_max_rt,
             param_keys=cfg.param_table_slots,
             param_pairs=cfg.param_pairs_per_event,
+            occupy_timeout_ms=cfg.occupy_timeout_ms,
         )
         self.param_key_registry = pf_mod.ParamKeyRegistry(cfg.param_table_slots)
         self._user_param_rules: List[pf_mod.ParamFlowRule] = []
@@ -249,8 +253,13 @@ class Sentinel:
         self.resource_types: dict = {}
         # per-second rolled-up block log (LogSlot → EagleEyeLogUtil analog)
         self.block_log = BlockStatLogger(self.clock)
+        self.callbacks = StatisticCallbackRegistry()
 
-        self._jit_decide, self._jit_exit, self._jit_invalidate = _jitted_steps(self.spec)
+        (self._jit_decide, self._jit_exit, self._jit_invalidate,
+         self._jit_record_blocks) = _jitted_steps(self.spec)
+        self._token_service = None          # cluster TokenService (client or
+        # embedded server facade); set via set_token_service
+        self._cluster_rules_by_row: dict = {}
 
     # ------------------------------------------------------------------
     # Rule management (XxxRuleManager.loadRules analog)
@@ -291,12 +300,28 @@ class Sentinel:
             capacity=cfg.max_flow_rules, k_per_resource=cfg.max_rules_per_resource,
             num_rows=cfg.max_resources, cold_factor=float(cfg.cold_factor),
             origin_registry=self.origins)
+        cluster_map: dict = {}
+        for r in compiled.rules:
+            if r.cluster_mode:
+                row = self.resources.get_or_create(r.resource)
+                cluster_map.setdefault(row, []).append(r)
         with self._lock:
             self._flow = compiled
+            self._cluster_rules_by_row = cluster_map
             self._ruleset = self._build_ruleset()
             # fresh shaping state for the new tables (reference rebuilds raters)
             self._state = self._state._replace(
-                flow_dyn=flow_mod.init_flow_dyn(cfg.max_flow_rules))
+                flow_dyn=flow_mod.init_flow_dyn(cfg.max_flow_rules,
+                                                self.spec.second.buckets,
+                                                self.spec.rows))
+
+    def set_token_service(self, svc) -> None:
+        """Install the cluster token service used for cluster-mode flow rules
+        (reference ``TokenClientProvider`` / embedded-server provider): any
+        object with ``request_token(flow_id, count, prioritized=False) →
+        TokenResult-like`` (``status``, ``wait_ms``). ``None`` uninstalls —
+        cluster rules then take the fallback path."""
+        self._token_service = svc
 
     def load_degrade_rules(self, rules: Sequence[deg_mod.DegradeRule]) -> None:
         cfg = self.cfg
@@ -367,7 +392,9 @@ class Sentinel:
         s = self.spec
         idx_s = s.second.index_of(now_ms)
         idx_m = s.minute.index_of(now_ms) if s.minute else 0
-        return (jnp.int32(idx_s), jnp.int32(idx_m), jnp.int32(self._rel_ms(now_ms)))
+        return (jnp.int32(idx_s), jnp.int32(idx_m),
+                jnp.int32(self._rel_ms(now_ms)),
+                jnp.int32(now_ms % s.second.win_ms))
 
     # ------------------------------------------------------------------
     # Per-call API
@@ -399,6 +426,18 @@ class Sentinel:
         context_id = (self.contexts.get_or_create(ctx.name)
                       if c_row < self.spec.alt_rows else 0)
         is_in = entry_type == ENTRY_TYPE_IN
+
+        # cluster-mode rules: token-server delegation BEFORE the local
+        # pipeline (FlowRuleChecker.passClusterCheck); failed requests with
+        # fallbackToLocalWhenFail re-enable those rules locally
+        cluster_fb = False
+        cluster_wait = 0
+        crules = self._cluster_rules_by_row.get(row)
+        if crules:
+            cluster_fb, cluster_wait = self._cluster_check(
+                resource, use_origin or "", row, o_row, c_row, acquire,
+                is_in, prioritized, crules, sleep)
+
         pairs = self._resolve_param_pairs_one(row, args)
         pr = pk = None
         if pairs is not None:
@@ -411,21 +450,31 @@ class Sentinel:
                 np.array([c_row], np.int32), np.array([acquire], np.int32),
                 np.array([is_in], np.bool_), np.array([prioritized], np.bool_),
                 param_rules=pr, param_keys=pk,
-                param_gen=pairs[2] if pairs is not None else -1)
+                param_gen=pairs[2] if pairs is not None else -1,
+                cluster_fallback=(np.array([True], np.bool_)
+                                  if cluster_fb else None))
             if not bool(verdict.allow[0]):
                 exc = block_exception_for(int(verdict.reason[0]), resource,
                                           origin=use_origin)
                 # LogSlot: block events roll into sentinel-block.log
                 self.block_log.log(resource, type(exc).__name__,
                                    origin=use_origin or "")
+                if not self.callbacks.empty:   # StatisticSlot onBlocked
+                    self.callbacks.fire_blocked(resource, use_origin or "",
+                                                acquire, exc)
                 raise exc
         except BaseException:
             if pairs is not None:   # blocked entries never exit → unpin now
                 pairs[3].unpin_rows(pairs[4])
             raise
+        if not self.callbacks.empty:           # StatisticSlot onPass
+            self.callbacks.fire_pass(resource, use_origin or "", acquire,
+                                     args)
         wait = int(verdict.wait_ms[0])
         if wait > 0 and sleep:
             self.clock.sleep_ms(wait)
+        if not sleep:
+            wait += cluster_wait     # cluster SHOULD_WAIT surfaces here too
         now = self.clock.now_ms()
         # sleep=False: project create_ms past the wait the caller will await,
         # so rt excludes pacing delay exactly like the sleep=True path
@@ -434,6 +483,65 @@ class Sentinel:
         if not sleep:
             e.wait_ms = wait
         return e
+
+    def _cluster_check(self, resource: str, origin: str, row: int,
+                       o_row: int, c_row: int, acquire: int, is_in: bool,
+                       prioritized: bool, crules,
+                       sleep: bool = True) -> Tuple[bool, int]:
+        """``passClusterCheck`` for this resource's cluster-mode rules.
+        Returns ``(need_local_fallback, pending_wait_ms)``; raises
+        FlowException on BLOCKED and records the block like StatisticSlot
+        would. With ``sleep=False`` SHOULD_WAIT waits are returned instead
+        of slept (async callers await them via ``Entry.wait_ms``)."""
+        svc = self._token_service
+        need_fallback = False
+        pending_wait = 0
+        for r in crules:
+            status, wait = -1, 0           # FAIL when no service installed
+            if svc is not None:
+                try:
+                    res = svc.request_token(r.cluster_flow_id, acquire,
+                                            prioritized)
+                    status = int(res.status)
+                    wait = int(getattr(res, "wait_ms", 0))
+                except Exception as exc:
+                    from sentinel_tpu.core.logs import record_log
+                    record_log().warning(
+                        "cluster token request failed: %r", exc)
+            if status == 0:                # OK
+                continue
+            if status == 2:                # SHOULD_WAIT → sleep, then pass
+                if wait > 0:
+                    if sleep:
+                        self.clock.sleep_ms(wait)
+                    else:
+                        pending_wait += wait
+                continue
+            if status in (1, -2):          # BLOCKED / TOO_MANY_REQUEST
+                now = self.clock.now_ms()
+                idx_s, idx_m, _rel, _w = self._time_scalars(now)
+                with self._lock:
+                    self._state = self._jit_record_blocks(
+                        self._state,
+                        jnp.asarray(np.array([row], np.int32)),
+                        jnp.asarray(np.array([o_row], np.int32)),
+                        jnp.asarray(np.array([c_row], np.int32)),
+                        jnp.asarray(np.array([acquire], np.int32)),
+                        jnp.asarray(np.array([is_in], np.bool_)),
+                        jnp.asarray(np.array([True], np.bool_)),
+                        idx_s, idx_m)
+                exc = block_exception_for(int(BlockReason.FLOW), resource,
+                                          origin=origin)
+                self.block_log.log(resource, type(exc).__name__,
+                                   origin=origin)
+                if not self.callbacks.empty:
+                    self.callbacks.fire_blocked(resource, origin, acquire,
+                                                exc)
+                raise exc
+            # FAIL / NO_RULE_EXISTS / BAD_REQUEST → local check or pass
+            if r.cluster_fallback_to_local:
+                need_fallback = True
+        return need_fallback, pending_wait
 
     def _resolve_param_pairs_one(self, row: int, args: Sequence):
         """→ (rules [PV], keys [PV], generation, registry), or None when the
@@ -493,6 +601,9 @@ class Sentinel:
             error=np.array([e.error is not None], np.bool_),
             is_in=np.array([e.is_in], np.bool_),
             param_rules=pr, param_keys=pk, param_gen=gen)
+        if not self.callbacks.empty:           # MetricExitCallback analog
+            self.callbacks.fire_exit(e.resource, rt, e.error is not None,
+                                     e.acquire)
 
     # ------------------------------------------------------------------
     # Batch API (throughput tier)
@@ -558,10 +669,57 @@ class Sentinel:
             if entry_types is not None else np.ones(n, np.bool_)
         prio = np.asarray(prioritized, np.bool_) if prioritized is not None \
             else np.zeros(n, np.bool_)
-        verdicts = self.decide_raw(rows, origin_ids, origin_rows, context_ids,
-                                   chain_rows, acq, is_in, prio,
+
+        # cluster-mode rules: token delegation per event, same as entry()
+        # (passClusterCheck). Cluster-blocked events are excluded from the
+        # local decide (their block is recorded by _cluster_check) and
+        # surfaced as FLOW denials in the returned verdicts.
+        cl_blocked = None
+        cl_waits = None
+        cluster_fb_arr = None
+        rows_for_decide = rows
+        if self._cluster_rules_by_row:
+            fallback = np.zeros(n, np.bool_)
+            cl_blocked = np.zeros(n, np.bool_)
+            cl_waits = np.zeros(n, np.int32)
+            rows_for_decide = np.array(rows, np.int32, copy=True)
+            for i in range(n):
+                crules = self._cluster_rules_by_row.get(int(rows[i]))
+                if not crules:
+                    continue
+                try:
+                    fb, w = self._cluster_check(
+                        resources[i],
+                        (origins[i] if origins is not None
+                         and origins[i] else ""),
+                        int(rows[i]), int(origin_rows[i]),
+                        int(chain_rows[i]), int(acq[i]), bool(is_in[i]),
+                        bool(prio[i]), crules, sleep=False)
+                    fallback[i] = fb
+                    cl_waits[i] = w
+                except BlockException:
+                    cl_blocked[i] = True
+                    rows_for_decide[i] = self.spec.rows   # padding: no stats
+            if fallback.any():
+                cluster_fb_arr = fallback
+
+        verdicts = self.decide_raw(rows_for_decide, origin_ids, origin_rows,
+                                   context_ids, chain_rows, acq, is_in, prio,
                                    param_rules=param_rules,
-                                   param_keys=param_keys, param_gen=param_gen)
+                                   param_keys=param_keys, param_gen=param_gen,
+                                   cluster_fallback=cluster_fb_arr)
+        if cl_blocked is not None and cl_blocked.any():
+            allow = np.array(verdicts.allow, copy=True)
+            reason = np.array(verdicts.reason, copy=True)
+            allow[cl_blocked] = False
+            reason[cl_blocked] = int(BlockReason.FLOW)
+            verdicts = Verdicts(allow=allow, reason=reason,
+                                wait_ms=np.maximum(verdicts.wait_ms,
+                                                   cl_waits))
+        elif cl_waits is not None:
+            verdicts = verdicts._replace(
+                wait_ms=np.maximum(verdicts.wait_ms, cl_waits))
+
         if param_keys is not None:
             # blocked events never exit → release their pins immediately
             blocked = ~np.asarray(verdicts.allow)
@@ -569,11 +727,14 @@ class Sentinel:
                 registry.unpin_rows(pf_mod.thread_key_rows(
                     compiled, param_rules[blocked], param_keys[blocked]))
         # LogSlot parity for the batch tier: blocked events roll into
-        # sentinel-block.log (same per-second dedup as the single path)
+        # sentinel-block.log (same per-second dedup as the single path);
+        # cluster blocks were already logged inside _cluster_check
         denied = np.nonzero(~np.asarray(verdicts.allow))[0]
         if denied.size:
             reasons = np.asarray(verdicts.reason)
             for i in denied.tolist():
+                if cl_blocked is not None and cl_blocked[i]:
+                    continue
                 self.block_log.log(
                     resources[i], err_mod.exception_name_for(int(reasons[i])),
                     origin=(origins[i] if origins is not None
@@ -590,7 +751,8 @@ class Sentinel:
 
     def decide_raw(self, rows, origin_ids, origin_rows, context_ids, chain_rows,
                    acquire, is_in, prioritized, *, param_rules=None,
-                   param_keys=None, param_gen: int = -1) -> Verdicts:
+                   param_keys=None, param_gen: int = -1,
+                   cluster_fallback=None) -> Verdicts:
         """Lowest-level host entry point: pre-resolved numpy arrays.
         ``param_gen`` is the generation the pair arrays were resolved against;
         stale pairs (a reload raced the resolve) are dropped, not misapplied."""
@@ -610,9 +772,11 @@ class Sentinel:
             valid=_pad_to(np.ones(n, np.bool_), b, False, np.bool_),
             param_rules=self._pad_pairs(param_rules, b, self.cfg.max_param_rules),
             param_keys=self._pad_pairs(param_keys, b, self.spec.param_keys),
+            cluster_fallback=(_pad_to(cluster_fallback, b, False, np.bool_)
+                              if cluster_fallback is not None else None),
         )
         now = self.clock.now_ms()
-        idx_s, idx_m, rel = self._time_scalars(now)
+        idx_s, idx_m, rel, in_win = self._time_scalars(now)
         load1, cpu = self._cpu.sample()
         with self._lock:
             # gen check must happen under the same lock that guards reloads,
@@ -622,7 +786,7 @@ class Sentinel:
             self._drain_evictions_locked()
             state, verdicts = self._jit_decide(
                 self._ruleset, self._state, batch, idx_s, idx_m, rel,
-                jnp.float32(load1), jnp.float32(cpu))
+                jnp.float32(load1), jnp.float32(cpu), in_win)
             self._state = state
         return Verdicts(allow=np.asarray(verdicts.allow)[:n],
                         reason=np.asarray(verdicts.reason)[:n],
@@ -646,7 +810,7 @@ class Sentinel:
             param_keys=self._pad_pairs(param_keys, b, self.spec.param_keys),
         )
         now = self.clock.now_ms()
-        idx_s, idx_m, rel = self._time_scalars(now)
+        idx_s, idx_m, rel, _in_win = self._time_scalars(now)
         with self._lock:
             unpin = None
             if batch.param_rules is not None:
@@ -724,7 +888,7 @@ class Sentinel:
         for name, row in items:
             c = counters[row]
             if not (c[ev.PASS] or c[ev.BLOCK] or c[ev.SUCCESS]
-                    or c[ev.EXCEPTION]):
+                    or c[ev.EXCEPTION] or c[ev.OCCUPIED_PASS]):
                 continue
             succ = int(c[ev.SUCCESS])
             nodes.append(MetricNode(

@@ -1,0 +1,166 @@
+"""Client-side cluster delegation (reference
+``FlowRuleChecker.passClusterCheck`` / ``fallbackToLocalOrPass``): a
+cluster-mode flow rule asks the token service instead of checking locally;
+BLOCKED raises + records, SHOULD_WAIT sleeps, FAIL falls back to the local
+check iff the rule says so."""
+
+import dataclasses
+
+import pytest
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+
+T0 = 1_785_000_000_000
+
+
+@dataclasses.dataclass
+class _Result:
+    status: int
+    wait_ms: int = 0
+
+
+class FakeTokenService:
+    def __init__(self):
+        self.script = []        # list of _Result popped per request
+        self.calls = []
+
+    def request_token(self, flow_id, count, prioritized=False):
+        self.calls.append((flow_id, count, prioritized))
+        return self.script.pop(0) if self.script else _Result(0)
+
+
+@pytest.fixture
+def clk():
+    return ManualClock(start_ms=T0)
+
+
+def make(clk):
+    cfg = stpu.load_config(max_resources=64, max_flow_rules=16,
+                           max_degrade_rules=16, max_authority_rules=16,
+                           minute_enabled=True)
+    sph = stpu.Sentinel(config=cfg, clock=clk)
+    return sph
+
+
+def cluster_rule(**over):
+    kw = dict(resource="csvc", count=100.0, cluster_mode=True,
+              cluster_flow_id=42, cluster_fallback_to_local=True)
+    kw.update(over)
+    return stpu.FlowRule(**kw)
+
+
+def test_ok_token_passes_and_skips_local_count(clk):
+    sph = make(clk)
+    svc = FakeTokenService()
+    sph.set_token_service(svc)
+    # local count would block instantly; cluster grants override it
+    sph.load_flow_rules([cluster_rule(count=0.0)])
+    for _ in range(3):
+        with sph.entry("csvc"):
+            pass
+    assert svc.calls == [(42, 1, False)] * 3
+    assert sph.node_totals("csvc")["pass"] == 3
+
+
+def test_blocked_token_raises_and_records(clk):
+    sph = make(clk)
+    svc = FakeTokenService()
+    svc.script = [_Result(1)]        # BLOCKED
+    sph.set_token_service(svc)
+    sph.load_flow_rules([cluster_rule()])
+    with pytest.raises(stpu.FlowException):
+        sph.entry("csvc")
+    t = sph.node_totals("csvc")
+    assert t["block"] == 1 and t["pass"] == 0
+
+
+def test_should_wait_sleeps_then_passes(clk):
+    sph = make(clk)
+    svc = FakeTokenService()
+    svc.script = [_Result(2, wait_ms=120)]
+    sph.set_token_service(svc)
+    sph.load_flow_rules([cluster_rule()])
+    before = clk.now_ms()
+    with sph.entry("csvc"):
+        pass
+    assert clk.now_ms() - before == 120    # TokenResult.waitInMs honored
+
+
+def test_fail_falls_back_to_local_check(clk):
+    sph = make(clk)
+    svc = FakeTokenService()
+    sph.set_token_service(svc)
+    sph.load_flow_rules([cluster_rule(count=2.0)])
+    svc.script = [_Result(-1)] * 5        # FAIL every time
+    res = []
+    for _ in range(5):
+        try:
+            with sph.entry("csvc"):
+                res.append("pass")
+        except stpu.BlockException:
+            res.append("block")
+    # local fallback enforces count=2
+    assert res == ["pass", "pass", "block", "block", "block"]
+
+
+def test_fail_without_fallback_passes_through(clk):
+    sph = make(clk)
+    svc = FakeTokenService()
+    sph.set_token_service(svc)
+    sph.load_flow_rules([cluster_rule(count=0.0,
+                                      cluster_fallback_to_local=False)])
+    svc.script = [_Result(-1)] * 4
+    for _ in range(4):
+        with sph.entry("csvc"):     # count=0 would block locally; pass
+            pass
+    assert sph.node_totals("csvc")["pass"] == 4
+
+
+def test_no_service_installed_behaves_like_fail(clk):
+    sph = make(clk)
+    sph.load_flow_rules([cluster_rule(count=1.0)])
+    res = []
+    for _ in range(3):
+        try:
+            with sph.entry("csvc"):
+                res.append("pass")
+        except stpu.BlockException:
+            res.append("block")
+    assert res == ["pass", "block", "block"]   # local fallback active
+
+
+def test_cluster_rule_inactive_locally_when_tokens_granted(clk):
+    """A non-cluster rule on the same resource still applies locally while
+    the cluster rule is delegated."""
+    sph = make(clk)
+    svc = FakeTokenService()
+    sph.set_token_service(svc)
+    sph.load_flow_rules([cluster_rule(count=0.0),
+                         stpu.FlowRule(resource="csvc", count=2.0)])
+    res = []
+    for _ in range(4):
+        try:
+            with sph.entry("csvc"):
+                res.append("pass")
+        except stpu.BlockException:
+            res.append("block")
+    assert res == ["pass", "pass", "block", "block"]
+
+
+def test_entry_batch_enforces_cluster_rules(clk):
+    """The batch tier must delegate cluster rules too (not bypass them)."""
+    sph = make(clk)
+    svc = FakeTokenService()
+    sph.set_token_service(svc)
+    sph.load_flow_rules([cluster_rule(count=0.0)])
+    svc.script = [_Result(0), _Result(1), _Result(2, wait_ms=80),
+                  _Result(-1)]
+    v = sph.entry_batch(["csvc"] * 4)
+    # OK / cluster-BLOCKED / SHOULD_WAIT(80ms) / FAIL→local fallback on a
+    # count=0 rule which blocks locally
+    assert list(map(bool, v.allow)) == [True, False, True, False]
+    assert int(v.wait_ms[2]) == 80
+    # both denials recorded in stats (cluster block + local fallback block)
+    t = sph.node_totals("csvc")
+    assert t["block"] == 2 and t["pass"] == 2

@@ -104,3 +104,38 @@ def test_config_rejects_bad_kwargs():
     with pytest.raises(TypeError):
         load_config(not_a_field=1)
     assert load_config(max_resources="4096").max_resources == 4096
+
+
+def test_statistic_callbacks_fire():
+    """StatisticSlotCallbackRegistry / MetricExtension analog: onPass,
+    onBlocked, onExit hooks around the single-entry path."""
+    import sentinel_tpu as stpu
+    clk = ManualClock(start_ms=1_785_000_000_000)
+    sph = stpu.Sentinel(load_config(max_resources=64, max_flow_rules=16,
+                                    max_degrade_rules=16,
+                                    max_authority_rules=16), clock=clk)
+    sph.load_flow_rules([stpu.FlowRule(resource="cb", count=1)])
+    seen = []
+    sph.callbacks.add_pass_handler(
+        lambda res, origin, acq, args: seen.append(("pass", res, acq)))
+    sph.callbacks.add_blocked_handler(
+        lambda res, origin, acq, exc: seen.append(
+            ("block", res, type(exc).__name__)))
+    sph.callbacks.add_exit_handler(
+        lambda res, rt, error, acq: seen.append(("exit", res, error)))
+
+    with sph.entry("cb"):
+        pass
+    try:
+        with sph.entry("cb"):
+            pass
+    except stpu.BlockException:
+        pass
+    assert seen == [("pass", "cb", 1), ("exit", "cb", False),
+                    ("block", "cb", "FlowException")]
+
+    # a raising handler is swallowed, not propagated
+    sph.callbacks.add_exit_handler(lambda *a: 1 / 0)
+    clk.advance_ms(1000)
+    with sph.entry("cb"):
+        pass
