@@ -102,31 +102,30 @@ def main() -> None:
             prioritized=jnp.zeros(B, jnp.bool_),
             valid=jnp.ones(B, jnp.bool_)))
 
-    step = jax.jit(functools.partial(decide_entries, spec), donate_argnums=(1,))
+    step = jax.jit(functools.partial(decide_entries, spec, enable_occupy=False),
+                   donate_argnums=(1,))
 
     t0_ms = 1_000_000_000
-    load1 = jnp.float32(0.5)
-    cpu = jnp.float32(0.1)
+    sys_scalars = jnp.asarray(np.array([0.5, 0.1], np.float32))
 
     def scalars(i):
         now = t0_ms + i * 2  # 2 ms per step → windows rotate during the run
-        return (jnp.int32(spec.second.index_of(now)), jnp.int32(0),
-                jnp.int32(now - t0_ms),
-                jnp.int32(now % spec.second.win_ms))
+        # packed: ONE transfer per step (tunneled-TPU dispatch latency)
+        return jnp.asarray(np.array(
+            [spec.second.index_of(now), 0, now - t0_ms,
+             now % spec.second.win_ms], np.int32))
 
     print(f"bench: R={R} B={B} steps={STEPS} on {jax.devices()[0]}",
           file=sys.stderr)
     for i in range(WARMUP):
-        idx_s, idx_m, rel, in_win = scalars(i)
         state, verdicts = step(ruleset, state, batches[i % n_batches],
-                               idx_s, idx_m, rel, load1, cpu, in_win)
+                               scalars(i), sys_scalars)
     jax.block_until_ready(state)
 
     start = time.perf_counter()
     for i in range(STEPS):
-        idx_s, idx_m, rel, in_win = scalars(WARMUP + i)
         state, verdicts = step(ruleset, state, batches[i % n_batches],
-                               idx_s, idx_m, rel, load1, cpu, in_win)
+                               scalars(WARMUP + i), sys_scalars)
     jax.block_until_ready((state, verdicts))
     elapsed = time.perf_counter() - start
 

@@ -1,0 +1,165 @@
+"""Cluster mode coordinator: turn the dashboard's mode flips into real
+client/server lifecycles (reference ``ClusterStateManager`` +
+``SentinelDefaultTokenServer`` embedded mode + ``DefaultClusterTokenClient``
+wiring, SURVEY §2.3/§2.8.4: "any instance can become the token server").
+
+Wire into the command plane::
+
+    coord = ClusterCoordinator(sentinel)
+    rt = start_transport(sentinel, ...)
+    rt.cluster_state.add_observer(coord.on_mode_change)
+
+Mode transitions:
+
+- ``CLIENT`` (0): connect a :class:`ClusterTokenClient` to the configured
+  server address and install it as the Sentinel's token service.
+- ``SERVER`` (1): start an embedded :class:`ClusterTokenServer` (own
+  engine) and install a loopback token service that talks to the local
+  engine directly (the reference's ``EmbeddedClusterTokenServerProvider`` —
+  the server instance serves its own requests in-process, no socket hop).
+- ``NOT_STARTED`` (-1): stop whichever is running, uninstall.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+from sentinel_tpu.core.logs import record_log
+
+CLUSTER_NOT_STARTED = -1
+CLUSTER_CLIENT = 0
+CLUSTER_SERVER = 1
+
+
+@dataclasses.dataclass
+class EmbeddedTokenResult:
+    status: int
+    wait_ms: int = 0
+    remaining: int = 0
+
+
+class _EmbeddedTokenService:
+    """Loopback TokenService over a local engine (no socket round-trip)."""
+
+    def __init__(self, engine, clock=None):
+        self.engine = engine
+        self._clock = clock
+
+    def _now(self) -> int:
+        if self._clock is not None:
+            return self._clock.now_ms()
+        import time
+        return int(time.time() * 1000)
+
+    def request_token(self, flow_id: int, count: int = 1,
+                      prioritized: bool = False):
+        status, wait, remaining = self.engine.request_tokens(
+            [flow_id], [count], [prioritized], now_ms=self._now())[0]
+        return EmbeddedTokenResult(status=status, wait_ms=wait,
+                                   remaining=remaining)
+
+
+class ClusterCoordinator:
+    def __init__(self, sentinel, *, namespace: Optional[str] = None,
+                 server_port: int = 0, n_shards: int = 1,
+                 flows_per_shard: int = 64, clock=None):
+        self.sentinel = sentinel
+        self.namespace = namespace or sentinel.cfg.app_name
+        self.server_port = server_port
+        self.n_shards = n_shards
+        self.flows_per_shard = flows_per_shard
+        self.clock = clock if clock is not None else sentinel.clock
+        self._lock = threading.Lock()
+        self.mode = CLUSTER_NOT_STARTED
+        self.client = None
+        self.server = None
+        # client connection config (ClusterClientConfigManager)
+        self.server_host = "127.0.0.1"
+        self.server_port_client = 18730
+        self.request_timeout_ms = 20
+
+    # ---------------------------------------------------------------- config
+    def configure_client(self, host: str, port: int,
+                         request_timeout_ms: Optional[int] = None) -> None:
+        """``modifyClusterClientConfig``: on change, a running client
+        reconnects to the new server (ServerChangeObserver)."""
+        with self._lock:
+            self.server_host = host
+            self.server_port_client = port
+            if request_timeout_ms is not None:
+                self.request_timeout_ms = request_timeout_ms
+            if self.mode == CLUSTER_CLIENT:
+                self._stop_client_locked()
+                self._start_client_locked()
+
+    # ---------------------------------------------------------------- modes
+    def on_mode_change(self, mode: int) -> None:
+        with self._lock:
+            if mode == self.mode:
+                return
+            self._stop_client_locked()
+            self._stop_server_locked()
+            # the old service is already gone: from here the effective mode
+            # is NOT_STARTED until the new one starts, so a failed start
+            # leaves a retryable state (not a stale mode that no-ops)
+            self.mode = CLUSTER_NOT_STARTED
+            try:
+                if mode == CLUSTER_CLIENT:
+                    self._start_client_locked()
+                elif mode == CLUSTER_SERVER:
+                    self._start_server_locked()
+                else:
+                    self.sentinel.set_token_service(None)
+                self.mode = mode
+            except Exception as exc:
+                record_log().warning("cluster mode change failed: %r", exc)
+
+    # ---------------------------------------------------------------- impl
+    def _start_client_locked(self) -> None:
+        from sentinel_tpu.cluster.client import ClusterTokenClient
+        client = ClusterTokenClient(
+            host=self.server_host, port=self.server_port_client,
+            namespace=self.namespace,
+            request_timeout_ms=self.request_timeout_ms)
+        client.start()
+        self.client = client
+        self.sentinel.set_token_service(client)
+
+    def _stop_client_locked(self) -> None:
+        if self.client is not None:
+            self.sentinel.set_token_service(None)
+            try:
+                self.client.stop()
+            finally:
+                self.client = None
+
+    def _start_server_locked(self) -> None:
+        from sentinel_tpu.cluster.server import ClusterTokenServer
+        from sentinel_tpu.parallel.cluster import ClusterEngine, ClusterSpec
+        engine = ClusterEngine(ClusterSpec(
+            n_shards=self.n_shards, flows_per_shard=self.flows_per_shard,
+            namespaces=4))
+        server = ClusterTokenServer(engine, port=self.server_port,
+                                    clock=self.clock)
+        server.start()
+        self.server = server
+        # embedded mode: this instance's own cluster rules are served by
+        # the in-process engine, no loopback socket
+        self.sentinel.set_token_service(
+            _EmbeddedTokenService(engine, clock=self.clock))
+
+    def _stop_server_locked(self) -> None:
+        if self.server is not None:
+            self.sentinel.set_token_service(None)
+            try:
+                self.server.stop()
+            finally:
+                self.server = None
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stop_client_locked()
+            self._stop_server_locked()
+            self.mode = CLUSTER_NOT_STARTED

@@ -161,16 +161,23 @@ def decide_entries(
     rules: RuleSet,
     state: SentinelState,
     batch: EntryBatch,
-    now_idx_s: jnp.ndarray,
-    now_idx_m: jnp.ndarray,
-    rel_now_ms: jnp.ndarray,
-    load1: jnp.ndarray,
-    cpu_usage: jnp.ndarray,
-    in_win_ms: Optional[jnp.ndarray] = None,   # now % second-win (occupy)
+    times: jnp.ndarray,          # int32[4]: idx_s, idx_m, rel_ms, in_win_ms
+    sys_scalars: jnp.ndarray,    # float32[2]: load1, cpu_usage
+    enable_occupy: bool = True,  # STATIC (see flow_check)
 ) -> Tuple[SentinelState, Verdicts]:
-    """One device step: decide a batch, then record post-decision statistics."""
+    """One device step: decide a batch, then record post-decision statistics.
+
+    Time/system inputs arrive PACKED (one int32[4] + one float32[2]) so a
+    step costs two host→device transfers, not six — on a tunneled TPU each
+    per-call transfer is real latency on the hot path."""
     R = spec.rows
     RA = spec.alt_rows
+    now_idx_s = times[0]
+    now_idx_m = times[1]
+    rel_now_ms = times[2]
+    in_win_ms = times[3]
+    load1 = sys_scalars[0]
+    cpu_usage = sys_scalars[1]
 
     # ---- slot cascade (each gate only sees events still alive) ----
     live = batch.valid
@@ -214,7 +221,8 @@ def decide_entries(
         main_minute=state.minute if spec.minute else None,
         now_idx_m=now_idx_m,
         in_win_ms=in_win_ms,
-        occupy_timeout_ms=spec.occupy_timeout_ms)
+        occupy_timeout_ms=spec.occupy_timeout_ms,
+        enable_occupy=enable_occupy)
     live3 = live2 & flow_ok
 
     # occupied (PriorityWait) events bypass the degrade slot entirely —
@@ -254,16 +262,17 @@ def decide_entries(
         batch.is_in)
     pass2 = jnp.concatenate([passed, passed])
     pass_now2 = jnp.concatenate([pass_now, pass_now])
-    occ2 = jnp.concatenate([occupied, occupied])
     acq2 = jnp.concatenate([batch.acquire, batch.acquire])
     pass_amt = jnp.where(pass_now2, acq2, 0)
-    occ_amt = jnp.where(occ2, acq2, 0)
     block_amt = jnp.where(jnp.concatenate([blocked, blocked]), acq2, 0)
 
     second = refresh_rows(spec.second, state.second, main_targets, now_idx_s)
     second = add_rows(spec.second, second, main_targets, ev.PASS, pass_amt, now_idx_s)
-    second = add_rows(spec.second, second, main_targets, ev.OCCUPIED_PASS,
-                      occ_amt, now_idx_s)
+    if enable_occupy:   # occupied is all-False in the static no-occupy variant
+        occ2 = jnp.concatenate([occupied, occupied])
+        occ_amt = jnp.where(occ2, acq2, 0)
+        second = add_rows(spec.second, second, main_targets, ev.OCCUPIED_PASS,
+                          occ_amt, now_idx_s)
     second = add_rows(spec.second, second, main_targets, ev.BLOCK, block_amt, now_idx_s)
 
     alt_second = refresh_rows(spec.second, state.alt_second, alt_targets, now_idx_s)
@@ -274,8 +283,9 @@ def decide_entries(
     if spec.minute:
         minute = refresh_rows(spec.minute, state.minute, main_targets, now_idx_m)
         minute = add_rows(spec.minute, minute, main_targets, ev.PASS, pass_amt, now_idx_m)
-        minute = add_rows(spec.minute, minute, main_targets, ev.OCCUPIED_PASS,
-                          occ_amt, now_idx_m)
+        if enable_occupy:
+            minute = add_rows(spec.minute, minute, main_targets,
+                              ev.OCCUPIED_PASS, occ_amt, now_idx_m)
         minute = add_rows(spec.minute, minute, main_targets, ev.BLOCK, block_amt, now_idx_m)
 
     thr_amt = jnp.where(pass2, 1, 0)  # +1 per entry (reference curThreadNum)
@@ -301,15 +311,16 @@ def record_exits(
     rules: RuleSet,
     state: SentinelState,
     batch: ExitBatch,
-    now_idx_s: jnp.ndarray,
-    now_idx_m: jnp.ndarray,
-    rel_now_ms: jnp.ndarray,
+    times: jnp.ndarray,          # int32[4] (same packing as decide_entries)
 ) -> SentinelState:
     """Completion step: ``StatisticSlot.exit`` (rt/success/exception, thread
     decrement, for node + origin + chain + ENTRY) then ``DegradeSlot.exit``
     (breaker feed)."""
     R = spec.rows
     RA = spec.alt_rows
+    now_idx_s = times[0]
+    now_idx_m = times[1]
+    rel_now_ms = times[2]
     pad_r = jnp.int32(R)
     pad_a = jnp.int32(RA)
 
@@ -377,12 +388,13 @@ def record_blocks(
     acquire: jnp.ndarray,
     is_in: jnp.ndarray,
     valid: jnp.ndarray,
-    now_idx_s: jnp.ndarray,
-    now_idx_m: jnp.ndarray,
+    times: jnp.ndarray,          # int32[4]
 ) -> SentinelState:
     """Record BLOCK events decided OUTSIDE the local pipeline (cluster token
     denials: the reference's StatisticSlot counts a cluster BLOCKED like any
     other BlockException)."""
+    now_idx_s = times[0]
+    now_idx_m = times[1]
     main_targets, alt_targets = _stat_targets(
         spec, rows, origin_rows, chain_rows, valid, is_in)
     amt = jnp.where(valid, acquire, 0)

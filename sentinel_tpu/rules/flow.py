@@ -39,6 +39,7 @@ import dataclasses
 from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from sentinel_tpu.ops import segments as seg
@@ -300,6 +301,8 @@ def flow_check(
     now_idx_m: Optional[jnp.ndarray] = None,
     in_win_ms: Optional[jnp.ndarray] = None,   # int32 scalar, now % win_ms
     occupy_timeout_ms: int = 500,
+    enable_occupy: bool = True,                # STATIC: trade a second jit
+    # variant for zero occupy cost on batches with no prioritized events
 ) -> Tuple[FlowDynState, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """→ (dyn', allow bool[B], wait_ms int32[B], occupied bool[B]).
 
@@ -399,28 +402,34 @@ def flow_check(
     # bookings are keyed by resource ROW (shared by all rules on the node,
     # like the reference's future buckets). Landed bookings (window already
     # reached) count toward the rolling admission sum for B windows,
-    # exactly as seeded borrowed PASS would.
+    # exactly as seeded borrowed PASS would. STATIC skip: the host tracks
+    # whether any booking can still be live and compiles this away
+    # otherwise (the gathers + extra scatter cost ~40% of the hot step).
     occ_cnt = dyn.occupied_count             # [R, S]
     occ_win = dyn.occupied_window            # [R, S]
-    safe_main_occ = jnp.minimum(sel_main_row, R - 1)
-    occ_age_bk = now_idx_s - occ_win[safe_main_occ]          # [BK, S]
-    occ_cnt_bk = occ_cnt[safe_main_occ]                      # [BK, S]
-    landed_bk = jnp.sum(
-        jnp.where((occ_age_bk >= 0) & (occ_age_bk < spec.buckets),
-                  occ_cnt_bk, 0.0), axis=1)
-    # bookings that will still be live in the NEXT window (pending or
-    # recently landed) — the budget already spoken for when occupying more
-    nextw_bk = jnp.sum(
-        jnp.where((occ_age_bk >= -1) & (occ_age_bk < spec.buckets - 1),
-                  occ_cnt_bk, 0.0), axis=1)
-    # only main-row selections see bookings (occupy is main-row-only)
-    no_book = use_alt | (sel_main_row >= R)
-    landed_bk = jnp.where(no_book, 0.0, landed_bk)
-    nextw_bk = jnp.where(no_book, 0.0, nextw_bk)
-
     grade_s = table.grade[rj_s]
-    base_s = jnp.where(grade_s == GRADE_QPS,
-                       cur_pass[order] + landed_bk[order], cur_thr[order])
+    if enable_occupy:
+        safe_main_occ = jnp.minimum(sel_main_row, R - 1)
+        occ_age_bk = now_idx_s - occ_win[safe_main_occ]      # [BK, S]
+        occ_cnt_bk = occ_cnt[safe_main_occ]                  # [BK, S]
+        landed_bk = jnp.sum(
+            jnp.where((occ_age_bk >= 0) & (occ_age_bk < spec.buckets),
+                      occ_cnt_bk, 0.0), axis=1)
+        # bookings still live in the NEXT window (pending or recently
+        # landed) — budget already spoken for when occupying more
+        nextw_bk = jnp.sum(
+            jnp.where((occ_age_bk >= -1) & (occ_age_bk < spec.buckets - 1),
+                      occ_cnt_bk, 0.0), axis=1)
+        # only main-row selections see bookings (occupy is main-row-only)
+        no_book = use_alt | (sel_main_row >= R)
+        landed_bk = jnp.where(no_book, 0.0, landed_bk)
+        nextw_bk = jnp.where(no_book, 0.0, nextw_bk)
+        base_s = jnp.where(grade_s == GRADE_QPS,
+                           cur_pass[order] + landed_bk[order],
+                           cur_thr[order])
+    else:
+        base_s = jnp.where(grade_s == GRADE_QPS, cur_pass[order],
+                           cur_thr[order])
     limit_s = eff_limit[order]
     behavior_s = table.behavior[rj_s]
 
@@ -457,64 +466,77 @@ def flow_check(
     # count surviving into it (current bucket + live bookings) leaves room
     # under the threshold, and the wait fits OccupyTimeout (default 500 ms).
     inapplicable_s = rj_s == NF
-    if in_win_ms is not None and occupy_timeout_ms > 0:
+    if enable_occupy and in_win_ms is not None and occupy_timeout_ms > 0:
         wait_next = (jnp.int32(spec.win_ms) - in_win_ms).astype(jnp.int32)
-        can_time = wait_next <= occupy_timeout_ms
-        # passes that SURVIVE into window now+1: every bucket whose stamp
-        # is within the last B-1 windows (0 <= now - stamp <= B-2) — the
-        # oldest live bucket expires at the edge, the rest carry over
-        safe_main = jnp.minimum(sel_main_row, R - 1)
-        srow_stamps = main_second.stamps[safe_main]            # [BK, B]
-        sdelta = now_idx_s - srow_stamps
-        survive_mask = (sdelta >= 0) & (sdelta <= spec.buckets - 2)
-        surviving_bk = jnp.sum(
-            jnp.where(survive_mask,
-                      main_second.counters[safe_main, :, ev.PASS], 0),
-            axis=1).astype(jnp.float32)
-        prio_s = jnp.repeat(batch.prioritized, K)[order]
-        eligible_s = (prio_s & (grade_s == GRADE_QPS)
-                      & (behavior_s == BEHAVIOR_DEFAULT)
-                      & ~pass_default_s & ~inapplicable_s
-                      & ~use_alt[order] & can_time)
-        occ_base_s = surviving_bk[order] + nextw_bk[order]
-        occ_amt_s = jnp.where(eligible_s, acq_s, 0.0)
-        occ_admit_s = seg.greedy_admit(occ_base_s, occ_amt_s, limit_s,
+
+        def _occupy_attempt(_):
+            can_time = wait_next <= occupy_timeout_ms
+            # passes that SURVIVE into window now+1: every bucket whose
+            # stamp is within the last B-1 windows (0 <= now-stamp <= B-2)
+            # — the oldest live bucket expires at the edge, the rest carry
+            safe_main = jnp.minimum(sel_main_row, R - 1)
+            srow_stamps = main_second.stamps[safe_main]        # [BK, B]
+            sdelta = now_idx_s - srow_stamps
+            survive_mask = (sdelta >= 0) & (sdelta <= spec.buckets - 2)
+            surviving_bk = jnp.sum(
+                jnp.where(survive_mask,
+                          main_second.counters[safe_main, :, ev.PASS], 0),
+                axis=1).astype(jnp.float32)
+            prio_s = jnp.repeat(batch.prioritized, K)[order]
+            eligible_s = (prio_s & (grade_s == GRADE_QPS)
+                          & (behavior_s == BEHAVIOR_DEFAULT)
+                          & ~pass_default_s & ~inapplicable_s
+                          & ~use_alt[order] & can_time)
+            occ_base_s = surviving_bk[order] + nextw_bk[order]
+            occ_amt_s = jnp.where(eligible_s, acq_s, 0.0)
+            occ_adm = seg.greedy_admit(occ_base_s, occ_amt_s, limit_s,
                                        starts, leader) & eligible_s
 
-        # event-level gate BEFORE committing bookings: a booking is only
-        # real if the whole event is admitted by the flow slot — every
-        # failing pair of the event must itself be occupy-admitted
-        # (reference: PriorityWaitException is the admission)
-        pair_ok_tmp = jnp.where(is_rl, pass_rl_s,
-                                pass_default_s | occ_admit_s) | inapplicable_s
-        occ_admit_pairs = seg.unsort(
-            order, occ_admit_s.astype(jnp.int32)).astype(jnp.bool_)
-        pair_ok_pairs = seg.unsort(
-            order, pair_ok_tmp.astype(jnp.int32)).astype(jnp.bool_)
-        event_ok = jnp.all(pair_ok_pairs.reshape(B, K), axis=1)     # [B]
-        event_occ = (jnp.any(occ_admit_pairs.reshape(B, K), axis=1)
-                     & event_ok & batch.valid)                      # [B]
+            # event-level gate BEFORE committing bookings: a booking is
+            # only real if the whole event is admitted by the flow slot —
+            # every failing pair of the event must itself be
+            # occupy-admitted (PriorityWaitException is the admission)
+            pair_ok_tmp = jnp.where(is_rl, pass_rl_s,
+                                    pass_default_s | occ_adm) | inapplicable_s
+            occ_adm_pairs = seg.unsort(
+                order, occ_adm.astype(jnp.int32)).astype(jnp.bool_)
+            pair_ok_pairs = seg.unsort(
+                order, pair_ok_tmp.astype(jnp.int32)).astype(jnp.bool_)
+            event_ok = jnp.all(pair_ok_pairs.reshape(B, K), axis=1)  # [B]
+            event_occ = (jnp.any(occ_adm_pairs.reshape(B, K), axis=1)
+                         & event_ok & batch.valid)                   # [B]
 
-        # book ONE grant per admitted event on its resource row (the
-        # reference's first denying rule throws PriorityWait and books on
-        # the node once), slot ring keyed by window now+1
-        slots_n = occ_cnt.shape[1]
-        slot = (now_idx_s + 1) % slots_n
-        grants = jnp.zeros(occ_cnt.shape[0], jnp.float32).at[
-            jnp.where(event_occ, batch.rows, occ_cnt.shape[0])].add(
-            jnp.where(event_occ, batch.acquire, 0).astype(jnp.float32),
-            mode="drop")
-        granted_row = grants > 0
-        slot_keep = occ_win[:, slot] == now_idx_s + 1
-        new_cnt = jnp.where(granted_row,
-                            jnp.where(slot_keep, occ_cnt[:, slot], 0.0)
-                            + grants,
-                            occ_cnt[:, slot])
-        new_win = jnp.where(granted_row, now_idx_s + 1, occ_win[:, slot])
-        dyn = dyn._replace(
-            occupied_count=occ_cnt.at[:, slot].set(new_cnt),
-            occupied_window=occ_win.at[:, slot].set(new_win))
-        occ_admit_s = occ_admit_s & jnp.repeat(event_occ, K)[order]
+            # book ONE grant per admitted event on its resource row (the
+            # reference's first denying rule throws PriorityWait and books
+            # on the node once), slot ring keyed by window now+1
+            slots_n = occ_cnt.shape[1]
+            slot = (now_idx_s + 1) % slots_n
+            grants = jnp.zeros(occ_cnt.shape[0], jnp.float32).at[
+                jnp.where(event_occ, batch.rows, occ_cnt.shape[0])].add(
+                jnp.where(event_occ, batch.acquire, 0).astype(jnp.float32),
+                mode="drop")
+            granted_row = grants > 0
+            slot_keep = occ_win[:, slot] == now_idx_s + 1
+            new_cnt = jnp.where(granted_row,
+                                jnp.where(slot_keep, occ_cnt[:, slot], 0.0)
+                                + grants,
+                                occ_cnt[:, slot])
+            new_win = jnp.where(granted_row, now_idx_s + 1,
+                                occ_win[:, slot])
+            return (occ_cnt.at[:, slot].set(new_cnt),
+                    occ_win.at[:, slot].set(new_win),
+                    occ_adm & jnp.repeat(event_occ, K)[order])
+
+        def _no_occupy(_):
+            return (occ_cnt, occ_win,
+                    jnp.zeros_like(pass_default_s).astype(jnp.bool_))
+
+        # real control flow: batches with no prioritized events (the common
+        # case, and the whole benchmark) skip the occupy math entirely
+        new_occ_cnt, new_occ_win, occ_admit_s = jax.lax.cond(
+            jnp.any(batch.prioritized), _occupy_attempt, _no_occupy, None)
+        dyn = dyn._replace(occupied_count=new_occ_cnt,
+                           occupied_window=new_occ_win)
     else:
         occ_admit_s = jnp.zeros_like(pass_default_s).astype(jnp.bool_)
         wait_next = jnp.int32(0)

@@ -71,7 +71,10 @@ ENTRY_TYPE_IN = 1
 def _jitted_steps(spec: EngineSpec):
     """Compiled steps shared across Sentinel instances with the same geometry
     (EngineSpec is a frozen, hashable dataclass)."""
-    return (jax.jit(functools.partial(decide_entries, spec)),
+    return (jax.jit(functools.partial(decide_entries, spec,
+                                      enable_occupy=False)),
+            jax.jit(functools.partial(decide_entries, spec,
+                                      enable_occupy=True)),
             jax.jit(functools.partial(record_exits, spec)),
             jax.jit(functools.partial(invalidate_resource_rows, spec)),
             jax.jit(functools.partial(record_blocks, spec)))
@@ -255,11 +258,13 @@ class Sentinel:
         self.block_log = BlockStatLogger(self.clock)
         self.callbacks = StatisticCallbackRegistry()
 
-        (self._jit_decide, self._jit_exit, self._jit_invalidate,
-         self._jit_record_blocks) = _jitted_steps(self.spec)
+        (self._jit_decide, self._jit_decide_prio, self._jit_exit,
+         self._jit_invalidate, self._jit_record_blocks) = \
+            _jitted_steps(self.spec)
         self._token_service = None          # cluster TokenService (client or
         # embedded server facade); set via set_token_service
         self._cluster_rules_by_row: dict = {}
+        self._occupy_live_until_ms = -1     # last ms a booking can be live
 
     # ------------------------------------------------------------------
     # Rule management (XxxRuleManager.loadRules analog)
@@ -389,12 +394,14 @@ class Sentinel:
         return int((now_ms - self.epoch_ms + 2 ** 31) % 2 ** 32 - 2 ** 31)
 
     def _time_scalars(self, now_ms: int):
+        """Packed int32[4] time vector: ONE host→device transfer per step
+        (per-scalar transfers are hot-path latency on a tunneled TPU)."""
         s = self.spec
         idx_s = s.second.index_of(now_ms)
         idx_m = s.minute.index_of(now_ms) if s.minute else 0
-        return (jnp.int32(idx_s), jnp.int32(idx_m),
-                jnp.int32(self._rel_ms(now_ms)),
-                jnp.int32(now_ms % s.second.win_ms))
+        return jnp.asarray(np.array(
+            [idx_s, idx_m, self._rel_ms(now_ms),
+             now_ms % s.second.win_ms], np.int32))
 
     # ------------------------------------------------------------------
     # Per-call API
@@ -487,14 +494,16 @@ class Sentinel:
     def _cluster_check(self, resource: str, origin: str, row: int,
                        o_row: int, c_row: int, acquire: int, is_in: bool,
                        prioritized: bool, crules,
-                       sleep: bool = True) -> Tuple[bool, int]:
+                       sleep: bool = True,
+                       record: bool = True) -> Tuple[bool, int]:
         """``passClusterCheck`` for this resource's cluster-mode rules.
         Returns ``(need_local_fallback, pending_wait_ms)``; raises
         FlowException on BLOCKED and records the block like StatisticSlot
         would. With ``sleep=False`` SHOULD_WAIT waits are returned instead
         of slept (async callers await them via ``Entry.wait_ms``)."""
         svc = self._token_service
-        need_fallback = False
+        fallback_wanted = False
+        granted = 0
         pending_wait = 0
         for r in crules:
             status, wait = -1, 0           # FAIL when no service installed
@@ -509,8 +518,10 @@ class Sentinel:
                     record_log().warning(
                         "cluster token request failed: %r", exc)
             if status == 0:                # OK
+                granted += 1
                 continue
             if status == 2:                # SHOULD_WAIT → sleep, then pass
+                granted += 1
                 if wait > 0:
                     if sleep:
                         self.clock.sleep_ms(wait)
@@ -518,18 +529,19 @@ class Sentinel:
                         pending_wait += wait
                 continue
             if status in (1, -2):          # BLOCKED / TOO_MANY_REQUEST
-                now = self.clock.now_ms()
-                idx_s, idx_m, _rel, _w = self._time_scalars(now)
-                with self._lock:
-                    self._state = self._jit_record_blocks(
-                        self._state,
-                        jnp.asarray(np.array([row], np.int32)),
-                        jnp.asarray(np.array([o_row], np.int32)),
-                        jnp.asarray(np.array([c_row], np.int32)),
-                        jnp.asarray(np.array([acquire], np.int32)),
-                        jnp.asarray(np.array([is_in], np.bool_)),
-                        jnp.asarray(np.array([True], np.bool_)),
-                        idx_s, idx_m)
+                if record:
+                    now = self.clock.now_ms()
+                    times = self._time_scalars(now)
+                    with self._lock:
+                        self._state = self._jit_record_blocks(
+                            self._state,
+                            jnp.asarray(np.array([row], np.int32)),
+                            jnp.asarray(np.array([o_row], np.int32)),
+                            jnp.asarray(np.array([c_row], np.int32)),
+                            jnp.asarray(np.array([acquire], np.int32)),
+                            jnp.asarray(np.array([is_in], np.bool_)),
+                            jnp.asarray(np.array([True], np.bool_)),
+                            times)
                 exc = block_exception_for(int(BlockReason.FLOW), resource,
                                           origin=origin)
                 self.block_log.log(resource, type(exc).__name__,
@@ -540,8 +552,17 @@ class Sentinel:
                 raise exc
             # FAIL / NO_RULE_EXISTS / BAD_REQUEST → local check or pass
             if r.cluster_fallback_to_local:
-                need_fallback = True
-        return need_fallback, pending_wait
+                fallback_wanted = True
+        # the local-fallback flag re-enables ALL the resource's cluster
+        # rules in the local pipeline, so it must not fire when some rule's
+        # token was explicitly granted (that would double-limit an admitted
+        # request); mixed grant/failure passes the failed rules through
+        if fallback_wanted and granted:
+            from sentinel_tpu.core.logs import record_log
+            record_log().warning(
+                "cluster rules for %s partially failed; failed rules pass "
+                "through (no local fallback while others granted)", resource)
+        return fallback_wanted and not granted, pending_wait
 
     def _resolve_param_pairs_one(self, row: int, args: Sequence):
         """→ (rules [PV], keys [PV], generation, registry), or None when the
@@ -677,12 +698,12 @@ class Sentinel:
         cl_blocked = None
         cl_waits = None
         cluster_fb_arr = None
-        rows_for_decide = rows
+        valid_mask = None
         if self._cluster_rules_by_row:
             fallback = np.zeros(n, np.bool_)
             cl_blocked = np.zeros(n, np.bool_)
             cl_waits = np.zeros(n, np.int32)
-            rows_for_decide = np.array(rows, np.int32, copy=True)
+            valid_mask = np.ones(n, np.bool_)
             for i in range(n):
                 crules = self._cluster_rules_by_row.get(int(rows[i]))
                 if not crules:
@@ -694,20 +715,42 @@ class Sentinel:
                          and origins[i] else ""),
                         int(rows[i]), int(origin_rows[i]),
                         int(chain_rows[i]), int(acq[i]), bool(is_in[i]),
-                        bool(prio[i]), crules, sleep=False)
+                        bool(prio[i]), crules, sleep=False, record=False)
                     fallback[i] = fb
                     cl_waits[i] = w
                 except BlockException:
                     cl_blocked[i] = True
-                    rows_for_decide[i] = self.spec.rows   # padding: no stats
+                    valid_mask[i] = False   # out of the local decide entirely
             if fallback.any():
                 cluster_fb_arr = fallback
+            # one batched device record for every cluster-blocked event
+            if cl_blocked.any():
+                idxs = np.nonzero(cl_blocked)[0]
+                m = len(idxs)
+                bm = self._pad(m)
+                times = self._time_scalars(self.clock.now_ms())
+                with self._lock:
+                    self._state = self._jit_record_blocks(
+                        self._state,
+                        jnp.asarray(_pad_to(rows[idxs], bm, self.spec.rows,
+                                            np.int32)),
+                        jnp.asarray(_pad_to(origin_rows[idxs], bm,
+                                            self.spec.alt_rows, np.int32)),
+                        jnp.asarray(_pad_to(chain_rows[idxs], bm,
+                                            self.spec.alt_rows, np.int32)),
+                        jnp.asarray(_pad_to(acq[idxs], bm, 0, np.int32)),
+                        jnp.asarray(_pad_to(is_in[idxs], bm, False,
+                                            np.bool_)),
+                        jnp.asarray(_pad_to(np.ones(m, np.bool_), bm, False,
+                                            np.bool_)),
+                        times)
 
-        verdicts = self.decide_raw(rows_for_decide, origin_ids, origin_rows,
+        verdicts = self.decide_raw(rows, origin_ids, origin_rows,
                                    context_ids, chain_rows, acq, is_in, prio,
                                    param_rules=param_rules,
                                    param_keys=param_keys, param_gen=param_gen,
-                                   cluster_fallback=cluster_fb_arr)
+                                   cluster_fallback=cluster_fb_arr,
+                                   valid=valid_mask)
         if cl_blocked is not None and cl_blocked.any():
             allow = np.array(verdicts.allow, copy=True)
             reason = np.array(verdicts.reason, copy=True)
@@ -752,7 +795,7 @@ class Sentinel:
     def decide_raw(self, rows, origin_ids, origin_rows, context_ids, chain_rows,
                    acquire, is_in, prioritized, *, param_rules=None,
                    param_keys=None, param_gen: int = -1,
-                   cluster_fallback=None) -> Verdicts:
+                   cluster_fallback=None, valid=None) -> Verdicts:
         """Lowest-level host entry point: pre-resolved numpy arrays.
         ``param_gen`` is the generation the pair arrays were resolved against;
         stale pairs (a reload raced the resolve) are dropped, not misapplied."""
@@ -769,24 +812,36 @@ class Sentinel:
             acquire=_pad_to(acquire, b, 0, np.int32),
             is_in=_pad_to(is_in, b, False, np.bool_),
             prioritized=_pad_to(prioritized, b, False, np.bool_),
-            valid=_pad_to(np.ones(n, np.bool_), b, False, np.bool_),
+            valid=_pad_to(valid if valid is not None
+                          else np.ones(n, np.bool_), b, False, np.bool_),
             param_rules=self._pad_pairs(param_rules, b, self.cfg.max_param_rules),
             param_keys=self._pad_pairs(param_keys, b, self.spec.param_keys),
             cluster_fallback=(_pad_to(cluster_fallback, b, False, np.bool_)
                               if cluster_fallback is not None else None),
         )
         now = self.clock.now_ms()
-        idx_s, idx_m, rel, in_win = self._time_scalars(now)
+        times = self._time_scalars(now)
         load1, cpu = self._cpu.sample()
+        sys_scalars = jnp.asarray(np.array([load1, cpu], np.float32))
         with self._lock:
             # gen check must happen under the same lock that guards reloads,
             # or a reload racing here could land stale pairs on the new table
             if batch.param_rules is not None and param_gen != self._param_gen:
                 batch = batch._replace(param_rules=None, param_keys=None)
             self._drain_evictions_locked()
-            state, verdicts = self._jit_decide(
-                self._ruleset, self._state, batch, idx_s, idx_m, rel,
-                jnp.float32(load1), jnp.float32(cpu), in_win)
+            # static occupy variant: the occupy-aware pipeline runs only
+            # when this batch is prioritized OR a previous booking can
+            # still be live (bookings last ≤ B+1 windows); everything else
+            # compiles to a pipeline with zero occupy code
+            any_prio = bool(prioritized.any())
+            if any_prio:
+                self._occupy_live_until_ms = now + (
+                    (self.spec.second.buckets + 1)
+                    * self.spec.second.win_ms)
+            use_occ = any_prio or now < self._occupy_live_until_ms
+            decide = self._jit_decide_prio if use_occ else self._jit_decide
+            state, verdicts = decide(
+                self._ruleset, self._state, batch, times, sys_scalars)
             self._state = state
         return Verdicts(allow=np.asarray(verdicts.allow)[:n],
                         reason=np.asarray(verdicts.reason)[:n],
@@ -810,7 +865,7 @@ class Sentinel:
             param_keys=self._pad_pairs(param_keys, b, self.spec.param_keys),
         )
         now = self.clock.now_ms()
-        idx_s, idx_m, rel, _in_win = self._time_scalars(now)
+        times = self._time_scalars(now)
         with self._lock:
             unpin = None
             if batch.param_rules is not None:
@@ -823,7 +878,7 @@ class Sentinel:
                              pf_mod.thread_key_rows(self._param, param_rules,
                                                     param_keys))
             self._state = self._jit_exit(self._ruleset, self._state, batch,
-                                         idx_s, idx_m, rel)
+                                         times)
         # unpin only AFTER the device-side decrement is enqueued (entry-side
         # pin discipline: resolve→pin, decide, exit-decrement→unpin)
         if unpin is not None:

@@ -1,0 +1,90 @@
+"""Cluster mode coordinator: dashboard mode flips become real token
+client/server lifecycles (reference ClusterStateManager + embedded token
+server, SURVEY §2.8.4 'any instance can become the token server')."""
+
+import pytest
+
+import sentinel_tpu as stpu
+from sentinel_tpu.cluster.coordinator import (
+    CLUSTER_CLIENT, CLUSTER_NOT_STARTED, CLUSTER_SERVER, ClusterCoordinator,
+)
+from sentinel_tpu.parallel.cluster import THRESHOLD_GLOBAL, ClusterFlowRule
+from sentinel_tpu.core.clock import ManualClock
+
+T0 = 1_785_000_000_000
+
+
+@pytest.fixture
+def sph():
+    cfg = stpu.load_config(max_resources=64, max_flow_rules=16,
+                           max_degrade_rules=16, max_authority_rules=16)
+    return stpu.Sentinel(config=cfg, clock=ManualClock(start_ms=T0))
+
+
+def _drain(sph, n):
+    out = []
+    for _ in range(n):
+        try:
+            with sph.entry("gsvc"):
+                out.append("pass")
+        except stpu.BlockException:
+            out.append("block")
+    return out
+
+
+def test_server_mode_serves_own_rules_embedded(sph):
+    coord = ClusterCoordinator(sph, clock=ManualClock(start_ms=T0))
+    try:
+        sph.load_flow_rules([stpu.FlowRule(
+            resource="gsvc", count=1000, cluster_mode=True,
+            cluster_flow_id=7, cluster_fallback_to_local=True)])
+        coord.on_mode_change(CLUSTER_SERVER)
+        assert coord.server is not None and coord.server.port > 0
+        coord.server.load_flow_rules(coord.namespace, [ClusterFlowRule(
+            flow_id=7, count=2, threshold_type=THRESHOLD_GLOBAL)])
+        assert _drain(sph, 4) == ["pass", "pass", "block", "block"]
+    finally:
+        coord.stop()
+
+
+def test_mode_off_uninstalls_service(sph):
+    coord = ClusterCoordinator(sph, clock=ManualClock(start_ms=T0))
+    try:
+        sph.load_flow_rules([stpu.FlowRule(
+            resource="gsvc", count=1.0, cluster_mode=True,
+            cluster_flow_id=7, cluster_fallback_to_local=True)])
+        coord.on_mode_change(CLUSTER_SERVER)
+        coord.on_mode_change(CLUSTER_NOT_STARTED)
+        assert coord.server is None
+        # no service → FAIL path → local fallback enforces count=1
+        assert _drain(sph, 3) == ["pass", "block", "block"]
+    finally:
+        coord.stop()
+
+
+def test_client_mode_talks_to_remote_server(sph):
+    server_app = stpu.Sentinel(stpu.load_config(
+        max_resources=64, max_flow_rules=16, max_degrade_rules=16,
+        max_authority_rules=16), clock=ManualClock(start_ms=T0))
+    server_coord = ClusterCoordinator(server_app,
+                                      clock=ManualClock(start_ms=T0))
+    client_coord = ClusterCoordinator(sph, namespace=server_coord.namespace,
+                                      clock=ManualClock(start_ms=T0))
+    try:
+        server_coord.on_mode_change(CLUSTER_SERVER)
+        server_coord.server.load_flow_rules(
+            server_coord.namespace,
+            [ClusterFlowRule(flow_id=7, count=2,
+                             threshold_type=THRESHOLD_GLOBAL)])
+        sph.load_flow_rules([stpu.FlowRule(
+            resource="gsvc", count=1000, cluster_mode=True,
+            cluster_flow_id=7, cluster_fallback_to_local=False)])
+        client_coord.configure_client("127.0.0.1", server_coord.server.port,
+                                      request_timeout_ms=60_000)
+        client_coord.on_mode_change(CLUSTER_CLIENT)
+        assert client_coord.client is not None
+        res = _drain(sph, 4)
+        assert res.count("pass") == 2 and res.count("block") == 2
+    finally:
+        client_coord.stop()
+        server_coord.stop()

@@ -164,3 +164,23 @@ def test_entry_batch_enforces_cluster_rules(clk):
     # both denials recorded in stats (cluster block + local fallback block)
     t = sph.node_totals("csvc")
     assert t["block"] == 2 and t["pass"] == 2
+
+
+def test_batch_cluster_block_leaves_no_stat_residue(clk):
+    """A cluster-blocked batch event must not count PASS on the ENTRY node
+    or leak a thread (it never enters the local pipeline)."""
+    from sentinel_tpu.metrics.node import TOTAL_IN_RESOURCE_NAME
+
+    sph = make(clk)
+    svc = FakeTokenService()
+    svc.script = [_Result(1)]            # BLOCKED
+    sph.set_token_service(svc)
+    sph.load_flow_rules([cluster_rule()])
+    v = sph.entry_batch(["csvc"])
+    assert not bool(v.allow[0])
+    t = sph.node_totals("csvc")
+    assert t["pass"] == 0 and t["block"] == 1 and t["threads"] == 0
+    entry_totals = {name: tot for name, _row, tot in sph.all_node_totals()}
+    g = entry_totals.get("__entry_node__") or entry_totals.get(
+        TOTAL_IN_RESOURCE_NAME)
+    assert g["pass"] == 0 and g["threads"] == 0 and g["block"] == 1
