@@ -60,6 +60,12 @@ class _EmbeddedTokenService:
         return EmbeddedTokenResult(status=status, wait_ms=wait,
                                    remaining=remaining)
 
+    def request_param_token(self, flow_id: int, count: int, params):
+        status, wait, remaining = self.engine.request_param_tokens(
+            [flow_id], [count], [list(params)], now_ms=self._now())[0]
+        return EmbeddedTokenResult(status=status, wait_ms=wait,
+                                   remaining=remaining)
+
 
 class ClusterCoordinator:
     def __init__(self, sentinel, *, namespace: Optional[str] = None,
@@ -80,6 +86,31 @@ class ClusterCoordinator:
         self.server_port_client = 18730
         self.request_timeout_ms = 20
 
+    # ---------------------------------------------------------------- wiring
+    def bind(self, cluster_state) -> None:
+        """Attach to a transport :class:`ClusterModeState`: mode flips and
+        client-config pushes from the dashboard drive this coordinator, and
+        ``getClusterMode`` reports the live token-server port."""
+        cluster_state.add_observer(self.on_mode_change)
+        cluster_state.add_config_observer(
+            lambda cfg: self.configure_client(
+                cfg["serverHost"], int(cfg["serverPort"]),
+                int(cfg["requestTimeout"])
+                if "requestTimeout" in cfg else None))
+        cluster_state.info_provider = self.info
+
+    def info(self) -> dict:
+        # lock-free snapshot: a mode change can hold the lock for seconds
+        # (engine compile) and getClusterMode must not block behind it
+        out = {"effectiveMode": self.mode}
+        server, client = self.server, self.client
+        if server is not None:
+            out["serverPort"] = server.port
+        if client is not None:
+            out["serverHost"] = self.server_host
+            out["clientServerPort"] = self.server_port_client
+        return out
+
     # ---------------------------------------------------------------- config
     def configure_client(self, host: str, port: int,
                          request_timeout_ms: Optional[int] = None) -> None:
@@ -92,7 +123,14 @@ class ClusterCoordinator:
                 self.request_timeout_ms = request_timeout_ms
             if self.mode == CLUSTER_CLIENT:
                 self._stop_client_locked()
-                self._start_client_locked()
+                try:
+                    self._start_client_locked()
+                except Exception as exc:
+                    # same contract as on_mode_change: a failed restart
+                    # leaves a retryable NOT_STARTED, never a phantom CLIENT
+                    self.mode = CLUSTER_NOT_STARTED
+                    record_log().warning(
+                        "cluster client reconfigure failed: %r", exc)
 
     # ---------------------------------------------------------------- modes
     def on_mode_change(self, mode: int) -> None:

@@ -97,3 +97,13 @@ class SentinelApiClient:
     def set_cluster_mode(self, ip: str, port: int, mode: int) -> bool:
         resp = self._post(ip, port, "setClusterMode", {"mode": str(mode)})
         return "success" in resp
+
+    def set_cluster_client_config(self, ip: str, port: int,
+                                  server_host: str, server_port: int,
+                                  request_timeout: int = 0) -> bool:
+        cfg = {"serverHost": server_host, "serverPort": server_port}
+        if request_timeout:
+            cfg["requestTimeout"] = request_timeout
+        resp = self._post(ip, port, "setClusterClientConfig",
+                          {"data": json.dumps(cfg)})
+        return "success" in resp

@@ -206,6 +206,42 @@ class Dashboard:
             return _fail(str(exc))
         return _ok(ok)
 
+    def cluster_assign(self, app: str, server_ip: str, server_port: int,
+                       request_timeout_ms: int = 10_000) -> dict:
+        """One-click topology (reference ``ClusterAssignService``): make the
+        named machine the token server, then bind every other healthy
+        machine of the app as a client of it."""
+        server_machine = self.apps.get_machine(app, server_ip, server_port)
+        if server_machine is None:
+            return _fail(f"machine {server_ip}:{server_port} not registered")
+        try:
+            if not self.client.set_cluster_mode(server_ip, server_port, 1):
+                return _fail("failed to switch server machine to SERVER mode")
+            state = self.client.get_cluster_mode(server_ip, server_port)
+        except AgentUnreachable as exc:
+            return _fail(str(exc))
+        token_port = int(state.get("serverPort", 0) or 0)
+        if not token_port:
+            return _fail("server machine reports no token-server port")
+        bound, failed = [], []
+        for m in self.apps.healthy_machines(app, self._now_ms()):
+            if m.ip == server_ip and m.port == server_port:
+                continue
+            try:
+                # generous default timeout: the server engine's first step
+                # jit-compiles for seconds; the reference's 20 ms assumes a
+                # warm JVM (clients can be retuned later via the same cmd)
+                ok = (self.client.set_cluster_client_config(
+                          m.ip, m.port, server_ip, token_port,
+                          request_timeout=request_timeout_ms)
+                      and self.client.set_cluster_mode(m.ip, m.port, 0))
+            except AgentUnreachable:
+                ok = False
+            (bound if ok else failed).append(f"{m.ip}:{m.port}")
+        return _ok({"server": f"{server_ip}:{server_port}",
+                    "tokenPort": token_port,
+                    "clients": bound, "failed": failed})
+
 
 class _Handler(BaseHTTPRequestHandler):
     dash: Dashboard
@@ -326,6 +362,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(d.set_cluster_mode(
                 str(p.get("app", "")), str(p.get("ip", "")),
                 int(p.get("port", 0) or 0), int(p.get("mode", 0) or 0)))
+            return
+        if method == "POST" and path == "/cluster/assign":
+            p = self._body_params(body)
+            self._json(d.cluster_assign(
+                str(p.get("app", "")), str(p.get("serverIp", "")),
+                int(p.get("serverPort", 0) or 0),
+                request_timeout_ms=int(p.get("requestTimeout",
+                                             10_000) or 10_000)))
             return
 
         m = re.fullmatch(r"/v1/([^/]+)/rules", path)

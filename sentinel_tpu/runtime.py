@@ -264,6 +264,7 @@ class Sentinel:
         self._token_service = None          # cluster TokenService (client or
         # embedded server facade); set via set_token_service
         self._cluster_rules_by_row: dict = {}
+        self._cluster_param_rules_by_row: dict = {}
         self._occupy_live_until_ms = -1     # last ms a booking can be live
 
     # ------------------------------------------------------------------
@@ -351,12 +352,22 @@ class Sentinel:
 
     def _reload_param_rules(self) -> None:
         cfg = self.cfg
-        rules = self._user_param_rules + self._gateway_param_rules
+        all_rules = self._user_param_rules + self._gateway_param_rules
+        # cluster-mode param rules delegate to the token server
+        # (ParamFlowChecker.passClusterCheck → requestParamToken); only the
+        # local ones compile into the device table
+        rules = [r for r in all_rules if not r.cluster_mode]
+        cluster_map: dict = {}
+        for r in all_rules:
+            if r.cluster_mode:
+                row = self.resources.get_or_create(r.resource)
+                cluster_map.setdefault(row, []).append(r)
         compiled = pf_mod.compile_param_rules(
             rules, resource_registry=self.resources,
             capacity=cfg.max_param_rules,
             k_per_resource=cfg.max_rules_per_resource)
         with self._lock:
+            self._cluster_param_rules_by_row = cluster_map
             self._param = compiled
             self._ruleset = self._build_ruleset()
             # rule slots changed meaning: fresh key interning + cold key state
@@ -444,6 +455,11 @@ class Sentinel:
             cluster_fb, cluster_wait = self._cluster_check(
                 resource, use_origin or "", row, o_row, c_row, acquire,
                 is_in, prioritized, crules, sleep)
+        cprules = self._cluster_param_rules_by_row.get(row)
+        if cprules and args:
+            cluster_wait += self._cluster_param_check(
+                resource, use_origin or "", row, o_row, c_row, acquire,
+                is_in, args, cprules, sleep)
 
         pairs = self._resolve_param_pairs_one(row, args)
         pr = pk = None
@@ -491,6 +507,29 @@ class Sentinel:
             e.wait_ms = wait
         return e
 
+    def _record_cluster_block(self, reason: int, resource: str, origin: str,
+                              row: int, o_row: int, c_row: int,
+                              acquire: int, is_in: bool) -> BlockException:
+        """Record + log + fire callbacks for a token-server denial; returns
+        the exception for the caller to raise (StatisticSlot accounting for
+        blocks decided off-device)."""
+        times = self._time_scalars(self.clock.now_ms())
+        with self._lock:
+            self._state = self._jit_record_blocks(
+                self._state,
+                jnp.asarray(np.array([row], np.int32)),
+                jnp.asarray(np.array([o_row], np.int32)),
+                jnp.asarray(np.array([c_row], np.int32)),
+                jnp.asarray(np.array([acquire], np.int32)),
+                jnp.asarray(np.array([is_in], np.bool_)),
+                jnp.asarray(np.array([True], np.bool_)),
+                times)
+        exc = block_exception_for(reason, resource, origin=origin)
+        self.block_log.log(resource, type(exc).__name__, origin=origin)
+        if not self.callbacks.empty:
+            self.callbacks.fire_blocked(resource, origin, acquire, exc)
+        return exc
+
     def _cluster_check(self, resource: str, origin: str, row: int,
                        o_row: int, c_row: int, acquire: int, is_in: bool,
                        prioritized: bool, crules,
@@ -530,18 +569,9 @@ class Sentinel:
                 continue
             if status in (1, -2):          # BLOCKED / TOO_MANY_REQUEST
                 if record:
-                    now = self.clock.now_ms()
-                    times = self._time_scalars(now)
-                    with self._lock:
-                        self._state = self._jit_record_blocks(
-                            self._state,
-                            jnp.asarray(np.array([row], np.int32)),
-                            jnp.asarray(np.array([o_row], np.int32)),
-                            jnp.asarray(np.array([c_row], np.int32)),
-                            jnp.asarray(np.array([acquire], np.int32)),
-                            jnp.asarray(np.array([is_in], np.bool_)),
-                            jnp.asarray(np.array([True], np.bool_)),
-                            times)
+                    raise self._record_cluster_block(
+                        int(BlockReason.FLOW), resource, origin, row,
+                        o_row, c_row, acquire, is_in)
                 exc = block_exception_for(int(BlockReason.FLOW), resource,
                                           origin=origin)
                 self.block_log.log(resource, type(exc).__name__,
@@ -563,6 +593,49 @@ class Sentinel:
                 "cluster rules for %s partially failed; failed rules pass "
                 "through (no local fallback while others granted)", resource)
         return fallback_wanted and not granted, pending_wait
+
+    def _cluster_param_check(self, resource: str, origin: str, row: int,
+                             o_row: int, c_row: int, acquire: int,
+                             is_in: bool, args: Sequence, cprules,
+                             sleep: bool = True) -> int:
+        """``ParamFlowChecker.passClusterCheck`` → ``requestParamToken`` for
+        cluster-mode hot-param rules. BLOCKED raises ParamFlowException and
+        records the block; failures pass through with a log (the local
+        fallback for param rules is a documented pass-through here — the
+        flow path carries the exact local fallback)."""
+        svc = self._token_service
+        pending_wait = 0
+        for r in cprules:
+            idx = r.param_idx if r.param_idx >= 0 else len(args) + r.param_idx
+            if idx < 0 or idx >= len(args):
+                continue                      # no such arg → rule passes
+            value = args[idx]
+            status, wait = -1, 0
+            if svc is not None:
+                try:
+                    res = svc.request_param_token(r.cluster_flow_id, acquire,
+                                                  [value])
+                    status = int(res.status)
+                    wait = int(getattr(res, "wait_ms", 0))
+                except Exception as exc:
+                    from sentinel_tpu.core.logs import record_log
+                    record_log().warning(
+                        "cluster param token request failed: %r", exc)
+            if status == 0:
+                continue
+            if status == 2:
+                if wait > 0:
+                    if sleep:
+                        self.clock.sleep_ms(wait)
+                    else:
+                        pending_wait += wait
+                continue
+            if status in (1, -2):             # BLOCKED / TOO_MANY
+                raise self._record_cluster_block(
+                    int(BlockReason.PARAM_FLOW), resource, origin, row,
+                    o_row, c_row, acquire, is_in)
+            # FAIL / NO_RULE: pass through (logged above when RPC failed)
+        return pending_wait
 
     def _resolve_param_pairs_one(self, row: int, args: Sequence):
         """→ (rules [PV], keys [PV], generation, registry), or None when the
@@ -699,25 +772,33 @@ class Sentinel:
         cl_waits = None
         cluster_fb_arr = None
         valid_mask = None
-        if self._cluster_rules_by_row:
+        if self._cluster_rules_by_row or self._cluster_param_rules_by_row:
             fallback = np.zeros(n, np.bool_)
             cl_blocked = np.zeros(n, np.bool_)
             cl_waits = np.zeros(n, np.int32)
             valid_mask = np.ones(n, np.bool_)
             for i in range(n):
                 crules = self._cluster_rules_by_row.get(int(rows[i]))
-                if not crules:
+                cprules = self._cluster_param_rules_by_row.get(int(rows[i]))
+                if not crules and not cprules:
                     continue
+                org = (origins[i] if origins is not None
+                       and origins[i] else "")
                 try:
-                    fb, w = self._cluster_check(
-                        resources[i],
-                        (origins[i] if origins is not None
-                         and origins[i] else ""),
-                        int(rows[i]), int(origin_rows[i]),
-                        int(chain_rows[i]), int(acq[i]), bool(is_in[i]),
-                        bool(prio[i]), crules, sleep=False, record=False)
-                    fallback[i] = fb
-                    cl_waits[i] = w
+                    if crules:
+                        fb, w = self._cluster_check(
+                            resources[i], org, int(rows[i]),
+                            int(origin_rows[i]), int(chain_rows[i]),
+                            int(acq[i]), bool(is_in[i]), bool(prio[i]),
+                            crules, sleep=False, record=False)
+                        fallback[i] = fb
+                        cl_waits[i] = w
+                    if cprules and args_list is not None and args_list[i]:
+                        cl_waits[i] += self._cluster_param_check(
+                            resources[i], org, int(rows[i]),
+                            int(origin_rows[i]), int(chain_rows[i]),
+                            int(acq[i]), bool(is_in[i]), args_list[i],
+                            cprules, sleep=False)
                 except BlockException:
                     cl_blocked[i] = True
                     valid_mask[i] = False   # out of the local decide entirely

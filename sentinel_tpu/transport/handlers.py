@@ -34,22 +34,36 @@ class ClusterModeState:
     """Per-process cluster mode cell (``ClusterStateManager`` analog).
 
     ``on_change(mode)`` hooks let the embedding app start/stop its token
-    client/server when the dashboard flips the mode.
+    client/server when the dashboard flips the mode; client-config
+    observers mirror ``ClusterClientConfigManager``'s ServerChangeObserver;
+    ``info_provider`` lets the running server/client report live details
+    (e.g. the bound token-server port) through ``getClusterMode``.
     """
 
     def __init__(self) -> None:
         self.mode = CLUSTER_NOT_STARTED
         self.last_modified_ms = 0
+        self.client_config: Dict[str, Any] = {}
+        self.info_provider: Optional[Callable[[], Dict[str, Any]]] = None
         self._observers: list = []
+        self._config_observers: list = []
 
     def add_observer(self, fn: Callable[[int], None]) -> None:
         self._observers.append(fn)
+
+    def add_config_observer(self, fn: Callable[[Dict[str, Any]], None]) -> None:
+        self._config_observers.append(fn)
 
     def set_mode(self, mode: int, now_ms: int = 0) -> None:
         self.mode = mode
         self.last_modified_ms = now_ms
         for fn in list(self._observers):
             fn(mode)
+
+    def set_client_config(self, config: Dict[str, Any]) -> None:
+        self.client_config = dict(config)
+        for fn in list(self._config_observers):
+            fn(self.client_config)
 
 
 def register_default_handlers(
@@ -212,11 +226,40 @@ def register_default_handlers(
     # ---- cluster mode ----------------------------------------------------
 
     def cmd_get_cluster_mode(req: CommandRequest) -> CommandResponse:
-        return CommandResponse.of_success(json.dumps({
+        info = {
             "mode": cstate.mode,
             "lastModified": cstate.last_modified_ms,
             "clientAvailable": True, "serverAvailable": True,
-        }))
+        }
+        if cstate.info_provider is not None:
+            try:
+                info.update(cstate.info_provider() or {})
+            except Exception:
+                pass
+        return CommandResponse.of_success(json.dumps(info))
+
+    def cmd_get_cluster_client_config(req: CommandRequest) -> CommandResponse:
+        return CommandResponse.of_success(json.dumps(cstate.client_config))
+
+    def cmd_set_cluster_client_config(req: CommandRequest) -> CommandResponse:
+        """``cluster/client/modifyConfig`` analog: point the token client at
+        a (new) server; a running client reconnects via the observers."""
+        data = req.param("data")
+        if not data and req.body:
+            try:
+                data = req.body.decode("utf-8")
+            except UnicodeDecodeError:
+                return CommandResponse.of_failure("invalid body", 400)
+        try:
+            cfg_in = json.loads(data or "{}")
+            cfg_out = {"serverHost": str(cfg_in["serverHost"]),
+                       "serverPort": int(cfg_in["serverPort"])}
+            if "requestTimeout" in cfg_in:
+                cfg_out["requestTimeout"] = int(cfg_in["requestTimeout"])
+        except (ValueError, KeyError, TypeError) as exc:
+            return CommandResponse.of_failure(f"invalid config: {exc}", 400)
+        cstate.set_client_config(cfg_out)
+        return CommandResponse.of_success("success")
 
     def cmd_set_cluster_mode(req: CommandRequest) -> CommandResponse:
         try:
@@ -246,6 +289,10 @@ def register_default_handlers(
         ("systemStatus", "system adaptive status", cmd_system_status),
         ("getClusterMode", "get cluster mode", cmd_get_cluster_mode),
         ("setClusterMode", "set cluster mode", cmd_set_cluster_mode),
+        ("getClusterClientConfig", "get cluster client config",
+         cmd_get_cluster_client_config),
+        ("setClusterClientConfig", "point the token client at a server",
+         cmd_set_cluster_client_config),
     ]:
         center.register(fn, name, desc)
 

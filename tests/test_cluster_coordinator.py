@@ -88,3 +88,83 @@ def test_client_mode_talks_to_remote_server(sph):
     finally:
         client_coord.stop()
         server_coord.stop()
+
+
+def test_dashboard_cluster_assign_end_to_end():
+    """Dashboard /cluster/assign: one machine becomes the token server,
+    the other a client of it; a cluster rule is then enforced globally
+    (reference ClusterAssignService flow)."""
+    import json
+    import time
+    import urllib.request
+
+    from sentinel_tpu.dashboard import Dashboard, DashboardServer
+    from sentinel_tpu.transport import start_transport
+
+    def mk_app():
+        cfg = stpu.load_config(max_resources=64, max_flow_rules=16,
+                               max_degrade_rules=16, max_authority_rules=16)
+        sph = stpu.Sentinel(config=cfg, clock=ManualClock(start_ms=T0))
+        coord = ClusterCoordinator(sph, namespace="shared-ns",
+                                   clock=ManualClock(start_ms=T0))
+        return sph, coord
+
+    dash = DashboardServer(Dashboard(password=""), host="127.0.0.1", port=0)
+    dport = dash.start(fetch=False)
+    apps = []
+    try:
+        for _ in range(2):
+            sph, coord = mk_app()
+            rt = start_transport(sph, host="0.0.0.0", port=0,
+                                 dashboard_addr=f"127.0.0.1:{dport}",
+                                 clock=sph.clock)
+            coord.bind(rt.cluster_state)
+            # raise the client RPC budget: first engine step jit-compiles
+            coord.request_timeout_ms = 60_000
+            apps.append((sph, coord, rt))
+        time.sleep(0.8)                 # heartbeats land
+
+        app_name = apps[0][0].cfg.app_name
+        machines = dash.dashboard.apps.healthy_machines(app_name)
+        assert len(machines) == 2
+        server_m = machines[0]
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{dport}/cluster/assign", method="POST",
+            data=json.dumps({"app": app_name, "serverIp": server_m.ip,
+                             "serverPort": server_m.port}).encode(),
+            headers={"Content-Type": "application/json"})
+        out = json.loads(urllib.request.urlopen(req).read().decode())
+        assert out["success"], out
+        assert out["data"]["tokenPort"] > 0
+        assert len(out["data"]["clients"]) == 1 and not out["data"]["failed"]
+
+        # figure out which app is the server vs the client
+        server_app = next(a for a in apps if a[1].server is not None)
+        client_app = next(a for a in apps if a[1].client is not None)
+        server_app[1].server.load_flow_rules("shared-ns", [
+            __import__("sentinel_tpu.parallel.cluster",
+                       fromlist=["ClusterFlowRule"]).ClusterFlowRule(
+                flow_id=5, count=2, threshold_type=THRESHOLD_GLOBAL)])
+
+        rule = stpu.FlowRule(resource="gsvc", count=1000, cluster_mode=True,
+                             cluster_flow_id=5,
+                             cluster_fallback_to_local=False)
+        for sph, _c, _rt in (server_app, client_app):
+            sph.load_flow_rules([rule])
+
+        # global budget 2: server app takes both, client app gets blocked
+        ok = blocked = 0
+        for sph in (server_app[0], server_app[0], client_app[0],
+                    client_app[0]):
+            try:
+                with sph.entry("gsvc"):
+                    ok += 1
+            except stpu.BlockException:
+                blocked += 1
+        assert ok == 2 and blocked == 2
+    finally:
+        for _sph, coord, rt in apps:
+            coord.stop()
+            rt.stop()
+        dash.stop()

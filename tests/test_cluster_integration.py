@@ -184,3 +184,42 @@ def test_batch_cluster_block_leaves_no_stat_residue(clk):
     g = entry_totals.get("__entry_node__") or entry_totals.get(
         TOTAL_IN_RESOURCE_NAME)
     assert g["pass"] == 0 and g["threads"] == 0 and g["block"] == 1
+
+
+class FakeParamTokenService(FakeTokenService):
+    def request_param_token(self, flow_id, count, params):
+        self.calls.append(("param", flow_id, count, tuple(params)))
+        return self.script.pop(0) if self.script else _Result(0)
+
+
+def test_cluster_param_rule_delegates(clk):
+    """Cluster-mode hot-param rules call requestParamToken with the arg
+    value; BLOCKED raises ParamFlowException and records the block."""
+    sph = make(clk)
+    svc = FakeParamTokenService()
+    sph.set_token_service(svc)
+    sph.load_param_flow_rules([stpu.ParamFlowRule(
+        resource="psvc", param_idx=0, count=100, cluster_mode=True,
+        cluster_flow_id=77)])
+    with sph.entry("psvc", args=("alice",)):
+        pass
+    assert svc.calls == [("param", 77, 1, ("alice",))]
+
+    svc.script = [_Result(1)]
+    with pytest.raises(stpu.ParamFlowException):
+        sph.entry("psvc", args=("alice",))
+    t = sph.node_totals("psvc")
+    assert t["pass"] == 1 and t["block"] == 1
+
+    # SHOULD_WAIT paces via the clock
+    svc.script = [_Result(2, wait_ms=90)]
+    before = clk.now_ms()
+    with sph.entry("psvc", args=("bob",)):
+        pass
+    assert clk.now_ms() - before == 90
+
+    # no args → rule passes without an RPC (paramIdx resolves to nothing)
+    n = len(svc.calls)
+    with sph.entry("psvc"):
+        pass
+    assert len(svc.calls) == n
