@@ -1,0 +1,142 @@
+"""Counter-state snapshot / warm restart.
+
+The reference deliberately has NO stats checkpointing (windows are seconds
+deep; restart = cold counters — SURVEY §5), and rules persist through
+writable datasources. This module keeps that stance but adds the cheap
+extra the dense design makes possible: the whole counter state is a handful
+of arrays, so a warm restart can resume sliding windows, breaker states,
+pacing clocks, and occupy bookings across a process restart (useful when a
+restart would otherwise let a burst through the cold windows).
+
+Format: one ``.npz`` with the flattened state pytree + a JSON sidecar of
+registry contents (name → row) and the wall-clock epoch, so absolute window
+indices stay meaningful. Restore requires identical engine geometry."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+import jax
+
+
+_META_SUFFIX = ".meta.json"
+
+_FORMAT_VERSION = 1
+
+
+def _rules_digest(sentinel) -> str:
+    """Fingerprint of the loaded rule sets: flow_dyn/breaker state is
+    slot-indexed, so restoring it under a different rule compilation would
+    attach pacing clocks and breaker states to the wrong rules."""
+    from sentinel_tpu.rules import codec
+    parts = [codec.rules_to_json(t, g()) for t, g in (
+        ("flow", sentinel.get_flow_rules),
+        ("degrade", sentinel.get_degrade_rules),
+        ("system", sentinel.get_system_rules),
+        ("authority", sentinel.get_authority_rules),
+        ("paramFlow", sentinel.get_param_flow_rules))]
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()
+
+
+def _geometry(sentinel) -> dict:
+    s = sentinel.spec
+    return {
+        "rows": s.rows, "alt_rows": s.alt_rows,
+        "second": [s.second.buckets, s.second.win_ms],
+        "minute": [s.minute.buckets, s.minute.win_ms] if s.minute else None,
+        "param_keys": s.param_keys,
+        "max_flow_rules": sentinel.cfg.max_flow_rules,
+        "max_degrade_rules": sentinel.cfg.max_degrade_rules,
+    }
+
+
+def save_state(sentinel, path: str) -> None:
+    """Snapshot the device state + registries of a Sentinel instance."""
+    with sentinel._lock:
+        leaves, treedef = jax.tree.flatten(sentinel._state)
+        arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+        meta = {
+            "version": _FORMAT_VERSION,
+            "geometry": _geometry(sentinel),
+            "rules_digest": _rules_digest(sentinel),
+            "epoch_ms": sentinel.epoch_ms,
+            "saved_at_ms": sentinel.clock.now_ms(),
+            "resources": sentinel.resources.items(),
+            "origins": sentinel.origins.items(),
+            "contexts": sentinel.contexts.items(),
+        }
+    # atomic: a crash mid-save must not leave a truncated snapshot that a
+    # later warm restart trips over
+    npz_final = path if str(path).endswith(".npz") else str(path) + ".npz"
+    tmp_npz = f"{npz_final}.{os.getpid()}.tmp"
+    with open(tmp_npz, "wb") as fh:
+        np.savez(fh, **arrays)
+    os.replace(tmp_npz, npz_final)
+    tmp_meta = f"{path}{_META_SUFFIX}.{os.getpid()}.tmp"
+    Path(tmp_meta).write_text(json.dumps(meta))
+    os.replace(tmp_meta, str(path) + _META_SUFFIX)
+
+
+def load_state(sentinel, path: str) -> bool:
+    """Warm-restore a snapshot into a fresh Sentinel with the same geometry.
+
+    Returns False (leaving the instance cold) when the snapshot's geometry
+    doesn't match — a changed config invalidates row meanings, and a cold
+    start is the reference's own behavior anyway. Rules are NOT restored
+    (they live in datasources); load rules first, then restore counters.
+    """
+    meta_path = Path(str(path) + _META_SUFFIX)
+    npz_path = Path(path if str(path).endswith(".npz") else str(path) + ".npz")
+    if not meta_path.exists() or not npz_path.exists():
+        return False
+    try:
+        meta = json.loads(meta_path.read_text())
+        data = np.load(npz_path)
+    except Exception:        # truncated/corrupt snapshot → cold start
+        return False
+    if meta.get("version") != _FORMAT_VERSION:
+        return False
+    if meta.get("geometry") != _geometry(sentinel):
+        return False
+    if meta.get("rules_digest") != _rules_digest(sentinel):
+        return False         # slot-indexed dyn state would misattach
+    with sentinel._lock:
+        leaves, treedef = jax.tree.flatten(sentinel._state)
+        if len(leaves) != len(data.files):
+            return False
+        restored = []
+        for i, cur in enumerate(leaves):
+            arr = data[f"leaf_{i}"]
+            if tuple(arr.shape) != tuple(cur.shape):
+                return False
+            restored.append(arr.astype(cur.dtype))
+        # registries FIRST (before touching device state): re-intern in
+        # row-id order so a fresh registry assigns the same ids (LRU
+        # iteration order ≠ allocation order). Snapshots taken after
+        # evictions have id holes and restore cold — fine, the reference
+        # never restores counters at all. On mismatch the instance stays
+        # cold (some names pre-interned, counters untouched).
+        for reg_name, reg in (("resources", sentinel.resources),
+                              ("origins", sentinel.origins),
+                              ("contexts", sentinel.contexts)):
+            for name, rid in sorted(meta[reg_name], key=lambda p: p[1]):
+                if reg.get_or_create(name) != rid:
+                    return False      # interning drifted: treat as cold
+        new_state = jax.tree.unflatten(treedef, restored)
+        # live-concurrency counters must NOT survive: the snapshot's
+        # in-flight entries never exit in this process, so restored thread
+        # counts would be phantom forever (threads only decrement at exit)
+        new_state = new_state._replace(
+            threads=sentinel._state.threads,
+            alt_threads=sentinel._state.alt_threads)
+        sentinel._state = new_state
+        # window indices are derived from absolute wall time, so they stay
+        # valid across the restart; the relative-ms epoch must carry over
+        # for pacing clocks/warm-up state to stay meaningful
+        sentinel.epoch_ms = meta["epoch_ms"]
+    return True

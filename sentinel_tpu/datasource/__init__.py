@@ -13,3 +13,7 @@ from sentinel_tpu.datasource.converters import rule_converter, rule_encoder  # n
 from sentinel_tpu.datasource.http import (  # noqa: F401
     HttpLongPollDataSource, HttpRefreshableDataSource, InProcessDataSource,
 )
+from sentinel_tpu.datasource.named import (  # noqa: F401
+    ApolloDataSource, ConsulDataSource, EtcdDataSource, EurekaDataSource,
+    NacosDataSource, RedisDataSource, SpringCloudConfigDataSource,
+)

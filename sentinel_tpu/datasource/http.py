@@ -107,8 +107,10 @@ class HttpLongPollDataSource(HttpRefreshableDataSource[T]):
     def read_source(self) -> str:
         with urllib.request.urlopen(self._request(),
                                     timeout=self.timeout_s + 30) as r:
-            self._index = r.headers.get(self.index_header) or self._index
             body = r.read().decode("utf-8")
+            # commit the blocking-query index only after the body arrived —
+            # otherwise a dropped connection skips this change forever
+            self._index = r.headers.get(self.index_header) or self._index
             self._last_body = body
             return body
 

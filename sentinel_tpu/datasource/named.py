@@ -1,0 +1,177 @@
+"""Named datasource drivers (reference ``sentinel-datasource-*`` modules).
+
+Thin, conventions-encoded wrappers over the generic HTTP sources — each
+reference driver reduces to "fetch this URL shape, watch it this way":
+
+- :class:`ConsulDataSource` — KV blocking queries (``X-Consul-Index``),
+  like ``sentinel-datasource-consul``'s long-poll watch.
+- :class:`NacosDataSource` — open-API config poll
+  (``/nacos/v1/cs/configs``), like ``sentinel-datasource-nacos``'s
+  listener (poll interval stands in for the push channel).
+- :class:`EtcdDataSource` — v3 gRPC-gateway ``/v3/kv/range`` POST poll,
+  like ``sentinel-datasource-etcd``.
+- :class:`EurekaDataSource` / :class:`SpringCloudConfigDataSource` /
+  :class:`ApolloDataSource` — plain conditional-GET polls over each
+  system's config URL shape.
+- :class:`RedisDataSource` — initial GET + pub/sub channel updates,
+  like ``sentinel-datasource-redis``; requires the ``redis`` package
+  (gated import — this build image doesn't ship it).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import urllib.parse
+import urllib.request
+from typing import Optional
+
+from sentinel_tpu.datasource.base import Converter, T
+from sentinel_tpu.datasource.http import (
+    HttpLongPollDataSource, HttpRefreshableDataSource,
+)
+
+
+class ConsulDataSource(HttpLongPollDataSource[T]):
+    def __init__(self, host: str, port: int, rule_key: str,
+                 converter: Converter, *, token: Optional[str] = None,
+                 wait: str = "25s", **kw):
+        headers = dict(kw.pop("headers", {}) or {})
+        if token:
+            headers["X-Consul-Token"] = token
+        super().__init__(
+            f"http://{host}:{port}/v1/kv/{rule_key}?raw",
+            converter, index_header="X-Consul-Index", wait=wait,
+            headers=headers, **kw)
+
+
+class NacosDataSource(HttpRefreshableDataSource[T]):
+    def __init__(self, server_addr: str, data_id: str, group: str,
+                 converter: Converter, *, namespace: str = "",
+                 refresh_ms: int = 3000, **kw):
+        qs = f"dataId={urllib.parse.quote(data_id)}" \
+             f"&group={urllib.parse.quote(group)}"
+        if namespace:
+            qs += f"&tenant={urllib.parse.quote(namespace)}"
+        super().__init__(f"http://{server_addr}/nacos/v1/cs/configs?{qs}",
+                         converter, refresh_ms, **kw)
+
+
+class EtcdDataSource(HttpRefreshableDataSource[T]):
+    """etcd v3 over the gRPC-gateway: POST ``/v3/kv/range`` with the
+    base64-encoded key; the value is base64-decoded before conversion."""
+
+    def __init__(self, host: str, port: int, key: str,
+                 converter: Converter, *, refresh_ms: int = 3000, **kw):
+        self._range_key = base64.b64encode(key.encode()).decode()
+        super().__init__(f"http://{host}:{port}/v3/kv/range",
+                         converter, refresh_ms, **kw)
+
+    def _request(self) -> urllib.request.Request:
+        body = json.dumps({"key": self._range_key}).encode()
+        return urllib.request.Request(
+            self.url, data=body,
+            headers={**self.headers, "Content-Type": "application/json"})
+
+    def read_source(self) -> str:
+        with urllib.request.urlopen(self._request(),
+                                    timeout=self.timeout_s) as r:
+            payload = json.loads(r.read().decode("utf-8"))
+        kvs = payload.get("kvs") or []
+        body = (base64.b64decode(kvs[0]["value"]).decode("utf-8")
+                if kvs else "")
+        self._last_body = body
+        return body
+
+
+class EurekaDataSource(HttpRefreshableDataSource[T]):
+    """Config served by an app registered in Eureka — the reference driver
+    resolves an instance and GETs its rule endpoint; here the resolved URL
+    is given directly (service discovery stays the caller's concern)."""
+
+    def __init__(self, rule_url: str, converter: Converter, **kw):
+        super().__init__(rule_url, converter, **kw)
+
+
+class SpringCloudConfigDataSource(HttpRefreshableDataSource[T]):
+    def __init__(self, server_addr: str, application: str, profile: str,
+                 label: str, key: str, converter: Converter, **kw):
+        self._key = key
+        super().__init__(
+            f"http://{server_addr}/{application}/{profile}/{label}",
+            converter, **kw)
+
+    def read_source(self) -> str:
+        # _last_body stays the RAW envelope (the base class's 304 path
+        # replays it through this extraction again)
+        raw = super().read_source()
+        try:
+            doc = json.loads(raw)
+            for ps in doc.get("propertySources", []):
+                src = ps.get("source", {})
+                if self._key in src:
+                    return str(src[self._key])
+        except (ValueError, AttributeError):
+            pass
+        return ""
+
+
+class ApolloDataSource(HttpRefreshableDataSource[T]):
+    def __init__(self, server_addr: str, app_id: str, cluster: str,
+                 namespace: str, key: str, converter: Converter, **kw):
+        self._key = key
+        super().__init__(
+            f"http://{server_addr}/configs/{app_id}/{cluster}/{namespace}",
+            converter, **kw)
+
+    def read_source(self) -> str:
+        raw = super().read_source()    # _last_body stays the raw envelope
+        try:
+            return str(json.loads(raw).get("configurations", {})
+                       .get(self._key, ""))
+        except (ValueError, AttributeError):
+            return ""
+
+
+class RedisDataSource:
+    """Initial GET + pub/sub update channel (``sentinel-datasource-redis``).
+    Requires the ``redis`` package; constructing without it raises with a
+    clear message (the build image doesn't bundle redis)."""
+
+    def __init__(self, host: str, port: int, rule_key: str, channel: str,
+                 converter: Converter, *, db: int = 0,
+                 password: Optional[str] = None):
+        try:
+            import redis
+        except ImportError as exc:
+            raise ImportError(
+                "RedisDataSource requires the 'redis' package; install it "
+                "or use a file/HTTP datasource") from exc
+        from sentinel_tpu.core.property import SentinelProperty
+
+        self.converter = converter
+        self.property = SentinelProperty()
+        self._client = redis.Redis(host=host, port=port, db=db,
+                                   password=password)
+        initial = self._client.get(rule_key)
+        if initial is not None:
+            self.property.update_value(converter(initial.decode("utf-8")))
+        self._pubsub = self._client.pubsub()
+        self._pubsub.subscribe(**{channel: self._on_message})
+        self._thread = self._pubsub.run_in_thread(sleep_time=0.1,
+                                                  daemon=True)
+
+    def _on_message(self, message) -> None:
+        if message.get("type") == "message":
+            data = message["data"]
+            if isinstance(data, bytes):
+                data = data.decode("utf-8")
+            self.property.update_value(self.converter(data))
+
+    def get_property(self):
+        return self.property
+
+    def close(self) -> None:
+        self._thread.stop()
+        self._pubsub.close()
+        self._client.close()

@@ -122,3 +122,103 @@ def test_in_process_push():
     n = len(seen)
     ds.push(_flow_json(7))
     assert len(seen) == n
+
+
+class _NamedHandler(BaseHTTPRequestHandler):
+    state = {}
+
+    def _reply(self, body: bytes, headers=()):
+        self.send_response(200)
+        for k, v in headers:
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        if self.path.startswith("/v1/kv/"):
+            self._reply(self.state["consul"].encode(),
+                        [("X-Consul-Index", "7")])
+        elif self.path.startswith("/nacos/v1/cs/configs"):
+            self.state["nacos_paths"].append(self.path)
+            self._reply(self.state["nacos"].encode())
+        elif self.path.startswith("/configs/"):
+            self._reply(json.dumps(
+                {"configurations": {"rules": self.state["apollo"]}}).encode())
+        else:
+            self._reply(json.dumps({"propertySources": [
+                {"source": {"sentinel.rules": self.state["spring"]}}]}).encode())
+
+    def do_POST(self):  # noqa: N802
+        import base64
+        n = int(self.headers.get("Content-Length", 0))
+        req = json.loads(self.rfile.read(n).decode())
+        self.state["etcd_keys"].append(
+            base64.b64decode(req["key"]).decode())
+        self._reply(json.dumps({"kvs": [{
+            "value": base64.b64encode(
+                self.state["etcd"].encode()).decode()}]}).encode())
+
+    def log_message(self, fmt, *args):
+        pass
+
+
+@pytest.fixture
+def named_server():
+    flow = json.dumps([{"resource": "r", "count": 4}])
+    _NamedHandler.state = {"consul": flow, "nacos": flow, "etcd": flow,
+                           "apollo": flow, "spring": flow,
+                           "nacos_paths": [], "etcd_keys": []}
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _NamedHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv, _NamedHandler.state
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_named_datasources_fetch_rules(named_server):
+    from sentinel_tpu.datasource import (
+        ApolloDataSource, ConsulDataSource, EtcdDataSource,
+        NacosDataSource, SpringCloudConfigDataSource,
+    )
+
+    srv, state = named_server
+    host, port = "127.0.0.1", srv.server_address[1]
+
+    ds = ConsulDataSource(host, port, "sentinel/flow",
+                          rule_converter("flow"), start_thread=False)
+    assert ds.get_property().get()[0].count == 4
+    assert ds._index == "7"            # blocking-query index captured
+    ds.close()
+
+    ds = NacosDataSource(f"{host}:{port}", "flow-rules", "DEFAULT_GROUP",
+                         rule_converter("flow"), start_thread=False)
+    assert ds.get_property().get()[0].count == 4
+    assert "dataId=flow-rules" in state["nacos_paths"][0]
+    ds.close()
+
+    ds = EtcdDataSource(host, port, "sentinel/rules",
+                        rule_converter("flow"), start_thread=False)
+    assert ds.get_property().get()[0].count == 4
+    assert state["etcd_keys"] == ["sentinel/rules"]
+    ds.close()
+
+    ds = ApolloDataSource(f"{host}:{port}", "app", "default", "ns",
+                          "rules", rule_converter("flow"),
+                          start_thread=False)
+    assert ds.get_property().get()[0].count == 4
+    ds.close()
+
+    ds = SpringCloudConfigDataSource(f"{host}:{port}", "app", "prod",
+                                     "main", "sentinel.rules",
+                                     rule_converter("flow"),
+                                     start_thread=False)
+    assert ds.get_property().get()[0].count == 4
+    ds.close()
+
+
+def test_redis_datasource_gated():
+    from sentinel_tpu.datasource import RedisDataSource
+    with pytest.raises(ImportError, match="redis"):
+        RedisDataSource("localhost", 6379, "k", "ch",
+                        rule_converter("flow"))

@@ -1,0 +1,82 @@
+"""Warm-restart snapshots: counters, breaker states, and pacing survive a
+process restart; geometry or interning drift restores cold (SURVEY §5
+checkpoint stance + the cheap dense-tensor extra)."""
+
+import pytest
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.core.snapshot import load_state, save_state
+
+T0 = 1_785_000_000_000
+
+
+def make(clk, **over):
+    kw = dict(max_resources=64, max_flow_rules=16,
+              max_degrade_rules=16, max_authority_rules=16)
+    kw.update(over)
+    return stpu.Sentinel(config=stpu.load_config(**kw), clock=clk)
+
+
+def test_counters_survive_restart(tmp_path):
+    clk = ManualClock(start_ms=T0)
+    a = make(clk)
+    a.load_flow_rules([stpu.FlowRule(resource="svc", count=3)])
+    for _ in range(3):
+        with a.entry("svc"):
+            pass
+    save_state(a, str(tmp_path / "snap"))
+
+    # "restarted" process: same geometry, same wall clock
+    b = make(ManualClock(start_ms=T0 + 50))
+    b.load_flow_rules([stpu.FlowRule(resource="svc", count=3)])
+    assert load_state(b, str(tmp_path / "snap"))
+    t = b.node_totals("svc")
+    assert t["pass"] == 3
+    # the rolling window carried over: the budget is already spent
+    with pytest.raises(stpu.BlockException):
+        b.entry("svc")
+    # ...and replenishes when the window slides, as if never restarted
+    b.clock.advance_ms(1100)
+    with b.entry("svc"):
+        pass
+
+
+def test_breaker_state_survives_restart(tmp_path):
+    clk = ManualClock(start_ms=T0)
+    a = make(clk)
+    a.load_degrade_rules([stpu.DegradeRule(
+        resource="svc", grade=stpu.GRADE_EXCEPTION_COUNT, count=1,
+        time_window=30, min_request_amount=1)])
+    for _ in range(2):
+        try:
+            with a.entry("svc") as e:
+                e.trace(RuntimeError("x"))
+        except stpu.BlockException:
+            pass
+    with pytest.raises(stpu.BlockException):
+        a.entry("svc")                      # breaker OPEN
+    save_state(a, str(tmp_path / "snap"))
+
+    b = make(ManualClock(start_ms=T0 + 100))
+    b.load_degrade_rules([stpu.DegradeRule(
+        resource="svc", grade=stpu.GRADE_EXCEPTION_COUNT, count=1,
+        time_window=30, min_request_amount=1)])
+    assert load_state(b, str(tmp_path / "snap"))
+    with pytest.raises(stpu.BlockException):
+        b.entry("svc")                      # still OPEN after restart
+
+
+def test_geometry_mismatch_restores_cold(tmp_path):
+    a = make(ManualClock(start_ms=T0))
+    with a.entry("svc"):
+        pass
+    save_state(a, str(tmp_path / "snap"))
+    b = make(ManualClock(start_ms=T0), max_resources=128)   # different rows
+    assert load_state(b, str(tmp_path / "snap")) is False
+    assert b.node_totals("svc").get("pass", 0) == 0
+
+
+def test_missing_snapshot_is_cold(tmp_path):
+    b = make(ManualClock(start_ms=T0))
+    assert load_state(b, str(tmp_path / "nope")) is False
