@@ -1,0 +1,301 @@
+"""The five BASELINE.json benchmark configs (SURVEY §6 — establish, don't
+reproduce: the reference publishes no numbers).
+
+Run: ``python benchmarks/run_all.py`` → one JSON line per config.
+Sizes shrink via ``BENCH_SMALL=1`` for smoke runs. ``bench.py`` at the repo
+root stays the driver's single headline metric; this harness is the wider
+JMH-equivalent matrix.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+# runnable from any cwd: the repo root is this file's parent's parent
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _env(name, default):
+    return int(os.environ.get(name, str(default)))
+
+
+SMALL = os.environ.get("BENCH_SMALL") == "1"
+
+
+def bench_entry_latency():
+    """Config 1 — FlowQpsDemo semantics on the single-entry tier: the
+    per-call decide round-trip (the p99 grant-latency budget)."""
+    import sentinel_tpu as stpu
+
+    sph = stpu.Sentinel(stpu.load_config(
+        max_resources=1024, max_flow_rules=64, max_degrade_rules=64,
+        max_authority_rules=16))
+    sph.load_flow_rules([stpu.FlowRule(resource="HelloWorld", count=1e9)])
+    n = 50 if SMALL else 500
+    for _ in range(20):                     # warm the trace + caches
+        with sph.entry("HelloWorld"):
+            pass
+    lat = np.empty(n)
+    for i in range(n):
+        t0 = time.perf_counter()
+        with sph.entry("HelloWorld"):
+            pass
+        lat[i] = time.perf_counter() - t0
+    return {
+        "config": "1-entry-latency",
+        "p50_ms": round(float(np.percentile(lat, 50)) * 1000, 3),
+        "p99_ms": round(float(np.percentile(lat, 99)) * 1000, 3),
+        "target_p99_ms": 2.0,
+    }
+
+
+def _mixed_engine(R, NRULES):
+    import jax
+    import jax.numpy as jnp
+    from sentinel_tpu.core.registry import (
+        OriginRegistry, Registry, ResourceRegistry,
+    )
+    from sentinel_tpu.engine.pipeline import (
+        EngineSpec, EntryBatch, RuleSet, decide_entries, init_state,
+    )
+    from sentinel_tpu.rules import authority as auth_mod
+    from sentinel_tpu.rules import degrade as deg_mod
+    from sentinel_tpu.rules import flow as flow_mod
+    from sentinel_tpu.rules import param_flow as pf_mod
+    from sentinel_tpu.rules import system as sys_mod
+    from sentinel_tpu.stats.window import WindowSpec
+
+    spec = EngineSpec(rows=R, alt_rows=1024,
+                      second=WindowSpec(buckets=2, win_ms=500),
+                      minute=None, statistic_max_rt=5000)
+    res = ResourceRegistry(R)
+    org = OriginRegistry(64)
+    ctxr = Registry(64, reserved=("c",))
+    return spec, res, org, ctxr, flow_mod, deg_mod, auth_mod, sys_mod, pf_mod
+
+
+def bench_all_controllers():
+    """Config 2 — Default/WarmUp/RateLimiter mix over 10k resources."""
+    import jax
+    import jax.numpy as jnp
+    from sentinel_tpu.engine.pipeline import (
+        EntryBatch, RuleSet, decide_entries, init_state,
+    )
+
+    R = 1 << 11 if SMALL else 1 << 14
+    NR = 256 if SMALL else 8192
+    B = 1 << 10 if SMALL else 1 << 15
+    STEPS = 10 if SMALL else 200
+    (spec, res, org, ctxr, flow_mod, deg_mod, auth_mod, sys_mod,
+     pf_mod) = _mixed_engine(R, NR)
+    behaviors = [flow_mod.BEHAVIOR_DEFAULT, flow_mod.BEHAVIOR_WARM_UP,
+                 flow_mod.BEHAVIOR_RATE_LIMITER]
+    rules = [flow_mod.FlowRule(resource=f"r{i}", count=50.0,
+                               control_behavior=behaviors[i % 3])
+             for i in range(NR)]
+    flow = flow_mod.compile_flow_rules(
+        rules, resource_registry=res, context_registry=ctxr, capacity=NR,
+        k_per_resource=4, num_rows=R, origin_registry=org)
+    deg = deg_mod.compile_degrade_rules([], resource_registry=res,
+                                        capacity=16, k_per_resource=4,
+                                        num_rows=R)
+    auth = auth_mod.compile_authority_rules(
+        [], resource_registry=res, origin_registry=org, capacity=16,
+        k_per_resource=4, num_rows=R)
+    param = pf_mod.compile_param_rules([], resource_registry=res,
+                                       capacity=16, k_per_resource=4)
+    ruleset = RuleSet(flow_table=flow.table, flow_idx=flow.rule_idx,
+                      deg_table=deg.table, deg_idx=deg.rule_idx,
+                      auth_table=auth.table, auth_idx=auth.rule_idx,
+                      sys_thresholds=sys_mod.compile_system_rules([]),
+                      param_table=param.table)
+    state = init_state(spec, NR, 16)
+    rng = np.random.default_rng(0)
+    batch = EntryBatch(
+        rows=jnp.asarray(rng.integers(1, NR, B).astype(np.int32)),
+        origin_ids=jnp.zeros(B, jnp.int32),
+        origin_rows=jnp.full(B, spec.alt_rows, jnp.int32),
+        context_ids=jnp.zeros(B, jnp.int32),
+        chain_rows=jnp.full(B, spec.alt_rows, jnp.int32),
+        acquire=jnp.ones(B, jnp.int32), is_in=jnp.ones(B, jnp.bool_),
+        prioritized=jnp.zeros(B, jnp.bool_), valid=jnp.ones(B, jnp.bool_))
+    step = jax.jit(functools.partial(decide_entries, spec,
+                                     enable_occupy=False),
+                   donate_argnums=(1,))
+    sysv = jnp.asarray(np.array([0.5, 0.1], np.float32))
+
+    def times(i):
+        now = 10_000_000 + i * 2
+        return jnp.asarray(np.array(
+            [spec.second.index_of(now), 0, now, now % 500], np.int32))
+
+    for i in range(3):
+        state, v = step(ruleset, state, batch, times(i), sysv)
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        state, v = step(ruleset, state, batch, times(3 + i), sysv)
+    jax.block_until_ready((state, v))
+    dt = time.perf_counter() - t0
+    return {"config": "2-all-controllers-10k-resources",
+            "decisions_per_sec": round(B * STEPS / dt, 0)}
+
+
+def bench_breakers():
+    """Config 3 — circuit breaking (slow-ratio + error-ratio) with exits."""
+    import jax
+    import jax.numpy as jnp
+    from sentinel_tpu.engine.pipeline import (
+        EntryBatch, ExitBatch, RuleSet, decide_entries, init_state,
+        record_exits,
+    )
+    from sentinel_tpu.rules import degrade as deg_mod
+
+    R = 1 << 11 if SMALL else 1 << 17
+    ND = 256 if SMALL else 4096
+    B = 1 << 10 if SMALL else 1 << 14
+    STEPS = 10 if SMALL else 100
+    (spec, res, org, ctxr, flow_mod, deg_mod, auth_mod, sys_mod,
+     pf_mod) = _mixed_engine(R, ND)
+    dr = []
+    for i in range(ND):
+        if i % 2:
+            dr.append(deg_mod.DegradeRule(
+                resource=f"r{i}", grade=deg_mod.GRADE_RT, count=50,
+                time_window=10))
+        else:
+            dr.append(deg_mod.DegradeRule(
+                resource=f"r{i}", grade=deg_mod.GRADE_EXCEPTION_RATIO,
+                count=0.5, time_window=10))
+    flow = flow_mod.compile_flow_rules(
+        [], resource_registry=res, context_registry=ctxr, capacity=16,
+        k_per_resource=4, num_rows=R, origin_registry=org)
+    deg = deg_mod.compile_degrade_rules(dr, resource_registry=res,
+                                        capacity=ND, k_per_resource=4,
+                                        num_rows=R)
+    auth = auth_mod.compile_authority_rules(
+        [], resource_registry=res, origin_registry=org, capacity=16,
+        k_per_resource=4, num_rows=R)
+    param = pf_mod.compile_param_rules([], resource_registry=res,
+                                       capacity=16, k_per_resource=4)
+    ruleset = RuleSet(flow_table=flow.table, flow_idx=flow.rule_idx,
+                      deg_table=deg.table, deg_idx=deg.rule_idx,
+                      auth_table=auth.table, auth_idx=auth.rule_idx,
+                      sys_thresholds=sys_mod.compile_system_rules([]),
+                      param_table=param.table)
+    state = init_state(spec, 16, ND)
+    rng = np.random.default_rng(0)
+    rows = jnp.asarray(rng.integers(1, ND, B).astype(np.int32))
+    ebatch = EntryBatch(
+        rows=rows, origin_ids=jnp.zeros(B, jnp.int32),
+        origin_rows=jnp.full(B, spec.alt_rows, jnp.int32),
+        context_ids=jnp.zeros(B, jnp.int32),
+        chain_rows=jnp.full(B, spec.alt_rows, jnp.int32),
+        acquire=jnp.ones(B, jnp.int32), is_in=jnp.ones(B, jnp.bool_),
+        prioritized=jnp.zeros(B, jnp.bool_), valid=jnp.ones(B, jnp.bool_))
+    xbatch = ExitBatch(
+        rows=rows, origin_rows=jnp.full(B, spec.alt_rows, jnp.int32),
+        chain_rows=jnp.full(B, spec.alt_rows, jnp.int32),
+        acquire=jnp.ones(B, jnp.int32),
+        rt_ms=jnp.asarray(rng.integers(1, 200, B).astype(np.int32)),
+        error=jnp.asarray(rng.random(B) < 0.3),
+        is_in=jnp.ones(B, jnp.bool_), valid=jnp.ones(B, jnp.bool_))
+    step = jax.jit(functools.partial(decide_entries, spec,
+                                     enable_occupy=False))
+    exit_step = jax.jit(functools.partial(record_exits, spec))
+    sysv = jnp.asarray(np.array([0.5, 0.1], np.float32))
+
+    def times(i):
+        now = 10_000_000 + i * 2
+        return jnp.asarray(np.array(
+            [spec.second.index_of(now), 0, now, now % 500], np.int32))
+
+    state, _ = step(ruleset, state, ebatch, times(0), sysv)
+    state = exit_step(ruleset, state, xbatch, times(0))
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        state, v = step(ruleset, state, ebatch, times(i), sysv)
+        state = exit_step(ruleset, state, xbatch, times(i))
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    return {"config": "3-circuit-breakers-entry+exit",
+            "entry_exit_pairs_per_sec": round(B * STEPS / dt, 0)}
+
+
+def bench_hot_param_zipf():
+    """Config 4 — hot-param throttling over Zipf-skewed keys."""
+    import sentinel_tpu as stpu
+
+    K = 1 << 12 if SMALL else 1 << 16
+    B = 512 if SMALL else 4096
+    STEPS = 5 if SMALL else 50
+    sph = stpu.Sentinel(stpu.load_config(
+        max_resources=256, max_flow_rules=16, max_degrade_rules=16,
+        max_authority_rules=16, max_param_rules=16,
+        param_table_slots=K))
+    sph.load_param_flow_rules([stpu.ParamFlowRule(
+        resource="hot", param_idx=0, count=1000)])
+    rng = np.random.default_rng(0)
+    keys = rng.zipf(1.2, size=B * STEPS) % (K // 2)
+    resources = ["hot"] * B
+    for s in range(2):
+        sph.entry_batch(resources,
+                        args_list=[(int(k),) for k in keys[:B]])
+    t0 = time.perf_counter()
+    for s in range(STEPS):
+        args = [(int(k),) for k in keys[s * B:(s + 1) * B]]
+        sph.entry_batch(resources, args_list=args)
+    dt = time.perf_counter() - t0
+    return {"config": "4-hot-param-zipf",
+            "param_checks_per_sec": round(B * STEPS / dt, 0)}
+
+
+def bench_cluster_tokens():
+    """Config 5 — cluster token grants on the sharded engine."""
+    from sentinel_tpu.parallel.cluster import (
+        THRESHOLD_GLOBAL, ClusterEngine, ClusterFlowRule, ClusterSpec,
+    )
+    import jax
+
+    n_shards = min(8, len(jax.devices()))
+    FL = 64 if SMALL else 512
+    B = 256 if SMALL else 4096
+    STEPS = 5 if SMALL else 50
+    eng = ClusterEngine(ClusterSpec(n_shards=n_shards,
+                                    flows_per_shard=max(FL // n_shards, 16),
+                                    namespaces=4))
+    eng.load_rules("ns", [ClusterFlowRule(flow_id=i, count=1e9,
+                                          threshold_type=THRESHOLD_GLOBAL)
+                          for i in range(FL)])
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, FL, B).tolist()
+    now = 10_000_000
+    eng.request_tokens(ids, [1] * B, now_ms=now)
+    t0 = time.perf_counter()
+    for s in range(STEPS):
+        eng.request_tokens(ids, [1] * B, now_ms=now + s)
+    dt = time.perf_counter() - t0
+    return {"config": "5-cluster-token-grants",
+            "shards": n_shards,
+            "grants_per_sec": round(B * STEPS / dt, 0)}
+
+
+def main() -> None:
+    for fn in (bench_entry_latency, bench_all_controllers, bench_breakers,
+               bench_hot_param_zipf, bench_cluster_tokens):
+        try:
+            print(json.dumps(fn()))
+        except Exception as exc:            # keep the matrix running
+            print(json.dumps({"config": fn.__name__, "error": repr(exc)}))
+
+
+if __name__ == "__main__":
+    main()
