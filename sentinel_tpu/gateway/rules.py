@@ -132,7 +132,9 @@ class GatewayRuleManager:
     param-rule set installed (``GatewayRuleManager`` + ``GatewayFlowSlot``)."""
 
     def __init__(self, sentinel):
+        import threading
         self._sentinel = sentinel
+        self._load_lock = threading.Lock()   # command threads race reloads
         self._rules: Dict[str, List[GatewayFlowRule]] = {}
         # resource → number of param-item indices (the args-array length is
         # this plus one shared slot for non-param rules, filled with $D)
@@ -163,10 +165,14 @@ class GatewayRuleManager:
         for rule in non_param:
             converted.append(_to_param_rule(rule, idx_map.get(rule.resource, 0)))
 
-        self._rules = rule_map
-        self._param_idx_count = idx_map
-        self._has_non_param = has_non_param
-        self._sentinel.set_gateway_param_rules(converted)
+        # one lock around the multi-map swap + param-rule install: two
+        # concurrent command-plane reloads must not interleave (the parser's
+        # args_length would disagree with the installed rules)
+        with self._load_lock:
+            self._rules = rule_map
+            self._param_idx_count = idx_map
+            self._has_non_param = has_non_param
+            self._sentinel.set_gateway_param_rules(converted)
 
     def rules_for_resource(self, resource: str) -> List[GatewayFlowRule]:
         return list(self._rules.get(resource, ()))

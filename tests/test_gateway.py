@@ -213,3 +213,55 @@ def test_user_and_gateway_param_rules_coexist(clk):
     sph.load_param_flow_rules([stpu.ParamFlowRule(resource="svc", param_idx=0,
                                                   count=5)])
     assert gw_burst(sph, "route-a", 3, args) == (2, 1)
+
+
+def test_gateway_command_surface():
+    """Agent gateway commands (adapter-common command handlers): rule and
+    api-definition round-trips over the command center."""
+    import json as _json
+
+    import sentinel_tpu as stpu
+    from sentinel_tpu.core.clock import ManualClock
+    from sentinel_tpu.gateway import (
+        ApiDefinition, ApiPathPredicateItem, GatewayApiDefinitionManager,
+        GatewayFlowRule, GatewayRuleManager,
+    )
+    from sentinel_tpu.transport import CommandCenter, CommandRequest, \
+        register_default_handlers
+
+    sph = stpu.Sentinel(stpu.load_config(
+        max_resources=64, max_flow_rules=16, max_degrade_rules=16,
+        max_authority_rules=16), clock=ManualClock(start_ms=1_785_000_000_000))
+    gw = GatewayRuleManager(sph)
+    apis = GatewayApiDefinitionManager()
+    center = CommandCenter()
+    register_default_handlers(center, sph, gateway_manager=gw,
+                              api_definition_manager=apis)
+
+    rules_json = _json.dumps([{
+        "resource": "route-a", "resourceMode": 0, "count": 7.0,
+        "intervalSec": 1,
+        "paramItem": {"parseStrategy": 0}}])
+    resp = center.handle("gateway/updateRules", CommandRequest(
+        parameters={"data": rules_json}))
+    assert resp.success, resp.result
+    got = _json.loads(center.handle("gateway/getRules",
+                                    CommandRequest(parameters={})).result)
+    assert got[0]["resource"] == "route-a" and got[0]["count"] == 7.0
+    assert got[0]["paramItem"]["parseStrategy"] == 0
+
+    defs_json = _json.dumps([{
+        "apiName": "my-api",
+        "predicateItems": [{"pattern": "/foo/**", "matchStrategy": 1}]}])
+    resp = center.handle("gateway/updateApiDefinitions", CommandRequest(
+        parameters={"data": defs_json}))
+    assert resp.success, resp.result
+    got = _json.loads(center.handle("gateway/getApiDefinitions",
+                                    CommandRequest(parameters={})).result)
+    assert got[0]["apiName"] == "my-api"
+    assert got[0]["predicateItems"][0]["pattern"] == "/foo/**"
+
+    # bad payload → 400, not 500
+    resp = center.handle("gateway/updateRules", CommandRequest(
+        parameters={"data": "not json"}))
+    assert not resp.success and resp.code == 400
