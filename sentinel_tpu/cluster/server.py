@@ -73,6 +73,11 @@ class ClusterTokenServer:
         self.port = port
         self.idle_seconds = idle_seconds
         self.batch_window_ms = batch_window_ms
+        # ClusterServerStatLogUtil → cluster-server.log: per-second rollup
+        # of grant/deny counts per flow id (EagleEye StatLogger analog)
+        from sentinel_tpu.core.logs import BlockStatLogger
+        self.stat_log = BlockStatLogger(
+            self.clock, file_name="sentinel-cluster-server.log")
 
         self._conns: Set[_Conn] = set()
         self._ns_conns: Dict[str, Set[str]] = {}
@@ -278,6 +283,9 @@ class ClusterTokenServer:
                     [r.data[0] for r in reqs], [r.data[1] for r in reqs],
                     [r.data[2] for r in reqs], now_ms=now_ms)
                 for (req, conn), (status, wait_ms, remaining) in zip(flow_q, res):
+                    self.stat_log.log(f"flow-{req.data[0]}",
+                                      "pass" if status in (0, 2) else "block",
+                                      origin=conn.namespace or "")
                     await self._send(conn, codec.Response(
                         req.xid, req.type, status, (remaining, wait_ms)))
             if param_q:
@@ -287,6 +295,9 @@ class ClusterTokenServer:
                     [r.data[0] for r in reqs], [r.data[1] for r in reqs],
                     [r.data[2] for r in reqs], now_ms=now_ms)
                 for (req, conn), (status, wait_ms, remaining) in zip(param_q, res):
+                    self.stat_log.log(f"param-{req.data[0]}",
+                                      "pass" if status in (0, 2) else "block",
+                                      origin=conn.namespace or "")
                     await self._send(conn, codec.Response(
                         req.xid, req.type, status, (remaining, wait_ms)))
 

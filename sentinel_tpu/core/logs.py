@@ -66,9 +66,10 @@ class BlockStatLogger:
 
     def __init__(self, clock, base_dir: Optional[str] = None,
                  max_entries: int = 6000, max_bytes: int = 300 * 1024 * 1024,
-                 backups: int = 3):
+                 backups: int = 3, file_name: Optional[str] = None):
         self._clock = clock
         self._dir = base_dir or log_base_dir()
+        self.file_name = file_name or self.FILE_NAME
         self._max_entries = max_entries
         self._max_bytes = max_bytes
         self._backups = backups
@@ -99,7 +100,7 @@ class BlockStatLogger:
             self._write(*pending)
 
     def _write(self, sec: int, counts: Dict) -> None:
-        path = os.path.join(self._dir, self.FILE_NAME)
+        path = os.path.join(self._dir, self.file_name)
         try:
             os.makedirs(self._dir, exist_ok=True)
             if os.path.exists(path) and os.path.getsize(path) > self._max_bytes:

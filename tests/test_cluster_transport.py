@@ -274,3 +274,36 @@ def test_socket_concurrent_tokens(served):
 def test_socket_unknown_flow(served):
     _, client, _ = served
     assert client.request_token(40999, 1).status == STATUS_NO_RULE_EXISTS
+
+
+def test_cluster_server_stat_log(tmp_path, monkeypatch):
+    """ClusterServerStatLogUtil analog: the token server rolls per-second
+    grant/deny counts per flow into sentinel-cluster-server.log."""
+    import os
+    from sentinel_tpu.cluster.client import ClusterTokenClient
+    from sentinel_tpu.cluster.server import ClusterTokenServer
+    from sentinel_tpu.parallel.cluster import (
+        THRESHOLD_GLOBAL, ClusterEngine, ClusterFlowRule, ClusterSpec,
+    )
+    from sentinel_tpu.core.clock import ManualClock
+
+    engine = ClusterEngine(ClusterSpec(n_shards=8, flows_per_shard=16,
+                                       namespaces=4))
+    server = ClusterTokenServer(engine, host="127.0.0.1", port=0,
+                                clock=ManualClock(start_ms=10_000_000))
+    server.stat_log._dir = str(tmp_path)
+    server.load_flow_rules("ns", [ClusterFlowRule(
+        flow_id=9, count=1, threshold_type=THRESHOLD_GLOBAL)])
+    server.start()
+    client = ClusterTokenClient(host="127.0.0.1", port=server.port,
+                                namespace="ns", request_timeout_ms=60_000)
+    client.start()
+    try:
+        for _ in range(3):
+            client.request_token(9, 1)
+    finally:
+        client.stop()
+        server.stop()
+    server.stat_log.flush()
+    text = (tmp_path / "sentinel-cluster-server.log").read_text()
+    assert "flow-9,pass" in text and "flow-9,block" in text
