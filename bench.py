@@ -39,8 +39,8 @@ def main() -> None:
     from sentinel_tpu.stats.window import WindowSpec
 
     R = int(os.environ.get("BENCH_RESOURCES", str(1 << 20)))        # 1M rows
-    B = int(os.environ.get("BENCH_BATCH", str(1 << 15)))            # 32k events
-    STEPS = int(os.environ.get("BENCH_STEPS", "500"))
+    B = int(os.environ.get("BENCH_BATCH", str(1 << 19)))            # 512k events
+    STEPS = int(os.environ.get("BENCH_STEPS", "60"))
     NRULES = int(os.environ.get("BENCH_RULES", "4096"))
     WARMUP = 3
 
