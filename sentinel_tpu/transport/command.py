@@ -61,7 +61,16 @@ class CommandCenter:
     def __init__(self) -> None:
         self._handlers: Dict[str, Handler] = {}
         self._descs: Dict[str, str] = {}
+        self._interceptors: list = []     # CommandHandlerInterceptor SPI
         self._lock = threading.Lock()
+
+    def add_interceptor(self, fn) -> None:
+        """``CommandHandlerInterceptor`` analog: ``fn(name, request) →
+        Optional[CommandResponse]`` runs before the handler; a non-None
+        return short-circuits it (auth gates, audit logs, rate limits on
+        the command plane itself)."""
+        with self._lock:
+            self._interceptors = self._interceptors + [fn]
 
     def register(self, fn: Handler, name: Optional[str] = None,
                  desc: Optional[str] = None) -> None:
@@ -85,6 +94,10 @@ class CommandCenter:
         if fn is None:
             return CommandResponse.of_failure(f"Unknown command `{name}`", 404)
         try:
+            for interceptor in self._interceptors:   # copy-on-write list
+                short = interceptor(name, request)
+                if short is not None:
+                    return short
             return fn(request)
         except Exception as exc:  # handler bug must not kill the server
             return CommandResponse.of_failure(f"internal error: {exc!r}", 500)

@@ -27,7 +27,7 @@ from sentinel_tpu.rules.param_flow import ParamFlowItem, ParamFlowRule
 from sentinel_tpu.rules.system import SystemRule
 from sentinel_tpu.rules.authority import AuthorityRule
 from sentinel_tpu.transport import (
-    CommandCenter, CommandRequest, SimpleHttpCommandCenter,
+    CommandCenter, CommandRequest, CommandResponse, SimpleHttpCommandCenter,
     HeartbeatSender, register_default_handlers,
 )
 
@@ -331,3 +331,21 @@ def test_form_body_invalid_utf8_returns_400(sentinel):
             assert exc.code == 400
     finally:
         rt.stop()
+
+
+def test_command_interceptors_short_circuit(sentinel):
+    """CommandHandlerInterceptor analog: interceptors run before handlers
+    and may short-circuit (auth gates / audit on the command plane)."""
+    center = CommandCenter()
+    register_default_handlers(center, sentinel)
+    seen = []
+    center.add_interceptor(lambda name, req: seen.append(name) or None)
+    center.add_interceptor(
+        lambda name, req: CommandResponse.of_failure("forbidden", 403)
+        if name == "setRules" else None)
+
+    assert center.handle("version", CommandRequest(parameters={})).success
+    resp = center.handle("setRules", CommandRequest(parameters={
+        "type": "flow", "data": "[]"}))
+    assert not resp.success and resp.code == 403
+    assert seen == ["version", "setRules"]
