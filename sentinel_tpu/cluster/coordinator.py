@@ -66,6 +66,26 @@ class _EmbeddedTokenService:
         return EmbeddedTokenResult(status=status, wait_ms=wait,
                                    remaining=remaining)
 
+    # batched surface: the runtime's batch tier funnels a whole entry_batch
+    # worth of token requests into ONE engine step instead of a device
+    # round-trip per event (the reference has no analog — its token RPCs
+    # are per-call — but the engine is batched end-to-end here)
+    def request_tokens_batch(self, items):
+        """items: [(flow_id, count, prioritized)] → aligned results."""
+        res = self.engine.request_tokens(
+            [i[0] for i in items], [i[1] for i in items],
+            [bool(i[2]) for i in items], now_ms=self._now())
+        return [EmbeddedTokenResult(status=s, wait_ms=w, remaining=r)
+                for (s, w, r) in res]
+
+    def request_param_tokens_batch(self, items):
+        """items: [(flow_id, count, params)] → aligned results."""
+        res = self.engine.request_param_tokens(
+            [i[0] for i in items], [i[1] for i in items],
+            [list(i[2]) for i in items], now_ms=self._now())
+        return [EmbeddedTokenResult(status=s, wait_ms=w, remaining=r)
+                for (s, w, r) in res]
+
 
 class ClusterCoordinator:
     def __init__(self, sentinel, *, namespace: Optional[str] = None,

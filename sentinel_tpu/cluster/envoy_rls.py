@@ -26,7 +26,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from sentinel_tpu.core.clock import Clock
 from sentinel_tpu.parallel.cluster import (
-    STATUS_BLOCKED, STATUS_TOO_MANY_REQUEST, THRESHOLD_GLOBAL,
+    STATUS_NO_RULE_EXISTS, STATUS_OK, THRESHOLD_GLOBAL,
     ClusterEngine, ClusterFlowRule,
 )
 
@@ -165,10 +165,12 @@ class EnvoyRlsService:
                 flow_ids, [acquire] * len(flow_ids), now_ms=self._now_ms())
             for (status, _wait, remaining), fid, i in zip(
                     results, flow_ids, positions):
-                # only explicit denials reject: a rule dropped between
-                # lookup and token request (NO_RULE_EXISTS) must keep the
-                # "no rule ⇒ OK" contract, and SHOULD_WAIT is an admission
-                blocked = status in (STATUS_BLOCKED, STATUS_TOO_MANY_REQUEST)
+                # SentinelEnvoyRlsServiceImpl: a rule dropped between lookup
+                # and token request (NO_RULE_EXISTS) keeps the "no rule ⇒ OK"
+                # contract; every OTHER non-OK status (BLOCKED, TOO_MANY,
+                # SHOULD_WAIT, FAIL, BAD_REQUEST) is OVER_LIMIT — RLS has no
+                # way to honor a wait, and engine errors must not fail open
+                blocked = status not in (STATUS_OK, STATUS_NO_RULE_EXISTS)
                 statuses[i] = DescriptorStatus(
                     code=CODE_OVER_LIMIT if blocked else CODE_OK,
                     limit=self.rules.limit_of(fid),

@@ -71,6 +71,12 @@ class SentinelConfig:
     # Warm-up cold factor (SentinelConfig default 3)
     cold_factor: int = 3
 
+    def __post_init__(self) -> None:
+        if not 1 <= self.max_rules_per_resource <= 31:
+            # the per-rule cluster-fallback mask is an int32 bitmask over
+            # the per-resource rule slots — slot 31+ would overflow it
+            raise ValueError("max_rules_per_resource must be in [1, 31]")
+
     def metric_dir(self) -> str:
         if self.metric_log_dir:
             return self.metric_log_dir

@@ -1,0 +1,42 @@
+"""Lazy result handles for dispatched-but-unread device work.
+
+The double-buffering primitive shared by the serving paths
+(``Sentinel.entry_batch_nowait`` / ``ClusterEngine.request_tokens_nowait``):
+the device step is dispatched (engine state already advanced in order) and
+the device→host transfer started async; :meth:`PendingResult.result`
+materializes. Holding a handle while dispatching the next batch overlaps the
+readback — the dominant per-batch cost on a remote-attached device — with
+the next batch's host prep.
+"""
+
+from __future__ import annotations
+
+
+class PendingResult:
+    """Memoizing one-shot handle: ``result()`` runs the deferred
+    materialization exactly once and returns the cached value after."""
+
+    __slots__ = ("_fn", "_done", "_res")
+
+    def __init__(self, fn):
+        self._fn = fn
+        self._done = False
+        self._res = None
+
+    def result(self):
+        if not self._done:
+            self._res = self._fn()
+            self._done = True
+            self._fn = None
+        return self._res
+
+
+def start_host_copy(arrays) -> None:
+    """Kick off async device→host copies so a later ``np.asarray`` finds
+    the data already (or nearly) resident instead of paying the full RTT
+    at materialization time. Backends without async D2H just sync later."""
+    for a in arrays:
+        try:
+            a.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass

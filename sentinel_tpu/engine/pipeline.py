@@ -101,10 +101,11 @@ class EntryBatch(NamedTuple):
     valid: jnp.ndarray          # bool[B]
     param_rules: Optional[jnp.ndarray] = None   # int32[B, PV] (param slot off: None)
     param_keys: Optional[jnp.ndarray] = None    # int32[B, PV]
-    # events whose cluster token request failed and whose rule says
-    # fallbackToLocalWhenFail: their cluster-mode rules check LOCALLY
-    # (FlowRuleChecker.fallbackToLocalOrPass); None = all False
-    cluster_fallback: Optional[jnp.ndarray] = None   # bool[B]
+    # per-event bitmask over per-resource rule slots: bit k set = the
+    # cluster-mode rule in slot k had its token request fail with
+    # fallbackToLocalWhenFail, so exactly that rule checks LOCALLY
+    # (per-rule FlowRuleChecker.fallbackToLocalOrPass); None = no fallback
+    cluster_fallback: Optional[jnp.ndarray] = None   # int32[B]
 
 
 class ExitBatch(NamedTuple):
@@ -207,7 +208,7 @@ def decide_entries(
         param_wait = jnp.zeros(live2.shape, jnp.int32)
 
     cl_fb = (batch.cluster_fallback if batch.cluster_fallback is not None
-             else jnp.zeros_like(batch.valid))
+             else jnp.zeros(batch.valid.shape, jnp.int32))
     fview = flow_mod.FlowBatchView(
         rows=batch.rows, origin_ids=batch.origin_ids,
         origin_rows=batch.origin_rows, context_ids=batch.context_ids,

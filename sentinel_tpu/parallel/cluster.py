@@ -49,6 +49,7 @@ try:  # jax >= 0.6 exposes shard_map at top level
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
+from sentinel_tpu.core.pending import PendingResult, start_host_copy
 from sentinel_tpu.ops import segments as seg
 from sentinel_tpu.stats import events as ev
 from sentinel_tpu.stats.window import (
@@ -365,6 +366,20 @@ class ClusterFlowRule:
     max_occupy_ratio: float = 1.0
 
 
+class PendingTokenResults(PendingResult):
+    """Handle for an in-flight token batch: the device step is already
+    dispatched (and the verdict transfer started async); :meth:`result`
+    materializes the aligned ``(status, wait_ms, remaining)`` list. Lets
+    callers double-buffer — dispatch batch N+1 while batch N's verdicts are
+    still in flight over the host link."""
+
+    __slots__ = ()
+
+
+def _start_host_copy(verdicts: "TokenVerdicts") -> None:
+    start_host_copy((verdicts.status, verdicts.wait_ms, verdicts.remaining))
+
+
 class ClusterEngine:
     """Host facade: flow routing, namespace management, the sharded step.
 
@@ -542,6 +557,16 @@ class ClusterEngine:
         """Batched ``TokenService.requestParamToken`` → ``(status, wait_ms,
         remaining)`` per request. Values beyond ``spec.max_params`` per
         request are dropped (cap documented on :class:`ClusterSpec`)."""
+        return self.request_param_tokens_nowait(
+            flow_ids, acquire, params, now_ms=now_ms).result()
+
+    def request_param_tokens_nowait(
+            self, flow_ids: Sequence[int], acquire: Sequence[int],
+            params: Sequence[Sequence[object]],
+            *, now_ms: int) -> PendingTokenResults:
+        """Dispatch-only variant: the sharded step is enqueued and the
+        verdict readback deferred to ``.result()`` so callers can overlap
+        batch N's readback with batch N+1's host prep + dispatch."""
         from sentinel_tpu.core.batching import pad_pow2
 
         n = len(flow_ids)
@@ -566,7 +591,8 @@ class ClusterEngine:
 
             bl = max((len(p) for p in per_shard), default=0)
             if bl == 0:
-                return [r or (STATUS_FAIL, 0, 0) for r in results]
+                out = [r or (STATUS_FAIL, 0, 0) for r in results]
+                return PendingTokenResults(lambda: out)
             blp = pad_pow2(bl)
 
             rows = np.zeros((S, blp), np.int32)
@@ -605,7 +631,13 @@ class ClusterEngine:
                 jax.device_put(jnp.asarray(self._connected), self._sh_rep),
                 jax.device_put(jnp.asarray(self._ns_limit), self._sh_rep),
                 now_idx, in_win)
+        _start_host_copy(verdicts)
+        return PendingTokenResults(functools.partial(
+            self._gather_results, verdicts, per_shard, results, S, blp))
 
+    def _gather_results(self, verdicts, per_shard, results, S, blp):
+        """Deferred readback: materialize the verdict arrays and scatter
+        them back into request order (shared by flow + param paths)."""
         st = np.asarray(verdicts.status).reshape(S, blp)
         wt = np.asarray(verdicts.wait_ms).reshape(S, blp)
         rm = np.asarray(verdicts.remaining).reshape(S, blp)
@@ -658,6 +690,18 @@ class ClusterEngine:
                        *, now_ms: int) -> List[Tuple[int, int, int]]:
         """Batched ``TokenService.requestToken`` → list of
         ``(status, wait_ms, remaining)`` aligned with the inputs."""
+        return self.request_tokens_nowait(
+            flow_ids, acquire, prioritized, now_ms=now_ms).result()
+
+    def request_tokens_nowait(self, flow_ids: Sequence[int],
+                              acquire: Sequence[int],
+                              prioritized: Optional[Sequence[bool]] = None,
+                              *, now_ms: int) -> PendingTokenResults:
+        """Dispatch-only ``requestToken``: enqueue the sharded step, start
+        the async device→host verdict copy, and defer materialization to
+        ``.result()`` — the double-buffered front-end the serving path uses
+        to hide readback latency (state updates still apply in dispatch
+        order under the engine lock)."""
         from sentinel_tpu.core.batching import pad_pow2
 
         n = len(flow_ids)
@@ -680,7 +724,8 @@ class ClusterEngine:
 
             bl = max((len(p) for p in per_shard), default=0)
             if bl == 0:
-                return [r or (STATUS_FAIL, 0, 0) for r in results]
+                out = [r or (STATUS_FAIL, 0, 0) for r in results]
+                return PendingTokenResults(lambda: out)
             blp = pad_pow2(bl)
 
             rows = np.zeros((S, blp), np.int32)
@@ -713,14 +758,9 @@ class ClusterEngine:
                 jax.device_put(jnp.asarray(self._connected), self._sh_rep),
                 jax.device_put(jnp.asarray(self._ns_limit), self._sh_rep),
                 now_idx, in_win)
-
-        st = np.asarray(verdicts.status).reshape(S, blp)
-        wt = np.asarray(verdicts.wait_ms).reshape(S, blp)
-        rm = np.asarray(verdicts.remaining).reshape(S, blp)
-        for s in range(S):
-            for k, i in enumerate(per_shard[s]):
-                results[i] = (int(st[s, k]), int(wt[s, k]), int(rm[s, k]))
-        return [r or (STATUS_FAIL, 0, 0) for r in results]
+        _start_host_copy(verdicts)
+        return PendingTokenResults(functools.partial(
+            self._gather_results, verdicts, per_shard, results, S, blp))
 
     def flow_metrics(self, flow_id: int, *, now_ms: int) -> dict:
         """Per-flow current-window snapshot (ClusterMetricNodeGenerator)."""

@@ -281,7 +281,7 @@ class FlowBatchView(NamedTuple):
     acquire: jnp.ndarray       # int32[B]
     valid: jnp.ndarray         # bool[B]
     prioritized: jnp.ndarray   # bool[B] — entryWithPriority (occupy eligible)
-    cluster_fallback: jnp.ndarray  # bool[B] — enable cluster rules locally
+    cluster_fallback: jnp.ndarray  # int32[B] — bit k: check slot-k cluster rule locally
 
 
 def flow_check(
@@ -340,10 +340,12 @@ def flow_check(
     app_other = (lim == LIMIT_OTHER) & (~specific_hit_bk) & (origin_bk != 0)
     applicable = act & (app_default | app_specific | app_other)
     # cluster-mode rules are enforced by the token server, not locally —
-    # EXCEPT for events whose token request failed with fallbackToLocal
-    # (FlowRuleChecker.passClusterCheck / fallbackToLocalOrPass)
-    applicable = applicable & (
-        ~table.cluster_mode[rj] | jnp.repeat(batch.cluster_fallback, K))
+    # EXCEPT the specific rules whose token request failed with
+    # fallbackToLocal: bit k of the per-event mask re-enables slot k
+    # (per-rule FlowRuleChecker.passClusterCheck / fallbackToLocalOrPass)
+    slot_bk = jnp.tile(jnp.arange(K, dtype=jnp.int32), B)
+    fb_bk = (jnp.repeat(batch.cluster_fallback, K) >> slot_bk) & 1
+    applicable = applicable & (~table.cluster_mode[rj] | (fb_bk == 1))
     # CHAIN additionally requires the event's context to match refResource
     kind = table.sel_kind[rj]
     applicable = applicable & jnp.where(

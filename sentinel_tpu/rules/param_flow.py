@@ -282,6 +282,27 @@ class ParamKeyRegistry:
                     else:
                         self._pins[r] = n
 
+    def get_or_create_batch(self, items) -> List[int]:
+        """Intern many ``(rule_slot, key_form, override_or_None)`` triples
+        under ONE lock hold → aligned row list. The batch tier's analog of
+        the native resource batch-intern: per-key lock traffic is what
+        dominates host-side prep at 4k+ events/step."""
+        out: List[int] = []
+        with self._lock:
+            for rule_slot, kf, override in items:
+                key = (rule_slot, kf)
+                row = self._map.get(key)
+                if row is not None:
+                    self._map.move_to_end(key)
+                else:
+                    row = (self._free.pop() if self._free
+                           else self._evict_lru_locked())
+                    self._map[key] = row
+                    if override is not None:
+                        self._pending_override.append((row, float(override)))
+                out.append(row)
+        return out
+
     def drain_updates(self) -> Tuple[List[int], List[Tuple[int, float]]]:
         """→ (evicted rows to invalidate, pending override writes)."""
         with self._lock:
@@ -351,6 +372,58 @@ def resolve_pairs(compiled: CompiledParamRules, keys: ParamKeyRegistry,
             pr[fills] = slot_j
             pk[fills] = keys.get_or_create(slot_j, kf, override=ov)
             fills += 1
+    return pr, pk
+
+
+def resolve_pairs_many(compiled: CompiledParamRules, keys: ParamKeyRegistry,
+                       rows: Sequence[int], args_list: Sequence[Sequence[Any]],
+                       pairs_per_event: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Batch form of :func:`resolve_pairs`: resolve every event's pairs with
+    ONE registry lock hold (``get_or_create_batch``) instead of a lock per
+    key. → ``(param_rules [n, PV], param_keys [n, PV])``."""
+    n_events = len(rows)
+    np_sentinel = compiled.table.active.shape[0] - 1
+    pk_sentinel = keys.capacity
+    pr = np.full((n_events, pairs_per_event), np_sentinel, np.int32)
+    pk = np.full((n_events, pairs_per_event), pk_sentinel, np.int32)
+    # first pass: collect (event, fill, slot, key_form, override) flat
+    want: List[Tuple[int, int, int, Any, Optional[int]]] = []
+    for i, (row, args) in enumerate(zip(rows, args_list)):
+        if not args:
+            continue
+        entries = compiled.by_row.get(int(row))
+        if not entries:
+            continue
+        n = len(args)
+        fills = 0
+        for slot_j, idx, hot in entries:
+            if idx < 0:
+                idx = n + idx if -idx <= n else -idx
+            if idx >= n:
+                continue
+            value = args[idx]
+            if value is None:
+                continue
+            values = (list(value)
+                      if isinstance(value, (list, tuple, set, frozenset))
+                      else [value])
+            for v in values:
+                if v is None:
+                    continue
+                if fills >= pairs_per_event:
+                    raise ValueError(
+                        f"event needs more than {pairs_per_event} param "
+                        f"checks; raise param_pairs_per_event")
+                kf = _key_form(v)
+                want.append((i, fills, slot_j, kf, hot.get(kf)))
+                fills += 1
+    if not want:
+        return pr, pk
+    rows_out = keys.get_or_create_batch(
+        [(slot_j, kf, ov) for _i, _f, slot_j, kf, ov in want])
+    for (i, f, slot_j, _kf, _ov), key_row in zip(want, rows_out):
+        pr[i, f] = slot_j
+        pk[i, f] = key_row
     return pr, pk
 
 

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 
 from sentinel_tpu.core.batching import pad_pow2, pad_to as _pad_to
 from sentinel_tpu.core.clock import Clock, global_clock
+from sentinel_tpu.core.pending import PendingResult, start_host_copy
 from sentinel_tpu.core.config import SentinelConfig, load_config
 from sentinel_tpu.core.context import current_context
 from sentinel_tpu.core.errors import (
@@ -194,6 +195,14 @@ class Entry:
         return False
 
 
+class PendingVerdicts(PendingResult):
+    """Handle for an in-flight batch decide: ``result()`` materializes the
+    :class:`Verdicts` and performs the deferred host-side bookkeeping
+    (blocked-pin release, block log) — it MUST be called for every handle."""
+
+    __slots__ = ()
+
+
 class Sentinel:
     """The framework instance (Env/CtSph + rule managers, in one object)."""
 
@@ -306,11 +315,19 @@ class Sentinel:
             capacity=cfg.max_flow_rules, k_per_resource=cfg.max_rules_per_resource,
             num_rows=cfg.max_resources, cold_factor=float(cfg.cold_factor),
             origin_registry=self.origins)
+        # cluster rules carry their rule-table SLOT position (k within the
+        # per-resource rule gather) so a failed token request can re-enable
+        # exactly that rule locally via a per-event bitmask — per-rule
+        # fallbackToLocalOrPass (FlowRuleChecker.java:184-193), not one
+        # all-or-nothing flag. Slot assignment mirrors compile_flow_rules.
         cluster_map: dict = {}
+        slots_used: dict = {}
         for r in compiled.rules:
+            row = self.resources.get_or_create(r.resource)
+            k = slots_used.get(row, 0)
+            slots_used[row] = k + 1
             if r.cluster_mode:
-                row = self.resources.get_or_create(r.resource)
-                cluster_map.setdefault(row, []).append(r)
+                cluster_map.setdefault(row, []).append((k, r))
         with self._lock:
             self._flow = compiled
             self._cluster_rules_by_row = cluster_map
@@ -447,8 +464,9 @@ class Sentinel:
 
         # cluster-mode rules: token-server delegation BEFORE the local
         # pipeline (FlowRuleChecker.passClusterCheck); failed requests with
-        # fallbackToLocalWhenFail re-enable those rules locally
-        cluster_fb = False
+        # fallbackToLocalWhenFail re-enable exactly those rules locally
+        # (per-rule slot bitmask)
+        cluster_fb = 0
         cluster_wait = 0
         crules = self._cluster_rules_by_row.get(row)
         if crules:
@@ -474,7 +492,7 @@ class Sentinel:
                 np.array([is_in], np.bool_), np.array([prioritized], np.bool_),
                 param_rules=pr, param_keys=pk,
                 param_gen=pairs[2] if pairs is not None else -1,
-                cluster_fallback=(np.array([True], np.bool_)
+                cluster_fallback=(np.array([cluster_fb], np.int32)
                                   if cluster_fb else None))
             if not bool(verdict.allow[0]):
                 exc = block_exception_for(int(verdict.reason[0]), resource,
@@ -534,17 +552,24 @@ class Sentinel:
                        o_row: int, c_row: int, acquire: int, is_in: bool,
                        prioritized: bool, crules,
                        sleep: bool = True,
-                       record: bool = True) -> Tuple[bool, int]:
+                       record: bool = True) -> Tuple[int, int]:
         """``passClusterCheck`` for this resource's cluster-mode rules.
-        Returns ``(need_local_fallback, pending_wait_ms)``; raises
+        ``crules`` is a list of ``(slot_k, rule)`` pairs (slot = the rule's
+        position in the per-resource rule gather). Returns
+        ``(fallback_bits, pending_wait_ms)`` where bit k of ``fallback_bits``
+        re-enables exactly slot k's rule in the local pipeline — per-rule
+        ``fallbackToLocalOrPass`` (FlowRuleChecker.java:184-193), so mixed
+        grant/failure locally enforces only the failed rules. Raises
         FlowException on BLOCKED and records the block like StatisticSlot
-        would. With ``sleep=False`` SHOULD_WAIT waits are returned instead
-        of slept (async callers await them via ``Entry.wait_ms``)."""
+        would. TOO_MANY_REQUEST (server overload, status -2) degrades to the
+        fallback path like FAIL — it never denies outright
+        (FlowRuleChecker.applyTokenResult). With ``sleep=False`` SHOULD_WAIT
+        waits are returned instead of slept (async callers await them via
+        ``Entry.wait_ms``)."""
         svc = self._token_service
-        fallback_wanted = False
-        granted = 0
+        fallback_bits = 0
         pending_wait = 0
-        for r in crules:
+        for slot_k, r in crules:
             status, wait = -1, 0           # FAIL when no service installed
             if svc is not None:
                 try:
@@ -557,52 +582,39 @@ class Sentinel:
                     record_log().warning(
                         "cluster token request failed: %r", exc)
             if status == 0:                # OK
-                granted += 1
                 continue
             if status == 2:                # SHOULD_WAIT → sleep, then pass
-                granted += 1
                 if wait > 0:
                     if sleep:
                         self.clock.sleep_ms(wait)
                     else:
                         pending_wait += wait
                 continue
-            if status in (1, -2):          # BLOCKED / TOO_MANY_REQUEST
+            if status == 1:                # BLOCKED
                 if record:
                     raise self._record_cluster_block(
                         int(BlockReason.FLOW), resource, origin, row,
                         o_row, c_row, acquire, is_in)
-                exc = block_exception_for(int(BlockReason.FLOW), resource,
-                                          origin=origin)
-                self.block_log.log(resource, type(exc).__name__,
-                                   origin=origin)
-                if not self.callbacks.empty:
-                    self.callbacks.fire_blocked(resource, origin, acquire,
-                                                exc)
-                raise exc
-            # FAIL / NO_RULE_EXISTS / BAD_REQUEST → local check or pass
+                raise self._log_cluster_block(int(BlockReason.FLOW),
+                                              resource, origin, acquire)
+            # FAIL / NO_RULE_EXISTS / BAD_REQUEST / TOO_MANY_REQUEST
+            # → local check (iff fallbackToLocalWhenFail) or pass
             if r.cluster_fallback_to_local:
-                fallback_wanted = True
-        # the local-fallback flag re-enables ALL the resource's cluster
-        # rules in the local pipeline, so it must not fire when some rule's
-        # token was explicitly granted (that would double-limit an admitted
-        # request); mixed grant/failure passes the failed rules through
-        if fallback_wanted and granted:
-            from sentinel_tpu.core.logs import record_log
-            record_log().warning(
-                "cluster rules for %s partially failed; failed rules pass "
-                "through (no local fallback while others granted)", resource)
-        return fallback_wanted and not granted, pending_wait
+                fallback_bits |= 1 << slot_k
+        return fallback_bits, pending_wait
 
     def _cluster_param_check(self, resource: str, origin: str, row: int,
                              o_row: int, c_row: int, acquire: int,
                              is_in: bool, args: Sequence, cprules,
-                             sleep: bool = True) -> int:
+                             sleep: bool = True, record: bool = True) -> int:
         """``ParamFlowChecker.passClusterCheck`` → ``requestParamToken`` for
         cluster-mode hot-param rules. BLOCKED raises ParamFlowException and
-        records the block; failures pass through with a log (the local
+        (when ``record``) records the block; ``record=False`` lets the batch
+        tier record all cluster blocks in ONE device call instead.
+        TOO_MANY_REQUEST (server overload) passes through like FAIL — it
+        never denies (ParamFlowChecker.passClusterCheck fallback). The local
         fallback for param rules is a documented pass-through here — the
-        flow path carries the exact local fallback)."""
+        flow path carries the exact local fallback."""
         svc = self._token_service
         pending_wait = 0
         for r in cprules:
@@ -630,11 +642,14 @@ class Sentinel:
                     else:
                         pending_wait += wait
                 continue
-            if status in (1, -2):             # BLOCKED / TOO_MANY
-                raise self._record_cluster_block(
-                    int(BlockReason.PARAM_FLOW), resource, origin, row,
-                    o_row, c_row, acquire, is_in)
-            # FAIL / NO_RULE: pass through (logged above when RPC failed)
+            if status == 1:                   # BLOCKED
+                if record:
+                    raise self._record_cluster_block(
+                        int(BlockReason.PARAM_FLOW), resource, origin, row,
+                        o_row, c_row, acquire, is_in)
+                raise self._log_cluster_block(int(BlockReason.PARAM_FLOW),
+                                              resource, origin, acquire)
+            # FAIL / NO_RULE / TOO_MANY: pass through (logged when RPC failed)
         return pending_wait
 
     def _resolve_param_pairs_one(self, row: int, args: Sequence):
@@ -713,6 +728,27 @@ class Sentinel:
                     entry_types: Optional[Sequence[int]] = None,
                     prioritized: Optional[Sequence[bool]] = None,
                     args_list: Optional[Sequence[Sequence]] = None) -> Verdicts:
+        return self.entry_batch_nowait(
+            resources, origins=origins, contexts=contexts, acquire=acquire,
+            entry_types=entry_types, prioritized=prioritized,
+            args_list=args_list).result()
+
+    def entry_batch_nowait(
+            self, resources: Sequence[str], *,
+            origins: Optional[Sequence[str]] = None,
+            contexts: Optional[Sequence[str]] = None,
+            acquire: Optional[Sequence[int]] = None,
+            entry_types: Optional[Sequence[int]] = None,
+            prioritized: Optional[Sequence[bool]] = None,
+            args_list: Optional[Sequence[Sequence]] = None
+    ) -> "PendingVerdicts":
+        """Dispatch-only batch tier: host prep + cluster delegation + the
+        jitted decide are all issued, but the verdict readback (the ~RTT
+        that dominates a remote-attached device) is deferred to
+        ``.result()``. Callers double-buffer — dispatch batch N+1 while N's
+        verdicts are in flight — to hide the device→host latency entirely.
+        ``.result()`` MUST be called for every handle: it also releases
+        blocked events' key pins and writes the block log."""
         n = len(resources)
         batch_intern = getattr(self.resources, "get_or_create_batch", None)
         if batch_intern is not None:      # native table: one FFI call, no GIL
@@ -729,15 +765,8 @@ class Sentinel:
             gen = self._param_gen
         if args_list is not None and compiled.num_active:
             param_gen = gen
-            pv = self.spec.param_pairs
-            param_rules = np.full((n, pv), self.cfg.max_param_rules, np.int32)
-            param_keys = np.full((n, pv), self.spec.param_keys, np.int32)
-            for i, a in enumerate(args_list):
-                if a and int(rows[i]) in compiled.by_row:
-                    pr, pk = pf_mod.resolve_pairs(
-                        compiled, registry, int(rows[i]), a, pv)
-                    param_rules[i] = pr
-                    param_keys[i] = pk
+            param_rules, param_keys = pf_mod.resolve_pairs_many(
+                compiled, registry, rows, args_list, self.spec.param_pairs)
             # pin THREAD-grade pairs while in flight (released for blocked
             # events below; allowed events stay pinned until exit_batch)
             registry.pin_rows(pf_mod.thread_key_rows(
@@ -764,46 +793,19 @@ class Sentinel:
         prio = np.asarray(prioritized, np.bool_) if prioritized is not None \
             else np.zeros(n, np.bool_)
 
-        # cluster-mode rules: token delegation per event, same as entry()
-        # (passClusterCheck). Cluster-blocked events are excluded from the
-        # local decide (their block is recorded by _cluster_check) and
-        # surfaced as FLOW denials in the returned verdicts.
-        cl_blocked = None
-        cl_waits = None
-        cluster_fb_arr = None
-        valid_mask = None
+        # cluster-mode rules: token delegation BEFORE the local decide, ONE
+        # batched RPC for the whole batch when the service supports it.
+        # Cluster-blocked events are excluded from the local decide and
+        # surfaced as FLOW/PARAM_FLOW denials in the returned verdicts.
+        cl = None
         if self._cluster_rules_by_row or self._cluster_param_rules_by_row:
-            fallback = np.zeros(n, np.bool_)
-            cl_blocked = np.zeros(n, np.bool_)
-            cl_waits = np.zeros(n, np.int32)
-            valid_mask = np.ones(n, np.bool_)
-            for i in range(n):
-                crules = self._cluster_rules_by_row.get(int(rows[i]))
-                cprules = self._cluster_param_rules_by_row.get(int(rows[i]))
-                if not crules and not cprules:
-                    continue
-                org = (origins[i] if origins is not None
-                       and origins[i] else "")
-                try:
-                    if crules:
-                        fb, w = self._cluster_check(
-                            resources[i], org, int(rows[i]),
-                            int(origin_rows[i]), int(chain_rows[i]),
-                            int(acq[i]), bool(is_in[i]), bool(prio[i]),
-                            crules, sleep=False, record=False)
-                        fallback[i] = fb
-                        cl_waits[i] = w
-                    if cprules and args_list is not None and args_list[i]:
-                        cl_waits[i] += self._cluster_param_check(
-                            resources[i], org, int(rows[i]),
-                            int(origin_rows[i]), int(chain_rows[i]),
-                            int(acq[i]), bool(is_in[i]), args_list[i],
-                            cprules, sleep=False)
-                except BlockException:
-                    cl_blocked[i] = True
-                    valid_mask[i] = False   # out of the local decide entirely
-            if fallback.any():
-                cluster_fb_arr = fallback
+            cl = self._cluster_precheck_batch(
+                resources, origins, rows, origin_rows, chain_rows,
+                acq, is_in, prio, args_list, n)
+        cl_blocked = cl_waits = cl_reasons = None
+        cluster_fb_arr = valid_mask = None
+        if cl is not None:
+            cluster_fb_arr, cl_blocked, cl_waits, cl_reasons, valid_mask = cl
             # one batched device record for every cluster-blocked event
             if cl_blocked.any():
                 idxs = np.nonzero(cl_blocked)[0]
@@ -826,44 +828,203 @@ class Sentinel:
                                             np.bool_)),
                         times)
 
-        verdicts = self.decide_raw(rows, origin_ids, origin_rows,
-                                   context_ids, chain_rows, acq, is_in, prio,
-                                   param_rules=param_rules,
-                                   param_keys=param_keys, param_gen=param_gen,
-                                   cluster_fallback=cluster_fb_arr,
-                                   valid=valid_mask)
-        if cl_blocked is not None and cl_blocked.any():
-            allow = np.array(verdicts.allow, copy=True)
-            reason = np.array(verdicts.reason, copy=True)
-            allow[cl_blocked] = False
-            reason[cl_blocked] = int(BlockReason.FLOW)
-            verdicts = Verdicts(allow=allow, reason=reason,
-                                wait_ms=np.maximum(verdicts.wait_ms,
-                                                   cl_waits))
-        elif cl_waits is not None:
-            verdicts = verdicts._replace(
-                wait_ms=np.maximum(verdicts.wait_ms, cl_waits))
+        pending = self.decide_raw_nowait(
+            rows, origin_ids, origin_rows, context_ids, chain_rows, acq,
+            is_in, prio, param_rules=param_rules, param_keys=param_keys,
+            param_gen=param_gen, cluster_fallback=cluster_fb_arr,
+            valid=valid_mask)
 
-        if param_keys is not None:
-            # blocked events never exit → release their pins immediately
-            blocked = ~np.asarray(verdicts.allow)
-            if blocked.any():
-                registry.unpin_rows(pf_mod.thread_key_rows(
-                    compiled, param_rules[blocked], param_keys[blocked]))
-        # LogSlot parity for the batch tier: blocked events roll into
-        # sentinel-block.log (same per-second dedup as the single path);
-        # cluster blocks were already logged inside _cluster_check
-        denied = np.nonzero(~np.asarray(verdicts.allow))[0]
-        if denied.size:
-            reasons = np.asarray(verdicts.reason)
-            for i in denied.tolist():
-                if cl_blocked is not None and cl_blocked[i]:
+        def _finalize() -> Verdicts:
+            verdicts = pending.result()
+            if cl_blocked is not None and cl_blocked.any():
+                allow = np.array(verdicts.allow, copy=True)
+                reason = np.array(verdicts.reason, copy=True)
+                allow[cl_blocked] = False
+                # per-event reason: param-token denials raise
+                # ParamFlowException downstream, flow-token denials
+                # FlowException (entry() parity)
+                reason[cl_blocked] = cl_reasons[cl_blocked]
+                verdicts = Verdicts(allow=allow, reason=reason,
+                                    wait_ms=np.maximum(verdicts.wait_ms,
+                                                       cl_waits))
+            elif cl_waits is not None:
+                verdicts = verdicts._replace(
+                    wait_ms=np.maximum(verdicts.wait_ms, cl_waits))
+
+            if param_keys is not None:
+                # blocked events never exit → release their pins immediately
+                blocked = ~np.asarray(verdicts.allow)
+                if blocked.any():
+                    registry.unpin_rows(pf_mod.thread_key_rows(
+                        compiled, param_rules[blocked], param_keys[blocked]))
+            # LogSlot parity for the batch tier: blocked events roll into
+            # sentinel-block.log (same per-second dedup as the single path);
+            # cluster blocks were already logged in the pre-check
+            denied = np.nonzero(~np.asarray(verdicts.allow))[0]
+            if denied.size:
+                reasons = np.asarray(verdicts.reason)
+                for i in denied.tolist():
+                    if cl_blocked is not None and cl_blocked[i]:
+                        continue
+                    self.block_log.log(
+                        resources[i],
+                        err_mod.exception_name_for(int(reasons[i])),
+                        origin=(origins[i] if origins is not None
+                                and origins[i] else ""))
+            return verdicts
+
+        return PendingVerdicts(_finalize)
+
+    def _log_cluster_block(self, reason: int, resource: str, origin: str,
+                           acquire: int) -> BlockException:
+        """Block log + StatisticSlot callbacks for a token-server denial
+        decided off-device (device record happens batched upstream);
+        returns the exception for callers that raise it."""
+        exc = block_exception_for(reason, resource, origin=origin)
+        self.block_log.log(resource, type(exc).__name__, origin=origin)
+        if not self.callbacks.empty:
+            self.callbacks.fire_blocked(resource, origin, acquire, exc)
+        return exc
+
+    def _cluster_precheck_batch(self, resources, origins, rows, origin_rows,
+                                chain_rows, acq, is_in, prio, args_list,
+                                n: int):
+        """Cluster token delegation for a whole batch → ``(fallback_bits or
+        None, cl_blocked, cl_waits, cl_reasons, valid_mask)``.
+
+        When the installed token service exposes the pipelined batch surface
+        (``request_tokens_batch`` — the embedded engine and the socket
+        client both do), ALL of the batch's token requests go out as ONE
+        call instead of a blocking RPC per event
+        (``ClusterFlowChecker.java:55-112`` semantics per request, applied
+        in rule order per event; a BLOCKED verdict short-circuits the
+        event's remaining results exactly like the exception would have).
+        Tokens for an event's later rules may be consumed even when an
+        earlier rule blocks — bounded over-consumption of the same class as
+        the reference's tolerated check-then-act races. Falls back to the
+        per-event blocking path for plain per-call services."""
+        svc = self._token_service
+        fallback = np.zeros(n, np.int32)      # per-rule slot bitmask
+        cl_blocked = np.zeros(n, np.bool_)
+        cl_waits = np.zeros(n, np.int32)
+        cl_reasons = np.full(n, int(BlockReason.FLOW), np.int32)
+        valid_mask = np.ones(n, np.bool_)
+
+        use_batch = svc is not None and hasattr(svc, "request_tokens_batch")
+        if not use_batch:
+            for i in range(n):
+                crules = self._cluster_rules_by_row.get(int(rows[i]))
+                cprules = self._cluster_param_rules_by_row.get(int(rows[i]))
+                if not crules and not cprules:
                     continue
-                self.block_log.log(
-                    resources[i], err_mod.exception_name_for(int(reasons[i])),
-                    origin=(origins[i] if origins is not None
-                            and origins[i] else ""))
-        return verdicts
+                org = (origins[i] if origins is not None
+                       and origins[i] else "")
+                try:
+                    if crules:
+                        fb, w = self._cluster_check(
+                            resources[i], org, int(rows[i]),
+                            int(origin_rows[i]), int(chain_rows[i]),
+                            int(acq[i]), bool(is_in[i]), bool(prio[i]),
+                            crules, sleep=False, record=False)
+                        fallback[i] = fb
+                        cl_waits[i] = w
+                    if cprules and args_list is not None and args_list[i]:
+                        cl_waits[i] += self._cluster_param_check(
+                            resources[i], org, int(rows[i]),
+                            int(origin_rows[i]), int(chain_rows[i]),
+                            int(acq[i]), bool(is_in[i]), args_list[i],
+                            cprules, sleep=False, record=False)
+                except BlockException as exc:
+                    cl_blocked[i] = True
+                    if isinstance(exc, err_mod.ParamFlowException):
+                        cl_reasons[i] = int(BlockReason.PARAM_FLOW)
+                    valid_mask[i] = False   # out of the local decide
+            return ((fallback if fallback.any() else None), cl_blocked,
+                    cl_waits, cl_reasons, valid_mask)
+
+        # ---- batched path: collect → one RPC per kind → apply in order ----
+        flow_req: list = []    # (event_i, slot_k, rule)
+        param_req: list = []   # (event_i, rule, value)
+        for i in range(n):
+            crules = self._cluster_rules_by_row.get(int(rows[i]))
+            cprules = self._cluster_param_rules_by_row.get(int(rows[i]))
+            if crules:
+                for slot_k, r in crules:
+                    flow_req.append((i, slot_k, r))
+            if cprules and args_list is not None and args_list[i]:
+                a = args_list[i]
+                for r in cprules:
+                    idx = (r.param_idx if r.param_idx >= 0
+                           else len(a) + r.param_idx)
+                    if 0 <= idx < len(a):
+                        param_req.append((i, r, a[idx]))
+        from sentinel_tpu.core.logs import record_log
+        flow_res: list = [None] * len(flow_req)
+        param_res: list = [None] * len(param_req)
+        try:
+            if flow_req:
+                flow_res = svc.request_tokens_batch(
+                    [(r.cluster_flow_id, int(acq[i]), bool(prio[i]))
+                     for i, _k, r in flow_req])
+        except Exception as exc:
+            record_log().warning("batched cluster token request failed: %r",
+                                 exc)
+        # the param batch surface is gated on ITS OWN method — a service
+        # exposing only the flow batch must not silently fail-open for
+        # param rules (per-call requestParamToken is the fallback)
+        try:
+            if param_req and hasattr(svc, "request_param_tokens_batch"):
+                param_res = svc.request_param_tokens_batch(
+                    [(r.cluster_flow_id, int(acq[i]), [v])
+                     for i, r, v in param_req])
+            elif param_req:
+                param_res = [svc.request_param_token(
+                    r.cluster_flow_id, int(acq[i]), [v])
+                    for i, r, v in param_req]
+        except Exception as exc:
+            record_log().warning("batched cluster param request failed: %r",
+                                 exc)
+        for (i, slot_k, r), res in zip(flow_req, flow_res):
+            if cl_blocked[i]:
+                continue        # first BLOCK wins (exception short-circuit)
+            status = int(res.status) if res is not None else -1
+            if status == 0:
+                continue
+            if status == 2:
+                cl_waits[i] += int(getattr(res, "wait_ms", 0))
+                continue
+            if status == 1:
+                cl_blocked[i] = True
+                valid_mask[i] = False
+                cl_reasons[i] = int(BlockReason.FLOW)
+                self._log_cluster_block(
+                    int(BlockReason.FLOW), resources[i],
+                    (origins[i] if origins is not None and origins[i]
+                     else ""), int(acq[i]))
+                continue
+            # FAIL / NO_RULE / BAD_REQUEST / TOO_MANY → per-rule fallback
+            if r.cluster_fallback_to_local:
+                fallback[i] |= 1 << slot_k
+        for (i, r, _v), res in zip(param_req, param_res):
+            if cl_blocked[i]:
+                continue
+            status = int(res.status) if res is not None else -1
+            if status == 0:
+                continue
+            if status == 2:
+                cl_waits[i] += int(getattr(res, "wait_ms", 0))
+                continue
+            if status == 1:
+                cl_blocked[i] = True
+                valid_mask[i] = False
+                cl_reasons[i] = int(BlockReason.PARAM_FLOW)
+                self._log_cluster_block(
+                    int(BlockReason.PARAM_FLOW), resources[i],
+                    (origins[i] if origins is not None and origins[i]
+                     else ""), int(acq[i]))
+            # other statuses: pass through (param fallback is pass-through)
+        return ((fallback if fallback.any() else None), cl_blocked,
+                cl_waits, cl_reasons, valid_mask)
 
     def _pad_pairs(self, arr: Optional[np.ndarray], b: int, fill: int):
         """Pad an [n, PV] pair array to [b, PV] (or None passthrough)."""
@@ -880,6 +1041,21 @@ class Sentinel:
         """Lowest-level host entry point: pre-resolved numpy arrays.
         ``param_gen`` is the generation the pair arrays were resolved against;
         stale pairs (a reload raced the resolve) are dropped, not misapplied."""
+        return self.decide_raw_nowait(
+            rows, origin_ids, origin_rows, context_ids, chain_rows, acquire,
+            is_in, prioritized, param_rules=param_rules,
+            param_keys=param_keys, param_gen=param_gen,
+            cluster_fallback=cluster_fallback, valid=valid).result()
+
+    def decide_raw_nowait(self, rows, origin_ids, origin_rows, context_ids,
+                          chain_rows, acquire, is_in, prioritized, *,
+                          param_rules=None, param_keys=None,
+                          param_gen: int = -1, cluster_fallback=None,
+                          valid=None) -> "PendingVerdicts":
+        """:meth:`decide_raw` with the verdict readback deferred: the step
+        is dispatched (state already advanced in order under the lock) and
+        the device→host verdict copy started async; ``.result()``
+        materializes. The double-buffering primitive for serving paths."""
         n = rows.shape[0]
         b = self._pad(n)
         pad_r = self.spec.rows
@@ -897,7 +1073,7 @@ class Sentinel:
                           else np.ones(n, np.bool_), b, False, np.bool_),
             param_rules=self._pad_pairs(param_rules, b, self.cfg.max_param_rules),
             param_keys=self._pad_pairs(param_keys, b, self.spec.param_keys),
-            cluster_fallback=(_pad_to(cluster_fallback, b, False, np.bool_)
+            cluster_fallback=(_pad_to(cluster_fallback, b, 0, np.int32)
                               if cluster_fallback is not None else None),
         )
         now = self.clock.now_ms()
@@ -924,9 +1100,14 @@ class Sentinel:
             state, verdicts = decide(
                 self._ruleset, self._state, batch, times, sys_scalars)
             self._state = state
-        return Verdicts(allow=np.asarray(verdicts.allow)[:n],
-                        reason=np.asarray(verdicts.reason)[:n],
-                        wait_ms=np.asarray(verdicts.wait_ms)[:n])
+        start_host_copy((verdicts.allow, verdicts.reason, verdicts.wait_ms))
+
+        def _read() -> Verdicts:
+            return Verdicts(allow=np.asarray(verdicts.allow)[:n],
+                            reason=np.asarray(verdicts.reason)[:n],
+                            wait_ms=np.asarray(verdicts.wait_ms)[:n])
+
+        return PendingVerdicts(_read)
 
     def exit_batch(self, *, rows, origin_rows, chain_rows, acquire, rt_ms,
                    error, is_in, param_rules=None, param_keys=None,

@@ -223,3 +223,104 @@ def test_cluster_param_rule_delegates(clk):
     with sph.entry("psvc"):
         pass
     assert len(svc.calls) == n
+
+
+def test_too_many_request_falls_back_to_local(clk):
+    """TOO_MANY_REQUEST (-2) is token-server overload, not a verdict: it
+    must degrade to local checking like FAIL, never deny outright
+    (FlowRuleChecker.applyTokenResult → fallbackToLocalOrPass)."""
+    sph = make(clk)
+    svc = FakeTokenService()
+    sph.set_token_service(svc)
+    sph.load_flow_rules([cluster_rule(count=2.0)])
+    svc.script = [_Result(-2)] * 5
+    res = []
+    for _ in range(5):
+        try:
+            with sph.entry("csvc"):
+                res.append("pass")
+        except stpu.BlockException:
+            res.append("block")
+    assert res == ["pass", "pass", "block", "block", "block"]
+
+
+def test_too_many_request_param_passes_through(clk):
+    """Param-token TOO_MANY_REQUEST degrades (pass-through), it does not
+    raise ParamFlowException (ParamFlowChecker.passClusterCheck)."""
+    sph = make(clk)
+    svc = FakeParamTokenService()
+    sph.set_token_service(svc)
+    sph.load_param_flow_rules([stpu.ParamFlowRule(
+        resource="psvc", param_idx=0, count=100, cluster_mode=True,
+        cluster_flow_id=77)])
+    svc.script = [_Result(-2)] * 3
+    for _ in range(3):
+        with sph.entry("psvc", args=("alice",)):
+            pass
+    assert sph.node_totals("psvc")["pass"] == 3
+
+
+class PerFlowTokenService:
+    """Scripts verdicts per flow_id (mixed grant/failure scenarios)."""
+
+    def __init__(self, by_flow):
+        self.by_flow = dict(by_flow)
+        self.calls = []
+
+    def request_token(self, flow_id, count, prioritized=False):
+        self.calls.append((flow_id, count, prioritized))
+        return _Result(self.by_flow.get(flow_id, 0))
+
+
+def test_mixed_grant_failure_enforces_failed_rule_locally(clk):
+    """When one cluster rule's token is granted and a sibling's request
+    FAILs with fallbackToLocalWhenFail, the failed rule must be enforced
+    LOCALLY (per-rule fallbackToLocalOrPass) — not pass through."""
+    sph = make(clk)
+    svc = PerFlowTokenService({42: 0, 43: -1})   # 42 grants, 43 fails
+    sph.set_token_service(svc)
+    sph.load_flow_rules([
+        cluster_rule(count=0.0, cluster_flow_id=42),   # granted remotely
+        cluster_rule(count=2.0, cluster_flow_id=43),   # fails → local
+    ])
+    res = []
+    for _ in range(5):
+        try:
+            with sph.entry("csvc"):
+                res.append("pass")
+        except stpu.BlockException:
+            res.append("block")
+    # flow 43's count=2 is enforced locally; flow 42's count=0 must NOT be
+    # (its token was granted remotely)
+    assert res == ["pass", "pass", "block", "block", "block"]
+
+
+def test_mixed_grant_failure_batch_tier(clk):
+    """Same per-rule fallback semantics through entry_batch."""
+    sph = make(clk)
+    svc = PerFlowTokenService({42: 0, 43: -1})
+    sph.set_token_service(svc)
+    sph.load_flow_rules([
+        cluster_rule(count=0.0, cluster_flow_id=42),
+        cluster_rule(count=2.0, cluster_flow_id=43),
+    ])
+    v = sph.entry_batch(["csvc"] * 5)
+    assert list(map(bool, v.allow)) == [True, True, False, False, False]
+
+
+def test_batch_cluster_param_block_reason_and_single_count(clk):
+    """A cluster param-token denial in the batch tier must (a) surface
+    reason=PARAM_FLOW (entry() raises ParamFlowException for the same
+    event), and (b) count the block exactly ONCE on the node."""
+    sph = make(clk)
+    svc = FakeParamTokenService()
+    svc.script = [_Result(1)]                    # BLOCKED
+    sph.set_token_service(svc)
+    sph.load_param_flow_rules([stpu.ParamFlowRule(
+        resource="psvc", param_idx=0, count=100, cluster_mode=True,
+        cluster_flow_id=77)])
+    v = sph.entry_batch(["psvc"], args_list=[("alice",)])
+    assert not bool(v.allow[0])
+    assert int(v.reason[0]) == int(stpu.BlockReason.PARAM_FLOW)
+    t = sph.node_totals("psvc")
+    assert t["block"] == 1 and t["pass"] == 0

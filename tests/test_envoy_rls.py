@@ -56,6 +56,32 @@ def test_unmatched_descriptor_passes(service):
     assert overall == CODE_OK
 
 
+def test_non_ok_statuses_are_over_limit(service, monkeypatch):
+    """Reference ``SentinelEnvoyRlsServiceImpl``: NO_RULE_EXISTS keeps the
+    "no rule ⇒ OK" contract, but every OTHER non-OK status — SHOULD_WAIT
+    (RLS cannot honor a wait), FAIL, BAD_REQUEST, TOO_MANY — is OVER_LIMIT;
+    engine errors must not fail open."""
+    from sentinel_tpu.parallel import cluster as cl
+
+    cases = [
+        (cl.STATUS_SHOULD_WAIT, CODE_OVER_LIMIT),
+        (-1, CODE_OVER_LIMIT),                       # FAIL
+        (cl.STATUS_TOO_MANY_REQUEST, CODE_OVER_LIMIT),
+        (cl.STATUS_BLOCKED, CODE_OVER_LIMIT),
+        (cl.STATUS_NO_RULE_EXISTS, CODE_OK),
+        (cl.STATUS_OK, CODE_OK),
+    ]
+    for status, expected in cases:
+        monkeypatch.setattr(
+            service.engine, "request_tokens",
+            lambda fids, counts, now_ms=None, _s=status:
+                [(_s, 25, 0)] * len(fids))
+        overall, st = service.should_rate_limit(
+            "apis", [[("generic_key", "checkout")]])
+        assert st[0].code == expected, (status, expected)
+        assert overall == expected
+
+
 def test_multi_entry_descriptor_order_matters(service):
     overall, _ = service.should_rate_limit(
         "apis", [[("header_match", "mobile"), ("dest", "payments")]])
