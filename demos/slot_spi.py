@@ -1,0 +1,85 @@
+"""Custom processor slots — the ``sentinel-demo-slot-spi`` /
+``sentinel-demo-slotchain-spi`` analog.
+
+The reference inserts user slots into the chain via SPI
+(``SlotChainProvider.java:39``, ``DefaultSlotChainBuilder.java:39``); here
+user slots register against a live engine without editing it
+(``Sentinel.register_slot``), in two tiers:
+
+* a :class:`HostGate` — plain Python, vetoes before dispatch;
+* a :class:`DeviceSlot` — a jittable gate compiled INTO the fused decide.
+
+Run: ``python demos/slot_spi.py``
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.engine.slots import DeviceSlot, HostGate
+
+
+class PaymentGuard(HostGate):
+    """Host tier: veto any entry whose first arg is a flagged account —
+    the kind of bespoke business gate the reference demo's custom slot
+    implements."""
+
+    name = "payment-guard"
+
+    def __init__(self, denylist):
+        self.denylist = set(denylist)
+
+    def check(self, resource, origin, acquire, args):
+        return not (args and args[0] in self.denylist)
+
+
+class EvenSecondThrottle(DeviceSlot):
+    """Device tier: a (deliberately whimsical) jittable gate that only
+    admits traffic on even second-window indices, with a per-call counter
+    in its own state slice — demonstrates state + pure-jax check."""
+
+    name = "even-second-throttle"
+
+    def init_state(self, spec):
+        return jnp.zeros((), jnp.int32)          # total events seen
+
+    def check(self, state, view):
+        ok = (view.now_idx_s % 2) == 0
+        seen = state + jnp.sum(view.live.astype(jnp.int32))
+        return seen, jnp.full(view.rows.shape, ok)
+
+
+def main():
+    clk = ManualClock(start_ms=1_700_000_000_000)
+    sph = stpu.Sentinel(stpu.load_config(
+        max_resources=64, max_flow_rules=16, max_degrade_rules=16,
+        max_authority_rules=16), clock=clk)
+
+    sph.register_slot(PaymentGuard(denylist={"acct-666"}))
+    print("== host gate ==")
+    for acct in ("acct-1", "acct-666", "acct-2"):
+        try:
+            with sph.entry("pay", args=(acct,)):
+                print(f"  {acct}: admitted")
+        except stpu.CustomSlotException as e:
+            print(f"  {acct}: DENIED by slot {e.slot_name!r}")
+    t = sph.node_totals("pay")
+    print(f"  pay totals: pass={t['pass']} block={t['block']}")
+
+    sph.register_slot(EvenSecondThrottle())
+    print("== device slot (compiled into the fused step) ==")
+    for step in range(4):
+        try:
+            with sph.entry("svc"):
+                print(f"  t={step * 500}ms: admitted")
+        except stpu.CustomSlotException as e:
+            print(f"  t={step * 500}ms: DENIED by slot {e.slot_name!r}")
+        clk.advance_ms(500)
+
+
+if __name__ == "__main__":
+    main()

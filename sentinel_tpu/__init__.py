@@ -17,17 +17,25 @@ Quick start (reference README parity)::
 
 from sentinel_tpu.core.clock import Clock, ManualClock, SystemClock, set_global_clock
 from sentinel_tpu.core.config import SentinelConfig, load_config
-from sentinel_tpu.core.context import ContextScope, enter_context, exit_context
+from sentinel_tpu.core.context import (
+    ContextScope,
+    enter_context,
+    exit_context,
+    restore_context,
+    snapshot_context,
+)
 from sentinel_tpu.core.errors import (
     AuthorityException,
     BlockException,
     BlockReason,
+    CustomSlotException,
     DegradeException,
     ErrorEntryFreeError,
     FlowException,
     ParamFlowException,
     SystemBlockException,
 )
+from sentinel_tpu.engine.slots import DeviceSlot, DeviceSlotView, HostGate
 from sentinel_tpu.rules.authority import STRATEGY_BLACK, STRATEGY_WHITE, AuthorityRule
 from sentinel_tpu.rules.degrade import (
     GRADE_EXCEPTION_COUNT,
@@ -63,7 +71,8 @@ __all__ = [
     "ParamFlowRule", "ParamFlowItem", "PARAM_BEHAVIOR_RATE_LIMITER",
     "BlockException", "FlowException", "DegradeException",
     "SystemBlockException", "AuthorityException", "ParamFlowException",
-    "BlockReason", "ErrorEntryFreeError",
+    "CustomSlotException", "BlockReason", "ErrorEntryFreeError",
+    "HostGate", "DeviceSlot", "DeviceSlotView",
     "GRADE_QPS", "GRADE_THREAD", "GRADE_RT", "GRADE_EXCEPTION_RATIO",
     "GRADE_EXCEPTION_COUNT",
     "BEHAVIOR_DEFAULT", "BEHAVIOR_WARM_UP", "BEHAVIOR_RATE_LIMITER",
@@ -72,5 +81,6 @@ __all__ = [
     "STRATEGY_WHITE", "STRATEGY_BLACK",
     "Clock", "ManualClock", "SystemClock", "set_global_clock",
     "ContextScope", "enter_context", "exit_context",
+    "snapshot_context", "restore_context",
     "SentinelConfig", "load_config",
 ]

@@ -1,16 +1,24 @@
 """Async entry helper (reference ``sentinel-reactor-adapter``
-``SentinelReactorTransformer`` — wrap an async operation in an entry whose
-pacing wait is awaited, not slept).
+``SentinelReactorTransformer`` + ``CORE/AsyncEntry.java`` — wrap an async
+operation in an entry whose pacing wait is awaited, not slept, with the
+call context snapshotted for asynchronous continuation).
 
 ``async with async_entry(sph, "resource"):`` is the asyncio analog of
 ``try (Entry e = SphU.entry(...))``; on deny the BlockException raises out
-of ``__aenter__`` before the body runs.
+of ``__aenter__`` before the body runs. The context (name + origin) is
+captured on ``.context`` at entry time — the ``AsyncEntry`` context
+snapshot — so completion work scheduled onto another task/thread can
+``restore_context(ae.context)`` before making nested entries. (Plain
+same-task flows don't need it: context storage is a ContextVar, private to
+each asyncio task.)
 """
 
 from __future__ import annotations
 
 import asyncio
 from typing import Optional, Sequence
+
+from sentinel_tpu.core.context import snapshot_context
 
 
 class async_entry:
@@ -24,8 +32,12 @@ class async_entry:
                         resource_type=resource_type)
         self._resource = resource
         self.entry = None
+        self.context = None       # AsyncEntry context snapshot (set on enter)
 
     async def __aenter__(self):
+        # AsyncEntry.java: snapshot the caller's context so completion code
+        # running elsewhere can restore it
+        self.context = snapshot_context()
         # the decide step itself is fast + non-blocking; only the pacing
         # wait must move onto the event loop
         self.entry = self._sentinel.entry(self._resource, sleep=False,

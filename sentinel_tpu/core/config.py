@@ -68,6 +68,16 @@ class SentinelConfig:
     # Host batching
     batch_size: int = 1024
 
+    # Host-side fast path (SURVEY §7 hard-part 1: the local analog of
+    # fallbackToLocalOrPass). Rule-free resources decide on host with
+    # batched device stat recording; resources with one simple QPS rule
+    # serve from a host-held token lease pre-charged through the device
+    # pipeline. Disabled automatically while system rules are loaded.
+    host_fast_path: bool = True
+    fast_path_flush_events: int = 1024   # buffered stat events per flush
+    fast_path_flush_ms: int = 20         # max staleness of buffered stats
+    fast_path_lease_fraction: float = 0.5  # lease chunk = count × fraction
+
     # Warm-up cold factor (SentinelConfig default 3)
     cold_factor: int = 3
 

@@ -1,16 +1,24 @@
-"""Per-thread call context (ContextUtil analog).
+"""Per-task call context (ContextUtil analog).
 
 Reference: ``sentinel-core/.../context/ContextUtil.java`` — a ThreadLocal
 holding the context name (entrance) and origin (caller app); adapters call
 ``ContextUtil.enter(contextName, origin)`` before ``SphU.entry``. The context
 name keys CHAIN-strategy flow rules and the entrance-node aggregation; the
 origin keys authority checks and origin-specific flow rules.
+
+Storage is a ``contextvars.ContextVar``, not ``threading.local``: asyncio
+interleaves many logical calls on one thread, and a thread-local context set
+by task A would leak into task B at the first ``await`` — exactly the hazard
+the reference solves for its async paths by snapshotting the context into
+``AsyncEntry`` (``CORE/AsyncEntry.java``). ContextVar gives every asyncio
+task its own value chain automatically (tasks copy the enclosing context at
+creation), and plain threads still see independent values.
 """
 
 from __future__ import annotations
 
+import contextvars
 import dataclasses
-import threading
 from typing import Optional
 
 DEFAULT_CONTEXT_NAME = "sentinel_default_context"
@@ -22,41 +30,60 @@ class Context:
     origin: str = ""
 
 
-_tls = threading.local()
+_ctx_var: contextvars.ContextVar[Optional[Context]] = contextvars.ContextVar(
+    "sentinel_tpu_context", default=None)
+
+_DEFAULT = Context()
 
 
 def current_context() -> Context:
-    ctx = getattr(_tls, "ctx", None)
-    if ctx is None:
-        ctx = Context()
-        _tls.ctx = ctx
-    return ctx
+    ctx = _ctx_var.get()
+    return ctx if ctx is not None else _DEFAULT
 
 
 def enter_context(name: str, origin: str = "") -> Context:
     """Reference ``ContextUtil.enter`` (names beyond the registry capacity
     degrade to the shared default context at lookup time, not here)."""
     ctx = Context(name=name or DEFAULT_CONTEXT_NAME, origin=origin or "")
-    _tls.ctx = ctx
+    _ctx_var.set(ctx)
     return ctx
 
 
 def exit_context() -> None:
-    _tls.ctx = None
+    _ctx_var.set(None)
+
+
+def snapshot_context() -> Context:
+    """Copy of the current context for asynchronous continuation — the
+    ``AsyncEntry.java`` context snapshot. Restore with
+    :func:`restore_context` from whatever task/thread completes the work."""
+    cur = current_context()
+    return Context(name=cur.name, origin=cur.origin)
+
+
+def restore_context(ctx: Context) -> None:
+    _ctx_var.set(Context(name=ctx.name, origin=ctx.origin))
 
 
 class ContextScope:
-    """``with ContextScope("entrance", origin="app-a"): ...``"""
+    """``with ContextScope("entrance", origin="app-a"): ...``
+
+    Token-based restore: safe under asyncio interleaving (each task's
+    ContextVar chain is private, and nesting unwinds correctly)."""
 
     def __init__(self, name: str, origin: str = ""):
         self._name = name
         self._origin = origin
-        self._prev: Optional[Context] = None
+        self._token: Optional[contextvars.Token] = None
 
     def __enter__(self) -> Context:
-        self._prev = getattr(_tls, "ctx", None)
-        return enter_context(self._name, self._origin)
+        ctx = Context(name=self._name or DEFAULT_CONTEXT_NAME,
+                      origin=self._origin or "")
+        self._token = _ctx_var.set(ctx)
+        return ctx
 
     def __exit__(self, *exc) -> None:
-        _tls.ctx = self._prev
+        if self._token is not None:
+            _ctx_var.reset(self._token)
+            self._token = None
         return None

@@ -21,6 +21,13 @@ class BlockReason:
     SYSTEM = 3
     AUTHORITY = 4
     PARAM_FLOW = 5
+    # codes >= CUSTOM_BASE are user ProcessorSlots (reference: custom slots
+    # inserted via SlotChainBuilder SPI throw their own BlockException
+    # subclasses). Two disjoint sub-spaces of the int8 range:
+    # CUSTOM_BASE + i  = registered DeviceSlot i (emitted by the pipeline)
+    # CUSTOM_GATE_BASE + i = registered HostGate i (emitted host-side)
+    CUSTOM_BASE = 16
+    CUSTOM_GATE_BASE = 96
 
     NAMES = {
         NONE: "none",
@@ -74,6 +81,19 @@ class ParamFlowException(BlockException):
     reason_code = BlockReason.PARAM_FLOW
 
 
+class CustomSlotException(BlockException):
+    """A user ProcessorSlot denied the entry. ``slot_name`` names the
+    registered slot (the analog of a custom BlockException subclass from a
+    slot-chain-SPI slot)."""
+
+    reason_code = BlockReason.CUSTOM_BASE
+
+    def __init__(self, resource: str, rule: Optional[Any] = None,
+                 origin: str = "", wait_ms: int = 0, slot_name: str = ""):
+        self.slot_name = slot_name
+        super().__init__(resource, rule=rule, origin=origin, wait_ms=wait_ms)
+
+
 _BY_CODE = {
     BlockReason.FLOW: FlowException,
     BlockReason.DEGRADE: DegradeException,
@@ -85,11 +105,17 @@ _BY_CODE = {
 
 def exception_name_for(code: int) -> str:
     """Exception class name for a BlockReason code (block-log lines)."""
+    if int(code) >= BlockReason.CUSTOM_BASE:
+        return CustomSlotException.__name__
     return _BY_CODE.get(int(code), BlockException).__name__
 
 
 def block_exception_for(code: int, resource: str, origin: str = "",
-                        wait_ms: int = 0, rule: Optional[Any] = None) -> BlockException:
+                        wait_ms: int = 0, rule: Optional[Any] = None,
+                        slot_name: str = "") -> BlockException:
+    if int(code) >= BlockReason.CUSTOM_BASE:
+        return CustomSlotException(resource, rule=rule, origin=origin,
+                                   wait_ms=wait_ms, slot_name=slot_name)
     cls = _BY_CODE.get(int(code), BlockException)
     return cls(resource, rule=rule, origin=origin, wait_ms=wait_ms)
 

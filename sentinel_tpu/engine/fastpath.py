@@ -1,0 +1,216 @@
+"""Host-side fast path for the single-entry tier.
+
+SURVEY §7 hard-part 1: the reference's local decision is ~ns in-process
+(``FlowRuleChecker`` reading a ``LeapArray`` on the caller's thread); on a
+device-attached engine every ``entry()`` pays a host→device round trip even
+for resources with no rules. This module decides ON HOST for the two cases
+that dominate real traffic, while keeping every statistic on device:
+
+* **FREE** resources — named by NO rule of any kind: admit immediately and
+  buffer the pass; buffered events flush through the normal jitted decide
+  in batches (rule-free events can't block, so the flush is pure
+  ``StatisticSlot`` recording — pass counts, thread gauge, ENTRY node,
+  origin/chain rows all land exactly as the slow path would record them).
+
+* **LEASED** resources — exactly one simple QPS flow rule
+  (DefaultController grade, ``limitApp=default``, DIRECT strategy,
+  non-cluster): the host pre-charges a token chunk by pushing ONE decide
+  with ``acquire=C`` through the full device pipeline, then hands tokens
+  out locally until the chunk is exhausted or the window bucket rotates.
+  Because every leased admission was already counted at pre-charge,
+  over-admission beyond the configured count is STRUCTURALLY impossible;
+  unused chunk remainder at bucket rotation is bounded under-admission
+  (the analog of the reference's tolerated check-then-act skew, in the
+  conservative direction). When the chunk is denied the row is marked hot
+  for the bucket and every event takes the exact device path.
+
+Exclusions (events fall through to the device path): prioritized entries,
+entries with args on param-ruled resources, origin/non-default-context
+entries on LEASED rows (their per-origin stats need per-event recording),
+and everything while system rules are loaded (SystemSlot gates inbound
+traffic globally; host-admitting would bypass it).
+
+Thread gauge: leased admissions are excluded from the concurrency gauge on
+both sides (entry pre-charge and exit both carry ``count_thread=False``),
+so the gauge stays consistent; FREE events are thread-counted exactly.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+FREE = 0
+LEASED = 1
+INELIGIBLE = 2
+
+# lease_state verdicts
+ADMIT = 0      # served from the live lease
+RENEW = 1      # no live lease (or exhausted, matching) → try a pre-charge
+DEVICE = 2     # take the exact device path for this event
+
+
+class _Lease:
+    __slots__ = ("bucket_idx", "remaining", "is_in")
+
+    def __init__(self, bucket_idx: int, remaining: int, is_in: bool):
+        self.bucket_idx = bucket_idx
+        self.remaining = remaining
+        self.is_in = is_in
+
+
+class HostFastPath:
+    """Classification tables + stat buffers + lease book-keeping.
+
+    Thread-safe; the runtime owns WHEN to flush (size/age triggers checked
+    by :meth:`due`, plus forced flushes before introspection reads).
+    """
+
+    def __init__(self, *, flush_events: int, flush_ms: int,
+                 lease_fraction: float, win_ms: int):
+        self.flush_events = flush_events
+        self.flush_ms = flush_ms
+        self.lease_fraction = lease_fraction
+        self.win_ms = max(1, win_ms)
+        self.sys_active = False
+        self._ineligible: Set[int] = set()
+        self._lease_count: Dict[int, float] = {}
+        self._leases: Dict[int, _Lease] = {}
+        self._hot_bucket: Dict[int, int] = {}
+        self._renewing: Set[int] = set()   # rows with a pre-charge in flight
+        self._pass_buf: List[tuple] = []
+        self._exit_buf: List[tuple] = []
+        self._buf_bucket = -1
+        self._last_flush_ms = 0
+        self._lock = threading.Lock()
+        # observability: how many device dispatches the fast path avoided
+        self.fast_admits = 0
+        self.lease_renewals = 0
+
+    # ---------------------------------------------------------------- tables
+    def set_tables(self, ineligible: Set[int], lease_counts: Dict[int, float],
+                   sys_active: bool) -> None:
+        """Swap in a fresh classification after a rule load. Live leases
+        are dropped (their pre-charge stays recorded on device — bounded
+        under-admission, never over)."""
+        with self._lock:
+            self._ineligible = ineligible
+            self._lease_count = lease_counts
+            self.sys_active = sys_active
+            self._leases.clear()
+            self._hot_bucket.clear()
+
+    def classify(self, row: int) -> int:
+        if row in self._ineligible:
+            return INELIGIBLE
+        if row in self._lease_count:
+            return LEASED
+        return FREE
+
+    # ---------------------------------------------------------------- leases
+    def bucket_of(self, now_ms: int) -> int:
+        return now_ms // self.win_ms
+
+    def lease_state(self, row: int, acquire: int, is_in: bool,
+                    now_ms: int) -> int:
+        """→ ADMIT (token taken from the live lease), RENEW (no live lease
+        this bucket, or a matching one is exhausted — a pre-charge may
+        help), or DEVICE (live lease with a different entry type: renewing
+        would burn budget on a second chunk, so the event takes the exact
+        device path). Never decides a denial."""
+        b = self.bucket_of(now_ms)
+        with self._lock:
+            lease = self._leases.get(row)
+            if lease is not None and lease.bucket_idx == b:
+                if lease.is_in != is_in:
+                    return DEVICE
+                if lease.remaining >= acquire:
+                    lease.remaining -= acquire
+                    self.fast_admits += 1
+                    return ADMIT
+            return RENEW
+
+    def begin_renewal(self, row: int) -> bool:
+        """Claim the single renewal slot for ``row``; False = another
+        thread's pre-charge is in flight (caller takes the device path
+        instead of double-charging the window)."""
+        with self._lock:
+            if row in self._renewing:
+                return False
+            self._renewing.add(row)
+            return True
+
+    def end_renewal(self, row: int) -> None:
+        with self._lock:
+            self._renewing.discard(row)
+
+    def is_hot(self, row: int, now_ms: int) -> bool:
+        """True while the current bucket already had a chunk denied —
+        every event goes through the exact device path until rotation."""
+        return self._hot_bucket.get(row) == self.bucket_of(now_ms)
+
+    def lease_chunk(self, row: int, acquire: int) -> int:
+        """Chunk size for a renewal: a fraction of the per-window budget,
+        at least the triggering event's acquire."""
+        count = self._lease_count.get(row, 0.0)
+        per_window = count * self.win_ms / 1000.0
+        return max(int(acquire), int(per_window * self.lease_fraction))
+
+    def install_lease(self, row: int, chunk: int, used: int, is_in: bool,
+                      now_ms: int) -> None:
+        """Credit a granted pre-charge. MERGES into a live matching lease
+        (every granted chunk was already recorded on device — dropping one
+        would waste budget, never over-admit)."""
+        with self._lock:
+            b = self.bucket_of(now_ms)
+            lease = self._leases.get(row)
+            if (lease is not None and lease.bucket_idx == b
+                    and lease.is_in == is_in):
+                lease.remaining += chunk - used
+            else:
+                self._leases[row] = _Lease(b, chunk - used, is_in)
+            self.lease_renewals += 1
+            self.fast_admits += 1
+
+    def mark_hot(self, row: int, now_ms: int) -> None:
+        with self._lock:
+            self._hot_bucket[row] = self.bucket_of(now_ms)
+            self._leases.pop(row, None)
+
+    # ---------------------------------------------------------------- buffers
+    def buffer_pass(self, row: int, o_row: int, c_row: int, acquire: int,
+                    is_in: bool, now_ms: int) -> None:
+        with self._lock:
+            if not self._pass_buf and not self._exit_buf:
+                self._buf_bucket = self.bucket_of(now_ms)
+            self._pass_buf.append((row, o_row, c_row, acquire, is_in, now_ms))
+            self.fast_admits += 1
+
+    def buffer_exit(self, row: int, o_row: int, c_row: int, acquire: int,
+                    rt_ms: int, error: bool, is_in: bool,
+                    count_thread: bool, now_ms: int) -> None:
+        with self._lock:
+            if not self._pass_buf and not self._exit_buf:
+                self._buf_bucket = self.bucket_of(now_ms)
+            self._exit_buf.append((row, o_row, c_row, acquire, rt_ms, error,
+                                   is_in, count_thread, now_ms))
+
+    def due(self, now_ms: int) -> bool:
+        n = len(self._pass_buf) + len(self._exit_buf)
+        if n == 0:
+            return False
+        if n >= self.flush_events:
+            return True
+        # bucket rotation: flush BEFORE buffering into a new window slice so
+        # each flush group shares one time stamp (exact window attribution)
+        if self.bucket_of(now_ms) != self._buf_bucket:
+            return True
+        return now_ms - self._last_flush_ms >= self.flush_ms
+
+    def drain(self, now_ms: int):
+        """→ (passes, exits) and reset (caller dispatches them to device)."""
+        with self._lock:
+            p, self._pass_buf = self._pass_buf, []
+            x, self._exit_buf = self._exit_buf, []
+            self._last_flush_ms = now_ms
+            return p, x

@@ -71,6 +71,10 @@ class SentinelState(NamedTuple):
     flow_dyn: flow_mod.FlowDynState
     breakers: deg_mod.BreakerState
     param_dyn: pf_mod.ParamDynState
+    # per-registered-DeviceSlot pytree state slices (engine/slots.py),
+    # positionally aligned with the custom_slots tuple the steps were
+    # compiled with; () when no custom slots are registered
+    custom: Tuple = ()
 
 
 class RuleSet(NamedTuple):
@@ -106,6 +110,16 @@ class EntryBatch(NamedTuple):
     # fallbackToLocalWhenFail, so exactly that rule checks LOCALLY
     # (per-rule FlowRuleChecker.fallbackToLocalOrPass); None = no fallback
     cluster_fallback: Optional[jnp.ndarray] = None   # int32[B]
+    # False = don't count this event in the thread (concurrency) gauges:
+    # host-leased admissions are never thread-counted (the lease pre-charge
+    # batch and each leased exit both carry False, keeping the gauge
+    # consistent). None = all True.
+    count_thread: Optional[jnp.ndarray] = None       # bool[B]
+    # False = a DENIAL of this event records no BLOCK stat: lease renewal
+    # probes are speculative acquire=C requests — a denied probe isn't C
+    # denied callers (the triggering caller re-decides per-event and
+    # records its own block). None = all True.
+    record_block: Optional[jnp.ndarray] = None       # bool[B]
 
 
 class ExitBatch(NamedTuple):
@@ -119,6 +133,7 @@ class ExitBatch(NamedTuple):
     valid: jnp.ndarray          # bool[B]
     param_rules: Optional[jnp.ndarray] = None   # int32[B, PV]
     param_keys: Optional[jnp.ndarray] = None    # int32[B, PV]
+    count_thread: Optional[jnp.ndarray] = None  # bool[B] (see EntryBatch)
 
 
 class Verdicts(NamedTuple):
@@ -165,6 +180,7 @@ def decide_entries(
     times: jnp.ndarray,          # int32[4]: idx_s, idx_m, rel_ms, in_win_ms
     sys_scalars: jnp.ndarray,    # float32[2]: load1, cpu_usage
     enable_occupy: bool = True,  # STATIC (see flow_check)
+    custom_slots: Tuple = (),    # STATIC: registered DeviceSlots (slots.py)
 ) -> Tuple[SentinelState, Verdicts]:
     """One device step: decide a batch, then record post-decision statistics.
 
@@ -234,8 +250,31 @@ def decide_entries(
         live3 & ~occupied, rel_now_ms)
     deg_ok = deg_ok | occupied
 
-    allow = live & auth_ok & sys_ok & param_ok & flow_ok & deg_ok
+    # ---- user DeviceSlots (slot-chain SPI analog; STATIC: compiles to
+    # nothing when none are registered) ----
+    custom_states = state.custom
+    if custom_slots:
+        from sentinel_tpu.engine.slots import DeviceSlotView, run_device_slots
+        from sentinel_tpu.stats.window import window_sum_rows
+        safe_rows = jnp.minimum(batch.rows, R - 1)
+        pass_counts = window_sum_rows(
+            spec.second, state.second, safe_rows, ev.PASS,
+            now_idx_s).astype(jnp.float32)
+        cview = DeviceSlotView(
+            rows=batch.rows, origin_ids=batch.origin_ids,
+            acquire=batch.acquire, is_in=batch.is_in,
+            prioritized=batch.prioritized, live=live3 & deg_ok,
+            now_idx_s=now_idx_s, rel_now_ms=rel_now_ms,
+            pass_counts=pass_counts)
+        custom_states, custom_ok, custom_reason = run_device_slots(
+            custom_slots, state.custom, cview)
+    else:
+        custom_ok = jnp.ones_like(live)
+        custom_reason = jnp.zeros(batch.rows.shape, jnp.int8)
+
+    allow = live & auth_ok & sys_ok & param_ok & flow_ok & deg_ok & custom_ok
     reason = jnp.zeros(batch.rows.shape, jnp.int8)
+    reason = jnp.where(~custom_ok, custom_reason, reason)
     reason = jnp.where(~deg_ok, jnp.int8(BlockReason.DEGRADE), reason)
     reason = jnp.where(~flow_ok, jnp.int8(BlockReason.FLOW), reason)
     reason = jnp.where(~param_ok, jnp.int8(BlockReason.PARAM_FLOW), reason)
@@ -265,7 +304,9 @@ def decide_entries(
     pass_now2 = jnp.concatenate([pass_now, pass_now])
     acq2 = jnp.concatenate([batch.acquire, batch.acquire])
     pass_amt = jnp.where(pass_now2, acq2, 0)
-    block_amt = jnp.where(jnp.concatenate([blocked, blocked]), acq2, 0)
+    blocked_rec = (blocked & batch.record_block
+                   if batch.record_block is not None else blocked)
+    block_amt = jnp.where(jnp.concatenate([blocked_rec, blocked_rec]), acq2, 0)
 
     second = refresh_rows(spec.second, state.second, main_targets, now_idx_s)
     second = add_rows(spec.second, second, main_targets, ev.PASS, pass_amt, now_idx_s)
@@ -289,7 +330,10 @@ def decide_entries(
                               ev.OCCUPIED_PASS, occ_amt, now_idx_m)
         minute = add_rows(spec.minute, minute, main_targets, ev.BLOCK, block_amt, now_idx_m)
 
-    thr_amt = jnp.where(pass2, 1, 0)  # +1 per entry (reference curThreadNum)
+    ct = (jnp.concatenate([batch.count_thread, batch.count_thread])
+          if batch.count_thread is not None else None)
+    thr_amt = jnp.where(pass2 if ct is None else pass2 & ct, 1, 0)
+    # +1 per entry (reference curThreadNum); leased admissions opt out
     threads = state.threads.at[jnp.where(pass2, main_targets, pad_r)].add(
         thr_amt, mode="drop")
     alt_threads = state.alt_threads.at[jnp.where(pass2, alt_targets, pad_a)].add(
@@ -303,7 +347,8 @@ def decide_entries(
     new_state = SentinelState(
         second=second, minute=minute, alt_second=alt_second,
         threads=threads, alt_threads=alt_threads,
-        flow_dyn=flow_dyn, breakers=breakers, param_dyn=param_dyn)
+        flow_dyn=flow_dyn, breakers=breakers, param_dyn=param_dyn,
+        custom=custom_states)
     return new_state, Verdicts(allow=allow, reason=reason, wait_ms=wait_ms)
 
 
@@ -358,7 +403,9 @@ def record_exits(
         minute = add_rows(spec.minute, minute, main_targets, ev.EXCEPTION,
                           err2, now_idx_m)
 
-    dec = jnp.where(valid2, 1, 0)
+    ct2 = (jnp.concatenate([batch.count_thread, batch.count_thread])
+           if batch.count_thread is not None else None)
+    dec = jnp.where(valid2 if ct2 is None else valid2 & ct2, 1, 0)
     threads = state.threads.at[main_targets].add(-dec, mode="drop")
     threads = jnp.maximum(threads, 0)
     alt_threads = state.alt_threads.at[alt_targets].add(-dec, mode="drop")
@@ -377,7 +424,8 @@ def record_exits(
     return SentinelState(
         second=second, minute=minute, alt_second=alt_second,
         threads=threads, alt_threads=alt_threads,
-        flow_dyn=state.flow_dyn, breakers=breakers, param_dyn=param_dyn)
+        flow_dyn=state.flow_dyn, breakers=breakers, param_dyn=param_dyn,
+        custom=state.custom)
 
 
 def record_blocks(

@@ -1,0 +1,120 @@
+"""Pluggable processor slots — the SlotChainBuilder / ProcessorSlot SPI
+re-designed for a fused, jitted pipeline.
+
+Reference: custom slots plug into the chain via SPI
+(``slotchain/SlotChainProvider.java:39``, ``spi/SpiLoader.java:73-179``,
+``DefaultSlotChainBuilder.java:39``; demos ``sentinel-demo-slot-spi`` and
+``sentinel-demo-slotchain-spi``). A Java slot is an object in a linked
+chain; here the chain is ONE compiled function, so extensibility comes in
+two tiers:
+
+* :class:`HostGate` — a host-side pre-decide gate. Runs before the device
+  dispatch on both the single-entry and batch tiers; can deny by returning
+  False (or raising a :class:`~sentinel_tpu.core.errors.BlockException`).
+  Denials are recorded on device like any other block (StatisticSlot
+  parity) and surface as :class:`CustomSlotException` with the gate's
+  name. This is the "annotate/block without editing the engine" tier — no
+  jax knowledge needed.
+
+* :class:`DeviceSlot` — a jittable gate COMPILED INTO the fused decide
+  step at registration time. ``check(state, view)`` must be a pure
+  jax-traceable function over a :class:`DeviceSlotView`; it returns the
+  slot's next state and a per-event ok mask. The slot owns a pytree state
+  slice carried inside the engine state (donated across steps like every
+  other slot's). This is the full-power tier: a user gate with the same
+  standing as FlowSlot, at device speed, still without editing
+  ``engine/pipeline.py``.
+
+Ordering: device slots run after the built-in cascade (authority → system
+→ param → flow → degrade), in registration order, each seeing only events
+still live — the same only-live-events contract the built-in slots have.
+Host gates run before everything (they can veto the device dispatch).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+
+class DeviceSlotView(NamedTuple):
+    """Read-only per-event inputs handed to a :class:`DeviceSlot`."""
+
+    rows: jnp.ndarray          # int32[B] main resource row (>= R padding)
+    origin_ids: jnp.ndarray    # int32[B] (0 = none)
+    acquire: jnp.ndarray       # int32[B]
+    is_in: jnp.ndarray         # bool[B]
+    prioritized: jnp.ndarray   # bool[B]
+    live: jnp.ndarray          # bool[B] — still admitted by earlier slots
+    now_idx_s: jnp.ndarray     # int32 scalar, second-window index
+    rel_now_ms: jnp.ndarray    # int32 scalar, ms since process epoch
+    pass_counts: jnp.ndarray   # float32[B] — rolling PASS of each row's
+    # second window (the most common gate input, pre-gathered once)
+
+
+class DeviceSlot:
+    """Base class for jittable slots. Subclass and override."""
+
+    #: shown in block logs / CustomSlotException.slot_name
+    name: str = "device-slot"
+
+    def init_state(self, spec) -> Any:
+        """Initial pytree state slice (called at registration and on
+        engine-state resets). ``spec`` is the EngineSpec. Return () for a
+        stateless gate."""
+        return ()
+
+    def check(self, state: Any, view: DeviceSlotView):
+        """Pure jax function: → ``(next_state, ok bool[B])``. Events with
+        ``view.live == False`` are already denied/padded — their ok value
+        is ignored."""
+        raise NotImplementedError
+
+
+class HostGate:
+    """Base class for host-side pre-decide gates. Subclass and override
+    :meth:`check` (and optionally :meth:`check_batch` for the batch tier —
+    the default loops ``check``)."""
+
+    name: str = "host-gate"
+
+    def check(self, resource: str, origin: str, acquire: int,
+              args: Sequence) -> bool:
+        """→ False to deny (or raise a BlockException subclass)."""
+        return True
+
+    def check_batch(self, resources: Sequence[str],
+                    origins: Optional[Sequence[str]],
+                    acquire, args_list) -> Sequence[bool]:
+        out = []
+        for i, r in enumerate(resources):
+            org = origins[i] if origins is not None and origins[i] else ""
+            args = args_list[i] if args_list is not None else ()
+            out.append(bool(self.check(r, org, int(acquire[i]), args)))
+        return out
+
+
+def run_device_slots(custom_slots: Tuple[DeviceSlot, ...], custom_states,
+                     view: DeviceSlotView):
+    """Cascade the registered device slots (called from the fused decide;
+    static over ``custom_slots`` so an empty registry compiles to
+    nothing). → (next_states tuple, combined ok bool[B], reason int8[B]
+    where blocked: CUSTOM_BASE + slot position, else 0)."""
+    from sentinel_tpu.core.errors import BlockReason
+
+    ok_all = jnp.ones_like(view.live)
+    reason = jnp.zeros(view.rows.shape, jnp.int8)
+    live = view.live
+    next_states = []
+    for si, slot in enumerate(custom_slots):
+        sview = view._replace(live=live)
+        st2, ok = slot.check(custom_states[si], sview)
+        ok = ok | ~live               # only live events can be denied
+        next_states.append(st2)
+        newly = ~ok & (reason == 0)
+        reason = jnp.where(newly, jnp.int8(BlockReason.CUSTOM_BASE + si),
+                           reason)
+        ok_all = ok_all & ok
+        live = live & ok
+    return tuple(next_states), ok_all, reason

@@ -51,6 +51,7 @@ from sentinel_tpu.engine.pipeline import (
     decide_entries, init_state, invalidate_resource_rows, record_blocks,
     record_exits,
 )
+from sentinel_tpu.engine import fastpath as fp_mod
 from sentinel_tpu.rules import authority as auth_mod
 from sentinel_tpu.rules import degrade as deg_mod
 from sentinel_tpu.rules import flow as flow_mod
@@ -60,25 +61,41 @@ from sentinel_tpu.core.callbacks import StatisticCallbackRegistry
 from sentinel_tpu.core.logs import BlockStatLogger
 from sentinel_tpu.stats import events as ev
 from sentinel_tpu.stats.window import (
-    MINUTE_SPEC, SECOND_SPEC, WindowSpec, bucket_snapshot, rolling_totals,
-    rt_totals,
+    MINUTE_SPEC, SECOND_SPEC, WindowSpec, bucket_snapshot, init_window,
+    rolling_totals, rt_totals,
 )
 
 ENTRY_TYPE_OUT = 0
 ENTRY_TYPE_IN = 1
 
 
-@functools.lru_cache(maxsize=None)
-def _jitted_steps(spec: EngineSpec):
-    """Compiled steps shared across Sentinel instances with the same geometry
-    (EngineSpec is a frozen, hashable dataclass)."""
+def _build_steps(spec: EngineSpec, custom_slots: tuple):
     return (jax.jit(functools.partial(decide_entries, spec,
-                                      enable_occupy=False)),
+                                      enable_occupy=False,
+                                      custom_slots=custom_slots)),
             jax.jit(functools.partial(decide_entries, spec,
-                                      enable_occupy=True)),
+                                      enable_occupy=True,
+                                      custom_slots=custom_slots)),
             jax.jit(functools.partial(record_exits, spec)),
             jax.jit(functools.partial(invalidate_resource_rows, spec)),
             jax.jit(functools.partial(record_blocks, spec)))
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_steps_cached(spec: EngineSpec):
+    return _build_steps(spec, ())
+
+
+def _jitted_steps(spec: EngineSpec, custom_slots: tuple = ()):
+    """Compiled steps shared across Sentinel instances with the same geometry
+    (EngineSpec is a frozen, hashable dataclass). Variants WITH custom
+    DeviceSlots are deliberately NOT cached globally: the owning Sentinel
+    holds the only reference, so stale compilations (and the slot objects)
+    are garbage-collected on every register/unregister instead of pinned
+    forever by an unbounded cache key."""
+    if custom_slots:
+        return _build_steps(spec, custom_slots)
+    return _jitted_steps_cached(spec)
 
 # jitted once at import; shapes are padded to powers of two so the trace
 # cache stays small (calling jax.jit(...) per drain would re-trace every time)
@@ -143,7 +160,7 @@ class Entry:
 
     __slots__ = ("_rt", "resource", "row", "origin_row", "chain_row",
                  "acquire", "is_in", "create_ms", "error", "_exited",
-                 "param_pairs", "wait_ms", "_terminate_handlers")
+                 "param_pairs", "wait_ms", "_terminate_handlers", "fast")
 
     def __init__(self, rt: "Sentinel", resource: str, row: int, origin_row: int,
                  chain_row: int, acquire: int, is_in: bool, create_ms: int,
@@ -161,6 +178,7 @@ class Entry:
         self._exited = False
         self.wait_ms = 0   # pacing verdict; >0 only with entry(sleep=False)
         self._terminate_handlers = None   # CtEntry.whenTerminate callbacks
+        self.fast = None   # "free"/"leased" when host-fast-path admitted
 
     def trace(self, exc: BaseException) -> None:
         """Reference ``Tracer.trace`` — mark a business exception so it feeds
@@ -257,6 +275,14 @@ class Sentinel:
         self.authority_property.add_listener(lambda rs: self.load_authority_rules(rs))
         self.param_flow_property: SentinelProperty = SentinelProperty()
         self.param_flow_property.add_listener(lambda rs: self.load_param_flow_rules(rs))
+        # SampleCountProperty / IntervalProperty analogs: live second-window
+        # geometry (update_window_geometry rebuilds state + re-jits)
+        self.sample_count_property: SentinelProperty = SentinelProperty()
+        self.sample_count_property.add_listener(
+            lambda sc: self.update_window_geometry(sample_count=int(sc)))
+        self.interval_property: SentinelProperty = SentinelProperty()
+        self.interval_property.add_listener(
+            lambda ms: self.update_window_geometry(interval_ms=int(ms)))
 
         self._sys_rules: List[sys_mod.SystemRule] = []
         self._cpu = _CpuSampler(self.clock)
@@ -275,6 +301,26 @@ class Sentinel:
         self._cluster_rules_by_row: dict = {}
         self._cluster_param_rules_by_row: dict = {}
         self._occupy_live_until_ms = -1     # last ms a booking can be live
+        # highest second-window index any dispatch has stamped; late fast-
+        # path flush groups older than a full ring vs this are re-stamped
+        # to now (safe-late) instead of resurrecting a recycled bucket
+        self._seen_idx = -(2 ** 62)
+
+        # pluggable processor slots (SlotChainBuilder SPI analog,
+        # engine/slots.py): host gates veto before dispatch, device slots
+        # compile into the fused decide at registration
+        self._host_gates: tuple = ()
+        self._device_slots: tuple = ()
+
+        # host-side fast path (SURVEY §7 hard-part 1): rule-free rows admit
+        # on host with batched stat recording; single-simple-QPS rows serve
+        # from a device-pre-charged token lease
+        self._fast = fp_mod.HostFastPath(
+            flush_events=cfg.fast_path_flush_events,
+            flush_ms=cfg.fast_path_flush_ms,
+            lease_fraction=cfg.fast_path_lease_fraction,
+            win_ms=self.spec.second.win_ms)
+        self._fast_enabled = bool(cfg.host_fast_path)
 
     # ------------------------------------------------------------------
     # Rule management (XxxRuleManager.loadRules analog)
@@ -308,7 +354,47 @@ class Sentinel:
             auth_table=self._auth.table, auth_idx=self._auth.rule_idx,
             sys_thresholds=self._sys, param_table=self._param.table)
 
+    def _rebuild_fastpath(self) -> None:
+        """Recompute the host-fast-path classification after any rule load
+        (see :mod:`sentinel_tpu.engine.fastpath`). Rows named by any rule
+        are pinned in the registry, so classifications can't be stolen by
+        LRU row recycling."""
+        if not self._fast_enabled:
+            return
+        inel: set = set()
+        lease: dict = {}
+        for r in self._deg.rules:
+            inel.add(self.resources.get_or_create(r.resource))
+        for r in self._auth.rules:
+            inel.add(self.resources.get_or_create(r.resource))
+        inel.update(self._param.by_row.keys())
+        inel.update(self._cluster_rules_by_row.keys())
+        inel.update(self._cluster_param_rules_by_row.keys())
+        flow_by_row: dict = {}
+        for r in self._flow.rules:
+            row = self.resources.get_or_create(r.resource)
+            flow_by_row.setdefault(row, []).append(r)
+            if r.strategy == flow_mod.STRATEGY_RELATE and r.ref_resource:
+                # RELATE reads the ref row's live counts — fast-path lag
+                # there would skew this rule's decisions
+                inel.add(self.resources.get_or_create(r.ref_resource))
+        for row, rs in flow_by_row.items():
+            r = rs[0]
+            if (len(rs) == 1 and r.grade == flow_mod.GRADE_QPS
+                    and r.control_behavior == flow_mod.BEHAVIOR_DEFAULT
+                    and r.strategy == flow_mod.STRATEGY_DIRECT
+                    and (r.limit_app or "default") == "default"
+                    and not r.cluster_mode):
+                lease[row] = float(r.count)
+            else:
+                inel.add(row)
+        lease = {row: c for row, c in lease.items() if row not in inel}
+        self._fast.set_tables(inel, lease, sys_active=bool(self._sys_rules))
+
     def load_flow_rules(self, rules: Sequence[flow_mod.FlowRule]) -> None:
+        # buffered fast-path passes were admitted under the OLD tables —
+        # land them before the swap or the flush would re-decide them
+        self._flush_fast()
         cfg = self.cfg
         compiled = flow_mod.compile_flow_rules(
             rules, resource_registry=self.resources, context_registry=self.contexts,
@@ -337,6 +423,7 @@ class Sentinel:
                 flow_dyn=flow_mod.init_flow_dyn(cfg.max_flow_rules,
                                                 self.spec.second.buckets,
                                                 self.spec.rows))
+            self._rebuild_fastpath()
 
     def set_token_service(self, svc) -> None:
         """Install the cluster token service used for cluster-mode flow rules
@@ -346,7 +433,119 @@ class Sentinel:
         cluster rules then take the fallback path."""
         self._token_service = svc
 
+    # ------------------------------------------------------------------
+    # Pluggable processor slots (SlotChainProvider / SlotChainBuilder SPI
+    # analog — engine/slots.py; demo: demos/slot_spi.py)
+    # ------------------------------------------------------------------
+
+    def register_slot(self, slot) -> None:
+        """Register a user processor slot WITHOUT editing the engine:
+        a :class:`~sentinel_tpu.engine.slots.HostGate` runs on host before
+        every dispatch (single + batch tiers); a
+        :class:`~sentinel_tpu.engine.slots.DeviceSlot` is compiled into
+        the fused decide step (re-jit at registration), with its own state
+        slice carried in the engine state. Denials surface as
+        :class:`CustomSlotException` carrying the slot's name and are
+        recorded like every other block."""
+        from sentinel_tpu.engine import slots as slots_mod
+
+        if isinstance(slot, slots_mod.DeviceSlot):
+            self._flush_fast()      # land buffered stats via the old step
+            with self._lock:
+                self._device_slots = self._device_slots + (slot,)
+                # device slots must see EVERY event: the host fast path
+                # (which bypasses the device) turns off while any are live
+                self._fast_enabled = False
+                self._reload_custom_jits_locked()
+        elif isinstance(slot, slots_mod.HostGate):
+            with self._lock:
+                self._host_gates = self._host_gates + (slot,)
+        else:
+            raise TypeError(
+                "slot must subclass HostGate or DeviceSlot (engine/slots.py)")
+
+    def unregister_slot(self, slot) -> None:
+        from sentinel_tpu.engine import slots as slots_mod
+
+        if isinstance(slot, slots_mod.DeviceSlot):
+            with self._lock:
+                self._device_slots = tuple(
+                    s for s in self._device_slots if s is not slot)
+                self._fast_enabled = (bool(self.cfg.host_fast_path)
+                                      and not self._device_slots)
+                self._reload_custom_jits_locked()
+        else:
+            with self._lock:
+                self._host_gates = tuple(
+                    g for g in self._host_gates if g is not slot)
+
+    def _reload_custom_jits_locked(self) -> None:
+        (self._jit_decide, self._jit_decide_prio, self._jit_exit,
+         self._jit_invalidate, self._jit_record_blocks) = \
+            _jitted_steps(self.spec, self._device_slots)
+        self._state = self._state._replace(custom=tuple(
+            s.init_state(self.spec) for s in self._device_slots))
+
+    def _slot_code(self, kind: str, index: int) -> int:
+        """Reason code for a custom slot denial (disjoint sub-spaces: the
+        pipeline emits CUSTOM_BASE+i for DeviceSlot i; host gates use
+        CUSTOM_GATE_BASE+i)."""
+        return (int(BlockReason.CUSTOM_GATE_BASE) + index if kind == "gate"
+                else int(BlockReason.CUSTOM_BASE) + index)
+
+    def slot_name_for_code(self, code: int) -> str:
+        """Registered slot name for a CUSTOM_BASE+ reason code."""
+        code = int(code)
+        if code >= BlockReason.CUSTOM_GATE_BASE:
+            i = code - int(BlockReason.CUSTOM_GATE_BASE)
+            return (self._host_gates[i].name
+                    if i < len(self._host_gates) else "unknown-slot")
+        i = code - int(BlockReason.CUSTOM_BASE)
+        return (self._device_slots[i].name
+                if i < len(self._device_slots) else "unknown-slot")
+
+    def _run_host_gates_one(self, resource: str, origin: str, acquire: int,
+                            args: Sequence, row: int, o_row: int, c_row: int,
+                            is_in: bool) -> None:
+        """Run the registered gates for one entry; raises on denial after
+        recording the block (StatisticSlot parity)."""
+        for gi, gate in enumerate(self._host_gates):
+            exc = None
+            try:
+                ok = gate.check(resource, origin, acquire, args)
+            except BlockException as e:
+                ok, exc = False, e
+            if not ok:
+                raise self._record_cluster_block(
+                    self._slot_code("gate", gi), resource, origin, row,
+                    o_row, c_row, acquire, is_in, exc=exc,
+                    slot_name=gate.name)
+
+    def _run_host_gates_batch(self, resources, origins, acq, args_list,
+                              is_in, n: int):
+        """→ (blocked bool[n], reasons int32[n]); denials are block-logged
+        here (the device record happens batched upstream)."""
+        blocked = np.zeros(n, np.bool_)
+        reasons = np.zeros(n, np.int32)
+        for gi, gate in enumerate(self._host_gates):
+            oks = np.asarray(gate.check_batch(resources, origins, acq,
+                                              args_list), np.bool_)
+            newly = ~oks & ~blocked
+            if newly.any():
+                code = self._slot_code("gate", gi)
+                reasons[newly] = code
+                blocked |= newly
+                for i in np.nonzero(newly)[0].tolist():
+                    org = (origins[i] if origins is not None
+                           and origins[i] else "")
+                    self._log_cluster_block(code, resources[i], org,
+                                            int(acq[i]))
+        return blocked, reasons
+
     def load_degrade_rules(self, rules: Sequence[deg_mod.DegradeRule]) -> None:
+        # buffered fast-path passes were admitted under the OLD tables —
+        # land them before the swap or the flush would re-decide them
+        self._flush_fast()
         cfg = self.cfg
         compiled = deg_mod.compile_degrade_rules(
             rules, resource_registry=self.resources, capacity=cfg.max_degrade_rules,
@@ -356,6 +555,7 @@ class Sentinel:
             self._ruleset = self._build_ruleset()
             self._state = self._state._replace(
                 breakers=deg_mod.init_breaker_state(cfg.max_degrade_rules))
+            self._rebuild_fastpath()
 
     def load_param_flow_rules(self, rules: Sequence[pf_mod.ParamFlowRule]) -> None:
         self._user_param_rules = list(rules)
@@ -368,6 +568,7 @@ class Sentinel:
         self._reload_param_rules()
 
     def _reload_param_rules(self) -> None:
+        self._flush_fast()      # see load_flow_rules
         cfg = self.cfg
         all_rules = self._user_param_rules + self._gateway_param_rules
         # cluster-mode param rules delegate to the token server
@@ -393,14 +594,22 @@ class Sentinel:
             self._param_gen += 1
             self._state = self._state._replace(
                 param_dyn=pf_mod.init_param_dyn(self.spec.param_keys))
+            self._rebuild_fastpath()
 
     def load_system_rules(self, rules: Sequence[sys_mod.SystemRule]) -> None:
+        # buffered fast-path passes were admitted under the OLD tables —
+        # land them before the swap or the flush would re-decide them
+        self._flush_fast()
         with self._lock:
             self._sys_rules = list(rules)
             self._sys = sys_mod.compile_system_rules(rules)
             self._ruleset = self._build_ruleset()
+            self._rebuild_fastpath()
 
     def load_authority_rules(self, rules: Sequence[auth_mod.AuthorityRule]) -> None:
+        # buffered fast-path passes were admitted under the OLD tables —
+        # land them before the swap or the flush would re-decide them
+        self._flush_fast()
         cfg = self.cfg
         compiled = auth_mod.compile_authority_rules(
             rules, resource_registry=self.resources, origin_registry=self.origins,
@@ -409,6 +618,48 @@ class Sentinel:
         with self._lock:
             self._auth = compiled
             self._ruleset = self._build_ruleset()
+            self._rebuild_fastpath()
+
+    def update_window_geometry(self, sample_count: Optional[int] = None,
+                               interval_ms: Optional[int] = None) -> None:
+        """Live second-window geometry change — the
+        ``SampleCountProperty``/``IntervalProperty`` analog
+        (``node/SampleCountProperty.java``: the reference swaps fresh
+        LeapArrays into every node). Second windows and flow shaping state
+        cold-reset (history discard is the reference semantic); the minute
+        ring, thread gauges, breakers and hot-param state carry over. The
+        engine re-jits for the new geometry and host leases are dropped."""
+        import dataclasses as _dc
+
+        sc = int(sample_count if sample_count is not None
+                 else self.cfg.second_sample_count)
+        iv = int(interval_ms if interval_ms is not None
+                 else self.cfg.second_interval_ms)
+        if sc <= 0 or iv <= 0 or iv % sc:
+            raise ValueError(
+                "interval_ms must be a positive multiple of sample_count")
+        self._flush_fast()      # land buffered stats on the OLD geometry
+        with self._lock:
+            if (sc == self.cfg.second_sample_count
+                    and iv == self.cfg.second_interval_ms):
+                return
+            self.cfg = _dc.replace(self.cfg, second_sample_count=sc,
+                                   second_interval_ms=iv)
+            new_second = WindowSpec(sc, iv // sc)
+            self.spec = _dc.replace(self.spec, second=new_second)
+            self._state = self._state._replace(
+                second=init_window(new_second, self.spec.rows),
+                alt_second=init_window(new_second, self.spec.alt_rows),
+                flow_dyn=flow_mod.init_flow_dyn(
+                    self.cfg.max_flow_rules, new_second.buckets,
+                    self.spec.rows))
+            (self._jit_decide, self._jit_decide_prio, self._jit_exit,
+             self._jit_invalidate, self._jit_record_blocks) = \
+                _jitted_steps(self.spec, self._device_slots)
+            self._occupy_live_until_ms = -1
+            self._seen_idx = -(2 ** 62)
+            self._fast.win_ms = max(1, new_second.win_ms)
+            self._rebuild_fastpath()     # drops leases against old buckets
 
     def set_global_switch(self, on: bool) -> None:
         """Reference setSwitch command — off = everything passes unchecked."""
@@ -462,6 +713,24 @@ class Sentinel:
                       if c_row < self.spec.alt_rows else 0)
         is_in = entry_type == ENTRY_TYPE_IN
 
+        # user host gates veto before anything else (slot-chain SPI tier 1)
+        if self._host_gates:
+            self._run_host_gates_one(resource, use_origin or "", acquire,
+                                     args, row, o_row, c_row, is_in)
+
+        # host fast path: rule-free rows admit on host with batched stat
+        # recording; single-simple-QPS rows serve from a device
+        # pre-charged lease (engine/fastpath.py). Falls through to the
+        # exact device path for everything else.
+        if self._fast_enabled and not prioritized:
+            fe = self._fast_entry(resource, row, o_row, c_row, origin_id,
+                                  use_origin or "", acquire, is_in, args)
+            if fe is not None:
+                return fe
+        if self._fast_enabled and self._fast.due(self.clock.now_ms()):
+            self._flush_fast()     # keep buffered stats fresh under mixed
+            # fast/slow traffic (the device sees them before this decide)
+
         # cluster-mode rules: token-server delegation BEFORE the local
         # pipeline (FlowRuleChecker.passClusterCheck); failed requests with
         # fallbackToLocalWhenFail re-enable exactly those rules locally
@@ -495,8 +764,11 @@ class Sentinel:
                 cluster_fallback=(np.array([cluster_fb], np.int32)
                                   if cluster_fb else None))
             if not bool(verdict.allow[0]):
-                exc = block_exception_for(int(verdict.reason[0]), resource,
-                                          origin=use_origin)
+                rcode = int(verdict.reason[0])
+                exc = block_exception_for(
+                    rcode, resource, origin=use_origin,
+                    slot_name=(self.slot_name_for_code(rcode)
+                               if rcode >= BlockReason.CUSTOM_BASE else ""))
                 # LogSlot: block events roll into sentinel-block.log
                 self.block_log.log(resource, type(exc).__name__,
                                    origin=use_origin or "")
@@ -527,10 +799,13 @@ class Sentinel:
 
     def _record_cluster_block(self, reason: int, resource: str, origin: str,
                               row: int, o_row: int, c_row: int,
-                              acquire: int, is_in: bool) -> BlockException:
-        """Record + log + fire callbacks for a token-server denial; returns
-        the exception for the caller to raise (StatisticSlot accounting for
-        blocks decided off-device)."""
+                              acquire: int, is_in: bool, exc=None,
+                              slot_name: str = "") -> BlockException:
+        """Record + log + fire callbacks for a denial decided off-device
+        (token server or host gate); returns the exception for the caller
+        to raise (StatisticSlot accounting for blocks decided off-device).
+        ``exc`` overrides the constructed exception (a gate raising its own
+        BlockException subclass propagates it)."""
         times = self._time_scalars(self.clock.now_ms())
         with self._lock:
             self._state = self._jit_record_blocks(
@@ -542,11 +817,8 @@ class Sentinel:
                 jnp.asarray(np.array([is_in], np.bool_)),
                 jnp.asarray(np.array([True], np.bool_)),
                 times)
-        exc = block_exception_for(reason, resource, origin=origin)
-        self.block_log.log(resource, type(exc).__name__, origin=origin)
-        if not self.callbacks.empty:
-            self.callbacks.fire_blocked(resource, origin, acquire, exc)
-        return exc
+        return self._log_cluster_block(reason, resource, origin, acquire,
+                                       exc=exc, slot_name=slot_name)
 
     def _cluster_check(self, resource: str, origin: str, row: int,
                        o_row: int, c_row: int, acquire: int, is_in: bool,
@@ -690,11 +962,138 @@ class Sentinel:
             c_row = self._alt_row(row, 1, self.contexts.get_or_create(context_name))
         return o_row, c_row
 
+    def _fast_entry(self, resource: str, row: int, o_row: int, c_row: int,
+                    origin_id: int, origin: str, acquire: int,
+                    is_in: bool, args: Sequence = ()) -> Optional[Entry]:
+        """Try the host fast path → an admitted :class:`Entry`, or None to
+        take the exact device path (never decides a DENIAL on host)."""
+        fast = self._fast
+        if fast.sys_active and is_in:
+            return None          # SystemSlot gates inbound traffic globally
+        kind = fast.classify(row)
+        if kind == fp_mod.INELIGIBLE:
+            return None
+        now = self.clock.now_ms()
+        if kind == fp_mod.FREE:
+            fast.buffer_pass(row, o_row, c_row, acquire, is_in, now)
+            mode = "free"
+        else:
+            # leases pre-charge stats without alt rows, so they only serve
+            # origin-less, default-context events; others need per-event
+            # recording → device path
+            if origin_id != 0 or c_row < self.spec.alt_rows:
+                return None
+            verdict = fast.lease_state(row, acquire, is_in, now)
+            if verdict == fp_mod.DEVICE:
+                return None
+            if verdict == fp_mod.RENEW:
+                if fast.is_hot(row, now):
+                    return None    # chunk denied this bucket: exact path
+                # single renewal in flight per row: a concurrent pre-charge
+                # would double-spend the window budget (under-admission)
+                if not fast.begin_renewal(row):
+                    return None
+                try:
+                    # re-check under the claim (another thread may have
+                    # installed a lease between lease_state and here)
+                    if fast.lease_state(row, acquire, is_in,
+                                        now) != fp_mod.ADMIT:
+                        chunk = fast.lease_chunk(row, acquire)
+                        ra = self.spec.alt_rows
+                        v = self.decide_raw(
+                            np.array([row], np.int32), np.zeros(1, np.int32),
+                            np.array([ra], np.int32), np.zeros(1, np.int32),
+                            np.array([ra], np.int32),
+                            np.array([chunk], np.int32),
+                            np.array([is_in], np.bool_),
+                            np.zeros(1, np.bool_),
+                            count_thread=np.zeros(1, np.bool_),
+                            record_block=np.zeros(1, np.bool_))
+                        if not bool(v.allow[0]):
+                            fast.mark_hot(row, now)
+                            return None
+                        fast.install_lease(row, chunk, acquire, is_in, now)
+                finally:
+                    fast.end_renewal(row)
+            mode = "leased"
+        if not self.callbacks.empty:   # StatisticSlot onPass
+            self.callbacks.fire_pass(resource, origin, acquire, args)
+        e = Entry(self, resource, row, o_row, c_row, acquire, is_in, now)
+        e.fast = mode
+        if fast.due(now):
+            self._flush_fast(now)
+        return e
+
+    def _flush_fast(self, now_ms: Optional[int] = None) -> None:
+        """Land buffered fast-path stats on device with their EVENT-TIME
+        window stamps: groups are keyed by second-window index and each
+        group dispatches with its own times, so late flushes (idle gaps,
+        introspection pulls) still attribute pass/success to the second
+        they happened in — reference exit-time recording semantics. Groups
+        older than a full window ring relative to anything already
+        dispatched are re-stamped to now (safe-late): stamping them old
+        could resurrect a physical bucket a newer write already owns.
+        Passes go through the normal jitted decide (rule-free events can't
+        block → pure StatisticSlot recording), exits through the batched
+        exit step."""
+        now = self.clock.now_ms() if now_ms is None else now_ms
+        passes, exits = self._fast.drain(now)
+        if not passes and not exits:
+            return
+        B = self.spec.second.buckets
+        idx_of = self.spec.second.index_of
+
+        def grouped(events, ms_pos):
+            by: dict = {}
+            for e in events:
+                by.setdefault(idx_of(e[ms_pos]), []).append(e)
+            return sorted(by.items())
+
+        for g_idx, grp in grouped(passes, 5):
+            at = grp[0][5] if self._seen_idx - g_idx < B else None
+            n = len(grp)
+            self.decide_raw_nowait(
+                np.fromiter((p[0] for p in grp), np.int32, n),
+                np.zeros(n, np.int32),
+                np.fromiter((p[1] for p in grp), np.int32, n),
+                np.zeros(n, np.int32),
+                np.fromiter((p[2] for p in grp), np.int32, n),
+                np.fromiter((p[3] for p in grp), np.int32, n),
+                np.fromiter((p[4] for p in grp), np.bool_, n),
+                np.zeros(n, np.bool_),     # verdicts unused: all rule-free
+                at_ms=at)
+        for g_idx, grp in grouped(exits, 8):
+            at = grp[0][8] if self._seen_idx - g_idx < B else None
+            n = len(grp)
+            self.exit_batch(
+                rows=np.fromiter((x[0] for x in grp), np.int32, n),
+                origin_rows=np.fromiter((x[1] for x in grp), np.int32, n),
+                chain_rows=np.fromiter((x[2] for x in grp), np.int32, n),
+                acquire=np.fromiter((x[3] for x in grp), np.int32, n),
+                rt_ms=np.fromiter((x[4] for x in grp), np.int32, n),
+                error=np.fromiter((x[5] for x in grp), np.bool_, n),
+                is_in=np.fromiter((x[6] for x in grp), np.bool_, n),
+                count_thread=np.fromiter((x[7] for x in grp), np.bool_, n),
+                at_ms=at)
+
     def _exit_one(self, e: Entry) -> None:
         if e.row < 0:  # global switch was off at entry
             return
         now = self.clock.now_ms()
         rt = max(0, now - e.create_ms)
+        if e.fast is not None:
+            # fast-path entries exit through the host buffer (leased ones
+            # opted out of the thread gauge on entry — symmetric here)
+            self._fast.buffer_exit(
+                e.row, e.origin_row, e.chain_row, e.acquire,
+                min(rt, self.cfg.statistic_max_rt), e.error is not None,
+                e.is_in, e.fast == "free", now)
+            if not self.callbacks.empty:
+                self.callbacks.fire_exit(e.resource, rt, e.error is not None,
+                                         e.acquire)
+            if self._fast.due(now):
+                self._flush_fast(now)
+            return
         pr = pk = None
         gen = -1
         if e.param_pairs is not None:
@@ -793,6 +1192,15 @@ class Sentinel:
         prio = np.asarray(prioritized, np.bool_) if prioritized is not None \
             else np.zeros(n, np.bool_)
 
+        # user host gates veto first (slot-chain SPI tier 1); denials are
+        # logged in the gate runner and device-recorded batched below
+        gate_blocked = gate_reasons = None
+        if self._host_gates:
+            gate_blocked, gate_reasons = self._run_host_gates_batch(
+                resources, origins, acq, args_list, is_in, n)
+            if not gate_blocked.any():
+                gate_blocked = gate_reasons = None
+
         # cluster-mode rules: token delegation BEFORE the local decide, ONE
         # batched RPC for the whole batch when the service supports it.
         # Cluster-blocked events are excluded from the local decide and
@@ -801,12 +1209,25 @@ class Sentinel:
         if self._cluster_rules_by_row or self._cluster_param_rules_by_row:
             cl = self._cluster_precheck_batch(
                 resources, origins, rows, origin_rows, chain_rows,
-                acq, is_in, prio, args_list, n)
+                acq, is_in, prio, args_list, n, skip=gate_blocked)
         cl_blocked = cl_waits = cl_reasons = None
         cluster_fb_arr = valid_mask = None
         if cl is not None:
             cluster_fb_arr, cl_blocked, cl_waits, cl_reasons, valid_mask = cl
-            # one batched device record for every cluster-blocked event
+        if gate_blocked is not None:
+            # merge gate denials into the pre-blocked set (gates ran first,
+            # so they take precedence and never overlap a cluster denial)
+            if cl_blocked is None:
+                cl_blocked = gate_blocked
+                cl_reasons = gate_reasons
+                cl_waits = np.zeros(n, np.int32)
+                valid_mask = ~gate_blocked
+            else:
+                cl_blocked = cl_blocked | gate_blocked
+                cl_reasons = np.where(gate_blocked, gate_reasons, cl_reasons)
+                valid_mask = valid_mask & ~gate_blocked
+        if cl_blocked is not None:
+            # one batched device record for every pre-blocked event
             if cl_blocked.any():
                 idxs = np.nonzero(cl_blocked)[0]
                 m = len(idxs)
@@ -876,11 +1297,19 @@ class Sentinel:
         return PendingVerdicts(_finalize)
 
     def _log_cluster_block(self, reason: int, resource: str, origin: str,
-                           acquire: int) -> BlockException:
-        """Block log + StatisticSlot callbacks for a token-server denial
-        decided off-device (device record happens batched upstream);
-        returns the exception for callers that raise it."""
-        exc = block_exception_for(reason, resource, origin=origin)
+                           acquire: int, exc=None,
+                           slot_name: Optional[str] = None) -> BlockException:
+        """Block log + StatisticSlot callbacks for a denial decided
+        off-device (token server or host gate; device record happens
+        batched upstream); returns the exception for callers that raise
+        it. ``exc`` overrides the constructed exception (a gate raising
+        its own BlockException subclass propagates it)."""
+        if exc is None:
+            if slot_name is None:
+                slot_name = (self.slot_name_for_code(reason)
+                             if reason >= BlockReason.CUSTOM_BASE else "")
+            exc = block_exception_for(reason, resource, origin=origin,
+                                      slot_name=slot_name)
         self.block_log.log(resource, type(exc).__name__, origin=origin)
         if not self.callbacks.empty:
             self.callbacks.fire_blocked(resource, origin, acquire, exc)
@@ -888,7 +1317,7 @@ class Sentinel:
 
     def _cluster_precheck_batch(self, resources, origins, rows, origin_rows,
                                 chain_rows, acq, is_in, prio, args_list,
-                                n: int):
+                                n: int, skip=None):
         """Cluster token delegation for a whole batch → ``(fallback_bits or
         None, cl_blocked, cl_waits, cl_reasons, valid_mask)``.
 
@@ -913,6 +1342,8 @@ class Sentinel:
         use_batch = svc is not None and hasattr(svc, "request_tokens_batch")
         if not use_batch:
             for i in range(n):
+                if skip is not None and skip[i]:
+                    continue       # already denied by a host gate
                 crules = self._cluster_rules_by_row.get(int(rows[i]))
                 cprules = self._cluster_param_rules_by_row.get(int(rows[i]))
                 if not crules and not cprules:
@@ -946,6 +1377,8 @@ class Sentinel:
         flow_req: list = []    # (event_i, slot_k, rule)
         param_req: list = []   # (event_i, rule, value)
         for i in range(n):
+            if skip is not None and skip[i]:
+                continue           # already denied by a host gate
             crules = self._cluster_rules_by_row.get(int(rows[i]))
             cprules = self._cluster_param_rules_by_row.get(int(rows[i]))
             if crules:
@@ -1037,7 +1470,8 @@ class Sentinel:
     def decide_raw(self, rows, origin_ids, origin_rows, context_ids, chain_rows,
                    acquire, is_in, prioritized, *, param_rules=None,
                    param_keys=None, param_gen: int = -1,
-                   cluster_fallback=None, valid=None) -> Verdicts:
+                   cluster_fallback=None, valid=None,
+                   count_thread=None, record_block=None) -> Verdicts:
         """Lowest-level host entry point: pre-resolved numpy arrays.
         ``param_gen`` is the generation the pair arrays were resolved against;
         stale pairs (a reload raced the resolve) are dropped, not misapplied."""
@@ -1045,13 +1479,16 @@ class Sentinel:
             rows, origin_ids, origin_rows, context_ids, chain_rows, acquire,
             is_in, prioritized, param_rules=param_rules,
             param_keys=param_keys, param_gen=param_gen,
-            cluster_fallback=cluster_fallback, valid=valid).result()
+            cluster_fallback=cluster_fallback, valid=valid,
+            count_thread=count_thread, record_block=record_block).result()
 
     def decide_raw_nowait(self, rows, origin_ids, origin_rows, context_ids,
                           chain_rows, acquire, is_in, prioritized, *,
                           param_rules=None, param_keys=None,
                           param_gen: int = -1, cluster_fallback=None,
-                          valid=None) -> "PendingVerdicts":
+                          valid=None, count_thread=None,
+                          record_block=None,
+                          at_ms: Optional[int] = None) -> "PendingVerdicts":
         """:meth:`decide_raw` with the verdict readback deferred: the step
         is dispatched (state already advanced in order under the lock) and
         the device→host verdict copy started async; ``.result()``
@@ -1075,8 +1512,12 @@ class Sentinel:
             param_keys=self._pad_pairs(param_keys, b, self.spec.param_keys),
             cluster_fallback=(_pad_to(cluster_fallback, b, 0, np.int32)
                               if cluster_fallback is not None else None),
+            count_thread=(_pad_to(count_thread, b, False, np.bool_)
+                          if count_thread is not None else None),
+            record_block=(_pad_to(record_block, b, False, np.bool_)
+                          if record_block is not None else None),
         )
-        now = self.clock.now_ms()
+        now = self.clock.now_ms() if at_ms is None else at_ms
         times = self._time_scalars(now)
         load1, cpu = self._cpu.sample()
         sys_scalars = jnp.asarray(np.array([load1, cpu], np.float32))
@@ -1086,6 +1527,8 @@ class Sentinel:
             if batch.param_rules is not None and param_gen != self._param_gen:
                 batch = batch._replace(param_rules=None, param_keys=None)
             self._drain_evictions_locked()
+            self._seen_idx = max(self._seen_idx,
+                                 self.spec.second.index_of(now))
             # static occupy variant: the occupy-aware pipeline runs only
             # when this batch is prioritized OR a previous booking can
             # still be live (bookings last ≤ B+1 windows); everything else
@@ -1111,7 +1554,8 @@ class Sentinel:
 
     def exit_batch(self, *, rows, origin_rows, chain_rows, acquire, rt_ms,
                    error, is_in, param_rules=None, param_keys=None,
-                   param_gen: int = -1) -> None:
+                   param_gen: int = -1, count_thread=None,
+                   at_ms: Optional[int] = None) -> None:
         n = rows.shape[0]
         b = self._pad(n)
         batch = ExitBatch(
@@ -1125,10 +1569,14 @@ class Sentinel:
             valid=_pad_to(np.ones(n, np.bool_), b, False, np.bool_),
             param_rules=self._pad_pairs(param_rules, b, self.cfg.max_param_rules),
             param_keys=self._pad_pairs(param_keys, b, self.spec.param_keys),
+            count_thread=(_pad_to(count_thread, b, False, np.bool_)
+                          if count_thread is not None else None),
         )
-        now = self.clock.now_ms()
+        now = self.clock.now_ms() if at_ms is None else at_ms
         times = self._time_scalars(now)
         with self._lock:
+            self._seen_idx = max(self._seen_idx,
+                                 self.spec.second.index_of(now))
             unpin = None
             if batch.param_rules is not None:
                 if param_gen != self._param_gen:
@@ -1191,6 +1639,7 @@ class Sentinel:
 
         if self.spec.minute is None:
             return []
+        self._flush_fast()      # buffered fast-path stats land first
         idx = jnp.int32(self.spec.minute.index_of(time_ms))
         with self._lock:
             counters, rt = _jit_bucket_snapshot(self.spec.minute)(
@@ -1257,6 +1706,7 @@ class Sentinel:
 
     def _totals_snapshot(self):
         """One full-table device read → (counters[R,E], rt[R], threads[R])."""
+        self._flush_fast()      # buffered fast-path stats land first
         now = self.clock.now_ms()
         idx_s = jnp.int32(self.spec.second.index_of(now))
         with self._lock:
@@ -1300,6 +1750,7 @@ class Sentinel:
         row = self.resources.lookup(resource)
         if row is None:
             return []
+        self._flush_fast()      # buffered fast-path stats land first
         now = self.clock.now_ms()
         idx_s = jnp.int32(self.spec.second.index_of(now))
         with self._lock:

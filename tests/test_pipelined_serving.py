@@ -230,12 +230,14 @@ def test_client_pipelined_batch_over_socket(clk):
     srv.start()
     try:
         cli = ClusterTokenClient("127.0.0.1", srv.port, namespace="ns",
-                                 request_timeout_ms=2000)
+                                 request_timeout_ms=10_000)
         cli.start()
         try:
-            # warm the engine's jitted step (first compile can exceed the
-            # timeout) with a flow id that has no rule → consumes nothing
+            # warm BOTH jitted shapes (single + padded batch) with flow ids
+            # that have no rule → consumes nothing; the first compile of a
+            # shape can exceed even a generous timeout on a loaded CI box
             cli.request_token(999, 1)
+            cli.request_tokens_batch([(999, 1, False)] * 5)
             res = cli.request_tokens_batch([(9, 1, False)] * 5)
             assert [r.status for r in res] == [0, 0, 0, 1, 1]
         finally:
