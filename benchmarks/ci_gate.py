@@ -1,0 +1,66 @@
+"""CI perf-regression gate: run the headline bench at CI-sized shapes on
+the CPU backend and fail on a >2× regression of decisions/sec against the
+committed baseline.
+
+Usage:
+    python benchmarks/ci_gate.py            # gate (exit 1 on regression)
+    python benchmarks/ci_gate.py --update   # re-baseline after intentional
+                                            # perf-relevant changes
+
+The baseline is machine-relative noise-prone, so the gate (a) uses a 2×
+margin, (b) takes the best of three runs, and (c) stores a deliberately
+conservative floor (half the measured rate at update time). It catches the
+failure mode that matters — an accidental 10× step cost (lost fusion,
+accidental sync, per-event host loop) — not 20% drift.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+HERE = Path(__file__).resolve().parent
+BASELINE_FILE = HERE / "ci_baseline.json"
+
+ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "BENCH_RESOURCES": str(1 << 14),
+    "BENCH_BATCH": str(1 << 13),
+    "BENCH_STEPS": "20",
+    "BENCH_RULES": "256",
+}
+
+
+def measure_once() -> float:
+    out = subprocess.run(
+        [sys.executable, str(HERE.parent / "bench.py")], env=ENV,
+        capture_output=True, text=True, timeout=600, check=True)
+    line = out.stdout.strip().splitlines()[-1]
+    return float(json.loads(line)["value"])
+
+
+def main() -> int:
+    best = max(measure_once() for _ in range(3))
+    if "--update" in sys.argv:
+        BASELINE_FILE.write_text(json.dumps(
+            {"cpu_decisions_per_sec_floor": best / 2,
+             "measured_at_update": best}, indent=1))
+        print(f"baseline updated: floor={best / 2:.0f} (measured {best:.0f})")
+        return 0
+    baseline = json.loads(BASELINE_FILE.read_text())
+    floor = baseline["cpu_decisions_per_sec_floor"]
+    print(json.dumps({"measured": best, "floor": floor,
+                      "ratio_vs_floor": round(best / floor, 2)}))
+    if best < floor:
+        print(f"PERF REGRESSION: {best:.0f} decisions/s < floor {floor:.0f} "
+              f"(>2x below the rate at baseline time)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

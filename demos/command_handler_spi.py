@@ -1,0 +1,64 @@
+"""Custom command handlers — the ``sentinel-demo-command-handler`` analog.
+
+The reference registers user ``CommandHandler``s through SPI
+(``@CommandMapping(name=...)``); here any callable registers into the
+:class:`CommandCenter` and is served by the same HTTP command frontend the
+dashboard talks to (port 8719 family).
+
+Run: ``python demos/command_handler_spi.py``
+"""
+
+import json
+import urllib.request
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.transport.command import (
+    CommandCenter, CommandRequest, CommandResponse,
+)
+from sentinel_tpu.transport.handlers import register_default_handlers
+from sentinel_tpu.transport.http_server import SimpleHttpCommandCenter
+
+
+def main() -> None:
+    clk = ManualClock(start_ms=1_700_000_000_000)
+    sph = stpu.Sentinel(stpu.load_config(
+        max_resources=64, max_flow_rules=16, max_degrade_rules=16,
+        max_authority_rules=16), clock=clk)
+    center = CommandCenter()
+    register_default_handlers(center, sph)
+
+    # --- the custom handler: echo + live block-rate summary ---
+    def block_rate(request: CommandRequest) -> CommandResponse:
+        resource = request.parameters.get("resource", "")
+        t = sph.node_totals(resource)
+        total = t["pass"] + t["block"]
+        rate = (t["block"] / total) if total else 0.0
+        return CommandResponse.of_success(json.dumps(
+            {"resource": resource, "blockRate": round(rate, 3)}))
+
+    center.register(block_rate, name="blockRate")
+
+    http = SimpleHttpCommandCenter(center, host="127.0.0.1", port=0)
+    port = http.start()
+    try:
+        sph.load_flow_rules([stpu.FlowRule(resource="pay", count=2)])
+        for _ in range(10):
+            try:
+                with sph.entry("pay"):
+                    pass
+            except stpu.BlockException:
+                pass
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/blockRate?resource=pay") as r:
+            print("custom command response:", r.read().decode())
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api") as r:
+            listed = r.read().decode()
+        print("registered in /api listing:", "blockRate" in listed)
+    finally:
+        http.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -114,6 +114,36 @@ class ClusterTokenServer:
     # Lifecycle
     # ------------------------------------------------------------------
 
+    def update_transport_config(self, port: Optional[int] = None,
+                                idle_seconds: Optional[float] = None) -> None:
+        """Live transport-config change — the ``ServerTransportConfig``
+        watcher (``SentinelDefaultTokenServer.java:37-111``: the reference
+        stops and restarts the netty server when the port changes). An
+        idle-seconds change applies immediately (the reaper reads it per
+        sweep); a port change restarts the listener, dropping connections
+        exactly like the reference restart — clients re-register via their
+        2 s reconnect loop."""
+        if idle_seconds is not None:
+            self.idle_seconds = float(idle_seconds)
+        if port is not None and int(port) != self.port:
+            running = self._thread is not None
+            old_port = self.port
+            if running:
+                self.stop()
+            self.port = int(port)
+            if running:
+                try:
+                    self.start()
+                except Exception:
+                    # the new port didn't bind: restore service on the old
+                    # one rather than staying down (clients are still
+                    # reconnecting to it)
+                    self._thread = None
+                    self._loop = None
+                    self.port = old_port
+                    self.start()
+                    raise
+
     def start(self) -> None:
         """Run the server on a daemon thread; returns once listening."""
         if self._thread is not None:

@@ -57,6 +57,11 @@ def _geometry(sentinel) -> dict:
 
 def save_state(sentinel, path: str) -> None:
     """Snapshot the device state + registries of a Sentinel instance."""
+    # land buffered fast-path stats and reconcile live lease remainders
+    # first: the restored process knows nothing about host-held tokens, so
+    # leaving them reserved would snapshot phantom passes
+    sentinel._fast.expire_all()
+    sentinel._flush_fast()
     with sentinel._lock:
         leaves, treedef = jax.tree.flatten(sentinel._state)
         arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
@@ -82,13 +87,19 @@ def save_state(sentinel, path: str) -> None:
     os.replace(tmp_meta, str(path) + _META_SUFFIX)
 
 
-def load_state(sentinel, path: str) -> bool:
+def load_state(sentinel, path: str):
     """Warm-restore a snapshot into a fresh Sentinel with the same geometry.
 
-    Returns False (leaving the instance cold) when the snapshot's geometry
-    doesn't match — a changed config invalidates row meanings, and a cold
-    start is the reference's own behavior anyway. Rules are NOT restored
-    (they live in datasources); load rules first, then restore counters.
+    → ``"full"`` (everything restored), ``"partial"`` (the loaded RULES
+    differ from the snapshot's: window counters + epoch restore — their
+    meaning is keyed by resource rows, which matched — while the
+    slot-indexed flow pacing / breaker / hot-param state stays cold, since
+    restoring it under a different rule compilation would attach clocks
+    and breaker states to the wrong rules), or ``False`` (geometry or
+    registry mismatch → cold start, the reference's own restart behavior).
+    Both truthy results restore; callers needing exactly-full check
+    ``== "full"``. Rules are NOT restored (they live in datasources); load
+    rules first, then restore counters.
     """
     meta_path = Path(str(path) + _META_SUFFIX)
     npz_path = Path(path if str(path).endswith(".npz") else str(path) + ".npz")
@@ -103,8 +114,7 @@ def load_state(sentinel, path: str) -> bool:
         return False
     if meta.get("geometry") != _geometry(sentinel):
         return False
-    if meta.get("rules_digest") != _rules_digest(sentinel):
-        return False         # slot-indexed dyn state would misattach
+    digest_ok = meta.get("rules_digest") == _rules_digest(sentinel)
     with sentinel._lock:
         leaves, treedef = jax.tree.flatten(sentinel._state)
         if len(leaves) != len(data.files):
@@ -127,16 +137,25 @@ def load_state(sentinel, path: str) -> bool:
             for name, rid in sorted(meta[reg_name], key=lambda p: p[1]):
                 if reg.get_or_create(name) != rid:
                     return False      # interning drifted: treat as cold
-        new_state = jax.tree.unflatten(treedef, restored)
-        # live-concurrency counters must NOT survive: the snapshot's
-        # in-flight entries never exit in this process, so restored thread
-        # counts would be phantom forever (threads only decrement at exit)
-        new_state = new_state._replace(
-            threads=sentinel._state.threads,
-            alt_threads=sentinel._state.alt_threads)
+        full = jax.tree.unflatten(treedef, restored)
+        if digest_ok:
+            # live-concurrency counters must NOT survive: the snapshot's
+            # in-flight entries never exit in this process, so restored
+            # thread counts would be phantom forever (threads only
+            # decrement at exit)
+            new_state = full._replace(
+                threads=sentinel._state.threads,
+                alt_threads=sentinel._state.alt_threads)
+        else:
+            # degraded restore-what-matches: rules changed since the
+            # snapshot → windows (row-keyed, still meaningful) carry over,
+            # slot-indexed dyn state stays cold
+            new_state = sentinel._state._replace(
+                second=full.second, minute=full.minute,
+                alt_second=full.alt_second)
         sentinel._state = new_state
         # window indices are derived from absolute wall time, so they stay
         # valid across the restart; the relative-ms epoch must carry over
         # for pacing clocks/warm-up state to stay meaningful
         sentinel.epoch_ms = meta["epoch_ms"]
-    return True
+    return "full" if digest_ok else "partial"

@@ -34,6 +34,7 @@ from sentinel_tpu.dashboard.auth import AuthService
 from sentinel_tpu.dashboard.client import AgentUnreachable, SentinelApiClient
 from sentinel_tpu.dashboard.discovery import AppManagement, MachineInfo
 from sentinel_tpu.dashboard.fetcher import MetricFetcher
+from sentinel_tpu.dashboard.rulepipeline import RulePipelineRegistry
 from sentinel_tpu.dashboard.repository import (
     InMemoryMetricsRepository, MetricEntity, RuleEntity, RuleRepository,
 )
@@ -64,7 +65,18 @@ class Dashboard:
         self.auth = AuthService(username, password)
         self.rules: Dict[str, RuleRepository] = {
             t: RuleRepository() for t in RULE_TYPES}
+        # v2 pluggable rule pipeline (DynamicRuleProvider/Publisher SPI):
+        # types with a registered pair read/publish through a config center
+        # instead of direct machine push; agents pull the same store via a
+        # datasource (rulepipeline.py)
+        self.rule_pipeline = RulePipelineRegistry()
         self._clock = clock
+
+    def set_rule_pipeline(self, rtype: str, provider=None,
+                          publisher=None) -> None:
+        """Install a v2 provider/publisher pair for one rule type
+        (``FlowRuleApiProvider`` → config-center variant swap)."""
+        self.rule_pipeline.set_pipeline(rtype, provider, publisher)
 
     def _now_ms(self) -> int:
         import time
@@ -96,13 +108,23 @@ class Dashboard:
 
     def query_rules(self, rtype: str, app: str, ip: str = "",
                     port: int = 0) -> dict:
-        m = self._machine(app, ip, port)
-        if m is None:
-            return _fail(f"no healthy machine for app {app}")
-        try:
-            raw = self.client.fetch_rules(m.ip, m.port, rtype)
-        except AgentUnreachable as exc:
-            return _fail(str(exc))
+        provider = self.rule_pipeline.provider(rtype)
+        if provider is not None:
+            # v2: the config center is the source of truth
+            try:
+                raw = provider.get_rules(app)
+            except Exception as exc:
+                return _fail(f"rule provider failed: {exc}")
+            m = self._machine(app, ip, port) or MachineInfo(
+                app=app, hostname="", ip="", port=0)
+        else:
+            m = self._machine(app, ip, port)
+            if m is None:
+                return _fail(f"no healthy machine for app {app}")
+            try:
+                raw = self.client.fetch_rules(m.ip, m.port, rtype)
+            except AgentUnreachable as exc:
+                return _fail(str(exc))
         repo = self.rules[rtype]
         known = {json.dumps(e.rule, sort_keys=True): e.id
                  for e in repo.find_by_app(app)}
@@ -116,6 +138,17 @@ class Dashboard:
 
     def publish_rules(self, rtype: str, app: str) -> bool:
         rules = [e.rule for e in self.rules[rtype].find_by_app(app)]
+        publisher = self.rule_pipeline.publisher(rtype)
+        if publisher is not None:
+            # v2: publish to the config center; agents converge by pulling
+            # it through their datasource (no direct machine push)
+            try:
+                publisher.publish(app, rules)
+                return True
+            except Exception as exc:
+                from sentinel_tpu.core.logs import record_log
+                record_log().warning("rule publisher failed: %r", exc)
+                return False
         ok = True
         machines = self.apps.healthy_machines(app, self._now_ms())
         if not machines:

@@ -16,4 +16,5 @@ from sentinel_tpu.datasource.http import (  # noqa: F401
 from sentinel_tpu.datasource.named import (  # noqa: F401
     ApolloDataSource, ConsulDataSource, EtcdDataSource, EurekaDataSource,
     NacosDataSource, RedisDataSource, SpringCloudConfigDataSource,
+    ZooKeeperDataSource,
 )

@@ -51,12 +51,14 @@ DEVICE = 2     # take the exact device path for this event
 
 
 class _Lease:
-    __slots__ = ("bucket_idx", "remaining", "is_in")
+    __slots__ = ("bucket_idx", "remaining", "is_in", "created_ms")
 
-    def __init__(self, bucket_idx: int, remaining: int, is_in: bool):
+    def __init__(self, bucket_idx: int, remaining: int, is_in: bool,
+                 created_ms: int):
         self.bucket_idx = bucket_idx
         self.remaining = remaining
         self.is_in = is_in
+        self.created_ms = created_ms
 
 
 class HostFastPath:
@@ -78,6 +80,9 @@ class HostFastPath:
         self._leases: Dict[int, _Lease] = {}
         self._hot_bucket: Dict[int, int] = {}
         self._renewing: Set[int] = set()   # rows with a pre-charge in flight
+        # expired leases' unused tokens awaiting window reversal:
+        # (row, created_ms, remaining, is_in)
+        self._expired: List[tuple] = []
         self._pass_buf: List[tuple] = []
         self._exit_buf: List[tuple] = []
         self._buf_bucket = -1
@@ -91,13 +96,14 @@ class HostFastPath:
     def set_tables(self, ineligible: Set[int], lease_counts: Dict[int, float],
                    sys_active: bool) -> None:
         """Swap in a fresh classification after a rule load. Live leases
-        are dropped (their pre-charge stays recorded on device — bounded
-        under-admission, never over)."""
+        are dropped; their unused pre-charged tokens queue for window
+        reversal at the next flush (transiently reserved on device until
+        then — never over-admission)."""
         with self._lock:
             self._ineligible = ineligible
             self._lease_count = lease_counts
             self.sys_active = sys_active
-            self._leases.clear()
+            self._collect_expired_locked(drop_all=True)
             self._hot_bucket.clear()
 
     def classify(self, row: int) -> int:
@@ -121,7 +127,14 @@ class HostFastPath:
         b = self.bucket_of(now_ms)
         with self._lock:
             lease = self._leases.get(row)
-            if lease is not None and lease.bucket_idx == b:
+            if lease is not None and lease.bucket_idx != b:
+                # bucket rotated: unused tokens go back to their window
+                self._leases.pop(row)
+                if lease.remaining > 0:
+                    self._expired.append((row, lease.created_ms,
+                                          lease.remaining, lease.is_in))
+                lease = None
+            if lease is not None:
                 if lease.is_in != is_in:
                     return DEVICE
                 if lease.remaining >= acquire:
@@ -168,14 +181,37 @@ class HostFastPath:
                     and lease.is_in == is_in):
                 lease.remaining += chunk - used
             else:
-                self._leases[row] = _Lease(b, chunk - used, is_in)
+                if lease is not None and lease.remaining > 0:
+                    self._expired.append((row, lease.created_ms,
+                                          lease.remaining, lease.is_in))
+                self._leases[row] = _Lease(b, chunk - used, is_in, now_ms)
             self.lease_renewals += 1
             self.fast_admits += 1
 
     def mark_hot(self, row: int, now_ms: int) -> None:
         with self._lock:
             self._hot_bucket[row] = self.bucket_of(now_ms)
-            self._leases.pop(row, None)
+            lease = self._leases.pop(row, None)
+            if lease is not None and lease.remaining > 0:
+                self._expired.append((row, lease.created_ms,
+                                      lease.remaining, lease.is_in))
+
+    def _collect_expired_locked(self, drop_all: bool = False,
+                                now_ms: Optional[int] = None) -> None:
+        b = None if now_ms is None else self.bucket_of(now_ms)
+        for row in list(self._leases):
+            lease = self._leases[row]
+            if drop_all or lease.bucket_idx != b:
+                del self._leases[row]
+                if lease.remaining > 0:
+                    self._expired.append((row, lease.created_ms,
+                                          lease.remaining, lease.is_in))
+
+    def expire_all(self) -> None:
+        """Reconcile every live lease (snapshot save / shutdown): unused
+        tokens queue for window reversal at the next flush."""
+        with self._lock:
+            self._collect_expired_locked(drop_all=True)
 
     # ---------------------------------------------------------------- buffers
     def buffer_pass(self, row: int, o_row: int, c_row: int, acquire: int,
@@ -196,6 +232,8 @@ class HostFastPath:
                                    is_in, count_thread, now_ms))
 
     def due(self, now_ms: int) -> bool:
+        if self._expired:
+            return True            # unused lease tokens awaiting reversal
         n = len(self._pass_buf) + len(self._exit_buf)
         if n == 0:
             return False
@@ -208,9 +246,13 @@ class HostFastPath:
         return now_ms - self._last_flush_ms >= self.flush_ms
 
     def drain(self, now_ms: int):
-        """→ (passes, exits) and reset (caller dispatches them to device)."""
+        """→ (passes, exits, expired_leases) and reset (caller dispatches
+        them to device; expired leases' unused tokens are subtracted back
+        from their window buckets)."""
         with self._lock:
+            self._collect_expired_locked(now_ms=now_ms)
             p, self._pass_buf = self._pass_buf, []
             x, self._exit_buf = self._exit_buf, []
+            e, self._expired = self._expired, []
             self._last_flush_ms = now_ms
-            return p, x
+            return p, x, e

@@ -464,6 +464,27 @@ def record_blocks(
     return state._replace(second=second, alt_second=alt_second, minute=minute)
 
 
+def uncount_reserved(spec: EngineSpec, state: SentinelState,
+                     rows: jnp.ndarray, sec_idx: jnp.ndarray,
+                     min_idx: jnp.ndarray,
+                     amounts: jnp.ndarray) -> SentinelState:
+    """Return unused host-lease tokens to their window buckets: a lease
+    pre-charge recorded PASS for the whole chunk up front (the admission
+    ledger must see reserved tokens), so the remainder of an expired lease
+    is subtracted back — pass metrics then count actual admissions, not
+    reservations. Only live buckets are touched (see
+    :func:`stats.window.uncount_rows`)."""
+    from sentinel_tpu.stats.window import uncount_rows
+
+    second = uncount_rows(spec.second, state.second, rows, sec_idx,
+                          ev.PASS, amounts)
+    minute = state.minute
+    if spec.minute:
+        minute = uncount_rows(spec.minute, state.minute, rows, min_idx,
+                              ev.PASS, amounts)
+    return state._replace(second=second, minute=minute)
+
+
 def invalidate_resource_rows(spec: EngineSpec, state: SentinelState,
                              rows: jnp.ndarray,
                              alt_rows: jnp.ndarray) -> SentinelState:

@@ -104,6 +104,12 @@ _jit_apply_overrides = jax.jit(pf_mod.apply_overrides)
 
 
 @functools.lru_cache(maxsize=None)
+def _jit_uncount_reserved(spec: EngineSpec):
+    from sentinel_tpu.engine.pipeline import uncount_reserved
+    return jax.jit(functools.partial(uncount_reserved, spec))
+
+
+@functools.lru_cache(maxsize=None)
 def _jit_bucket_snapshot(spec: WindowSpec):
     return jax.jit(functools.partial(bucket_snapshot, spec))
 
@@ -1037,8 +1043,8 @@ class Sentinel:
         block → pure StatisticSlot recording), exits through the batched
         exit step."""
         now = self.clock.now_ms() if now_ms is None else now_ms
-        passes, exits = self._fast.drain(now)
-        if not passes and not exits:
+        passes, exits, expired = self._fast.drain(now)
+        if not passes and not exits and not expired:
             return
         B = self.spec.second.buckets
         idx_of = self.spec.second.index_of
@@ -1062,6 +1068,35 @@ class Sentinel:
                 np.fromiter((p[4] for p in grp), np.bool_, n),
                 np.zeros(n, np.bool_),     # verdicts unused: all rule-free
                 at_ms=at)
+        if expired:
+            # return unused lease tokens to their window buckets (pass
+            # metrics then reflect actual admissions, not reservations);
+            # is_in pre-charges also counted the ENTRY node
+            rows: list = []
+            secs: list = []
+            mins: list = []
+            amts: list = []
+            min_spec = self.spec.minute
+            for row, created, remaining, was_in in expired:
+                targets = [row, ENTRY_NODE_ROW] if was_in else [row]
+                for r in targets:
+                    rows.append(r)
+                    secs.append(self.spec.second.index_of(created))
+                    mins.append(min_spec.index_of(created) if min_spec else 0)
+                    amts.append(remaining)
+            m = len(rows)
+            bm = self._pad(m)
+            with self._lock:
+                self._state = _jit_uncount_reserved(self.spec)(
+                    self._state,
+                    jnp.asarray(_pad_to(np.asarray(rows, np.int32), bm,
+                                        self.spec.rows, np.int32)),
+                    jnp.asarray(_pad_to(np.asarray(secs, np.int32), bm, 0,
+                                        np.int32)),
+                    jnp.asarray(_pad_to(np.asarray(mins, np.int32), bm, 0,
+                                        np.int32)),
+                    jnp.asarray(_pad_to(np.asarray(amts, np.int32), bm, 0,
+                                        np.int32)))
         for g_idx, grp in grouped(exits, 8):
             at = grp[0][8] if self._seen_idx - g_idx < B else None
             n = len(grp)

@@ -205,6 +205,22 @@ def add_rows_multi(spec: WindowSpec, state: WindowState, rows: jnp.ndarray,
     return state._replace(counters=counters)
 
 
+def uncount_rows(spec: WindowSpec, state: WindowState, rows: jnp.ndarray,
+                 idxs: jnp.ndarray, event: int,
+                 amounts: jnp.ndarray) -> WindowState:
+    """Subtract ``amounts`` of ``event`` from the bucket at window index
+    ``idxs`` per row — ONLY where that bucket still carries the stamp for
+    ``idxs`` (live). Reverses a reservation recorded earlier in the same
+    ring lap (host lease pre-charges returning unused tokens); a rotated
+    bucket already reads as zero, so no reversal is needed (or safe)
+    there. Padding: rows >= R."""
+    k = idxs % spec.buckets
+    live = state.stamps[rows.clip(0, state.stamps.shape[0] - 1), k] == idxs
+    amt = jnp.where(live, amounts, 0)
+    counters = state.counters.at[rows, k, event].add(-amt, mode="drop")
+    return state._replace(counters=counters)
+
+
 def invalidate_rows(spec: WindowSpec, state: WindowState, rows: jnp.ndarray) -> WindowState:
     """Forget all history of ``rows`` (registry eviction → row reuse).
 

@@ -307,3 +307,49 @@ def test_cluster_server_stat_log(tmp_path, monkeypatch):
     server.stat_log.flush()
     text = (tmp_path / "sentinel-cluster-server.log").read_text()
     assert "flow-9,pass" in text and "flow-9,block" in text
+
+
+def test_transport_config_change_restarts_server():
+    """ServerTransportConfig watcher analog (SentinelDefaultTokenServer):
+    a port change restarts the listener on the new port; idle change
+    applies live without a restart."""
+    import socket
+
+    from sentinel_tpu.cluster.server import ClusterTokenServer
+    from sentinel_tpu.parallel.cluster import (
+        THRESHOLD_GLOBAL, ClusterEngine, ClusterFlowRule, ClusterSpec,
+    )
+
+    engine = ClusterEngine(ClusterSpec(n_shards=1, flows_per_shard=16,
+                                       namespaces=2))
+    server = ClusterTokenServer(engine, host="127.0.0.1", port=0,
+                                clock=ManualClock(start_ms=NOW0))
+    server.load_flow_rules("ns", [ClusterFlowRule(
+        flow_id=3, count=100, threshold_type=THRESHOLD_GLOBAL)])
+    server.start()
+    old_port = server.port
+    try:
+        # idle change: live, no restart (port unchanged)
+        server.update_transport_config(idle_seconds=42)
+        assert server.idle_seconds == 42 and server.port == old_port
+
+        # pick a fresh free port, then flip the transport config to it
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        new_port = probe.getsockname()[1]
+        probe.close()
+        server.update_transport_config(port=new_port)
+        assert server.port == new_port
+
+        cli = ClusterTokenClient("127.0.0.1", new_port, namespace="ns",
+                                 request_timeout_ms=60_000)
+        cli.start()
+        try:
+            assert cli.request_token(3, 1).status == 0
+        finally:
+            cli.stop()
+        # the old port no longer accepts
+        with pytest.raises(OSError):
+            socket.create_connection(("127.0.0.1", old_port), timeout=0.5)
+    finally:
+        server.stop()

@@ -298,6 +298,27 @@ def test_in_out_alternation_does_not_burn_budget(clk):
     assert sph._fast.lease_renewals <= 2
 
 
+def test_expired_lease_returns_unused_tokens_to_metrics(clk):
+    """A lease pre-charge fronts PASS for the whole chunk (the admission
+    ledger must see reservations), but once the bucket rotates the unused
+    remainder is subtracted back — pass metrics count ADMISSIONS."""
+    sph = make(clk, minute_enabled=True)
+    sph.load_flow_rules([stpu.FlowRule(resource="api", count=100.0)])
+    for _ in range(5):                    # chunk=25 pre-charged, 5 used
+        with sph.entry("api"):
+            pass
+    clk.advance_ms(600)                   # bucket rotates
+    with sph.entry("api"):                # triggers expiry + new lease
+        pass
+    clk.advance_ms(600)
+    sph._flush_fast()
+    clk.advance_ms(1500)
+    # minute-ring per-second view shows true admissions for the T0 second:
+    # 5 at T0 plus 1 at T0+600 — NOT the 25-token chunk reservations
+    nodes = {n.resource: n for n in sph.metrics_snapshot(T0)}
+    assert nodes["api"].pass_qps == 6
+
+
 def test_mixed_fast_and_batch_traffic_consistent(clk):
     """Host-admitted passes are visible to later device decides after the
     flush (bounded staleness, conservative direction)."""

@@ -80,3 +80,29 @@ def test_geometry_mismatch_restores_cold(tmp_path):
 def test_missing_snapshot_is_cold(tmp_path):
     b = make(ManualClock(start_ms=T0))
     assert load_state(b, str(tmp_path / "nope")) is False
+
+
+def test_rule_change_restores_windows_partially(tmp_path):
+    """Degraded restore-what-matches: the snapshot was taken under OTHER
+    rules → window counters (row-keyed) carry over, slot-indexed pacing /
+    breaker state stays cold."""
+    clk = ManualClock(start_ms=T0)
+    a = make(clk)
+    a.load_flow_rules([stpu.FlowRule(resource="svc", count=100)])
+    for _ in range(5):
+        with a.entry("svc"):
+            pass
+    a._flush_fast()
+    save_state(a, str(tmp_path / "snap"))
+
+    b = make(ManualClock(start_ms=T0 + 50))
+    b.load_flow_rules([stpu.FlowRule(resource="svc", count=5)])  # CHANGED
+    assert load_state(b, str(tmp_path / "snap")) == "partial"
+    # the 5 restored window passes count against the new tighter budget
+    assert b.node_totals("svc")["pass"] == 5
+    with pytest.raises(stpu.BlockException):
+        b.entry("svc")
+    # same-rules restore still reports full
+    c = make(ManualClock(start_ms=T0 + 50))
+    c.load_flow_rules([stpu.FlowRule(resource="svc", count=100)])
+    assert load_state(c, str(tmp_path / "snap")) == "full"
