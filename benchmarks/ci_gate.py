@@ -1,29 +1,37 @@
 """CI perf-regression gate: run the headline bench at CI-sized shapes on
-the CPU backend and fail on a >2× regression of decisions/sec against the
-committed baseline.
+the CPU backend and fail on a large regression of decisions/sec.
 
 Usage:
     python benchmarks/ci_gate.py            # gate (exit 1 on regression)
     python benchmarks/ci_gate.py --update   # re-baseline after intentional
                                             # perf-relevant changes
 
-The baseline is machine-relative noise-prone, so the gate (a) uses a 2×
-margin, (b) takes the best of three runs, and (c) stores a deliberately
-conservative floor (half the measured rate at update time). It catches the
-failure mode that matters — an accidental 10× step cost (lost fusion,
-accidental sync, per-event host loop) — not 20% drift.
+The committed baseline is machine-relative, so it is only *enforced* on a
+machine with the same fingerprint (cpu count + node name) that produced it
+— there the gate uses a 2× margin over the best of three runs. On any other
+machine (e.g. a shared CI runner of a different hardware class) the gate
+falls back to an absolute sanity floor instead: the failure mode that
+matters — an accidental per-event host loop, lost fusion, or an accidental
+device sync per event — costs 3-5 orders of magnitude, which the sanity
+floor catches on any hardware, while honest 2-4× machine-class differences
+pass. Run ``--update`` on the machine whose floor you want enforced.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import platform
 import subprocess
 import sys
 from pathlib import Path
 
 HERE = Path(__file__).resolve().parent
 BASELINE_FILE = HERE / "ci_baseline.json"
+
+# any machine that can run the suite at all clears this unless the fused
+# step degenerates into per-event Python/host work
+SANITY_FLOOR_DECISIONS_PER_SEC = 1e6
 
 ENV = {
     **os.environ,
@@ -33,6 +41,10 @@ ENV = {
     "BENCH_STEPS": "20",
     "BENCH_RULES": "256",
 }
+
+
+def fingerprint() -> str:
+    return f"{platform.node()}/{os.cpu_count()}cpu"
 
 
 def measure_once() -> float:
@@ -48,16 +60,23 @@ def main() -> int:
     if "--update" in sys.argv:
         BASELINE_FILE.write_text(json.dumps(
             {"cpu_decisions_per_sec_floor": best / 2,
-             "measured_at_update": best}, indent=1))
-        print(f"baseline updated: floor={best / 2:.0f} (measured {best:.0f})")
+             "measured_at_update": best,
+             "machine": fingerprint()}, indent=1))
+        print(f"baseline updated: floor={best / 2:.0f} (measured {best:.0f}) "
+              f"on {fingerprint()}")
         return 0
     baseline = json.loads(BASELINE_FILE.read_text())
-    floor = baseline["cpu_decisions_per_sec_floor"]
-    print(json.dumps({"measured": best, "floor": floor,
-                      "ratio_vs_floor": round(best / floor, 2)}))
+    same_machine = baseline.get("machine") == fingerprint()
+    floor = (baseline["cpu_decisions_per_sec_floor"] if same_machine
+             else SANITY_FLOOR_DECISIONS_PER_SEC)
+    print(json.dumps({
+        "measured": best, "floor": floor,
+        "mode": "baseline-machine" if same_machine else "sanity-floor",
+        "ratio_vs_floor": round(best / floor, 2)}))
     if best < floor:
         print(f"PERF REGRESSION: {best:.0f} decisions/s < floor {floor:.0f} "
-              f"(>2x below the rate at baseline time)", file=sys.stderr)
+              f"({'>2x below the rate at baseline time' if same_machine else 'below the absolute sanity floor — the fused step has degenerated'})",
+              file=sys.stderr)
         return 1
     return 0
 

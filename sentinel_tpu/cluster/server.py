@@ -145,14 +145,27 @@ class ClusterTokenServer:
                     raise
 
     def start(self) -> None:
-        """Run the server on a daemon thread; returns once listening."""
+        """Run the server on a daemon thread; returns once listening. A bind
+        failure (port in use) surfaces immediately — the boot exception is
+        handed back through ``_boot_error`` rather than waiting out the
+        10 s timeout, so a transport-config restart's rollback window stays
+        at milliseconds."""
         if self._thread is not None:
             return
+        self._boot_error: Optional[BaseException] = None
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="sentinel-cluster-server")
         self._thread.start()
         if not self._started.wait(timeout=10):
             raise RuntimeError("cluster token server failed to start")
+        if self._boot_error is not None:
+            self._thread.join(timeout=1)
+            self._thread = None
+            self._loop = None
+            self._started.clear()
+            exc, self._boot_error = self._boot_error, None
+            raise RuntimeError(
+                f"cluster token server failed to start: {exc}") from exc
 
     def stop(self) -> None:
         if self._loop is None:
@@ -192,7 +205,13 @@ class ClusterTokenServer:
             loop.create_task(self._idle_loop())
             self._started.set()
 
-        loop.run_until_complete(boot())
+        try:
+            loop.run_until_complete(boot())
+        except BaseException as exc:    # bind failure → report, clean up
+            self._boot_error = exc
+            self._started.set()
+            loop.close()
+            return
         try:
             loop.run_forever()
         finally:

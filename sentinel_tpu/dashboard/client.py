@@ -19,6 +19,13 @@ from sentinel_tpu.metrics.node import MetricNode
 
 DEFAULT_TIMEOUT_S = 3.0
 
+# gateway rule families speak their own commands (reference
+# ``SentinelApiClient.fetchApis/modifyApis`` + ``GatewayFlowRuleController``)
+_GATEWAY_GET = {"gatewayFlow": "gateway/getRules",
+                "gatewayApi": "gateway/getApiDefinitions"}
+_GATEWAY_SET = {"gatewayFlow": "gateway/updateRules",
+                "gatewayApi": "gateway/updateApiDefinitions"}
+
 
 class AgentUnreachable(Exception):
     pass
@@ -56,13 +63,20 @@ class SentinelApiClient:
 
     def fetch_rules(self, ip: str, port: int,
                     rule_type: str) -> List[Dict[str, Any]]:
-        text = self._get(ip, port, "getRules", {"type": rule_type})
+        if rule_type in _GATEWAY_GET:
+            text = self._get(ip, port, _GATEWAY_GET[rule_type])
+        else:
+            text = self._get(ip, port, "getRules", {"type": rule_type})
         return json.loads(text or "[]")
 
     def set_rules(self, ip: str, port: int, rule_type: str,
                   rules: List[Dict[str, Any]]) -> bool:
-        resp = self._post(ip, port, "setRules", {
-            "type": rule_type, "data": json.dumps(rules)})
+        if rule_type in _GATEWAY_SET:
+            resp = self._post(ip, port, _GATEWAY_SET[rule_type],
+                              {"data": json.dumps(rules)})
+        else:
+            resp = self._post(ip, port, "setRules", {
+                "type": rule_type, "data": json.dumps(rules)})
         return "success" in resp
 
     def fetch_metrics(self, ip: str, port: int, start_ms: int,

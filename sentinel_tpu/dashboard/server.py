@@ -17,7 +17,9 @@ Routes (all JSON wrapped in the reference's ``Result`` envelope
 - ``GET  /``                            single-file JS UI
 
 Rule types: flow, degrade, system, authority, paramFlow (agent command
-``getRules``/``setRules`` type keys).
+``getRules``/``setRules`` type keys), plus gatewayFlow / gatewayApi
+(``gateway/getRules|updateRules|getApiDefinitions|updateApiDefinitions``,
+reference ``GatewayFlowRuleController`` / ``GatewayApiController``).
 """
 
 from __future__ import annotations
@@ -39,7 +41,8 @@ from sentinel_tpu.dashboard.repository import (
     InMemoryMetricsRepository, MetricEntity, RuleEntity, RuleRepository,
 )
 
-RULE_TYPES = ("flow", "degrade", "system", "authority", "paramFlow")
+RULE_TYPES = ("flow", "degrade", "system", "authority", "paramFlow",
+              "gatewayFlow", "gatewayApi")
 
 _STATIC_DIR = Path(__file__).parent / "static"
 
@@ -165,8 +168,15 @@ class Dashboard:
         """Round-trip through the rule codec so stored dicts carry every
         field with defaults — identical to what agents echo back from
         ``getRules`` (otherwise re-pulls can't match repo ids)."""
-        from sentinel_tpu.rules import codec
         try:
+            if rtype == "gatewayFlow":
+                from sentinel_tpu.gateway import codec as gw
+                return gw.gateway_rule_to_dict(gw.gateway_rule_from_dict(rule))
+            if rtype == "gatewayApi":
+                from sentinel_tpu.gateway import codec as gw
+                return gw.api_definition_to_dict(
+                    gw.api_definition_from_dict(rule))
+            from sentinel_tpu.rules import codec
             return json.loads(codec.rules_to_json(
                 rtype, codec.rules_from_json(rtype, json.dumps([rule]))))[0]
         except (ValueError, KeyError, TypeError):
@@ -377,6 +387,13 @@ class _Handler(BaseHTTPRequestHandler):
                 nodes = d.client.fetch_cluster_nodes(
                     q.get("ip", ""), int(q.get("port", "0") or 0))
                 self._json(_ok(nodes))
+            except AgentUnreachable as exc:
+                self._json(_fail(str(exc)))
+            return
+        if method == "GET" and path == "/resource/jsonTree.json":
+            try:
+                self._json(_ok(d.client.fetch_json_tree(
+                    q.get("ip", ""), int(q.get("port", "0") or 0))))
             except AgentUnreachable as exc:
                 self._json(_fail(str(exc)))
             return

@@ -1,0 +1,857 @@
+"use strict";
+/* Operator dashboard SPA (reference: sentinel-dashboard AngularJS webapp —
+   app list, machine discovery, realtime per-resource charts, rule editors
+   for every rule family, cluster topology/assign — rebuilt dependency-free
+   against the Python dashboard's REST surface). */
+
+// ------------------------------------------------------------------ helpers
+const $ = (sel) => document.querySelector(sel);
+
+function h(tag, attrs = {}, children = []) {
+  const e = document.createElement(tag);
+  for (const [k, v] of Object.entries(attrs)) {
+    if (k === "class") e.className = v;
+    else if (k.startsWith("on")) e[k] = v;
+    else if (k === "html") e.innerHTML = v;
+    else e.setAttribute(k, v);
+  }
+  for (const c of [].concat(children)) {
+    if (c == null) continue;
+    e.appendChild(typeof c === "string" ? document.createTextNode(c) : c);
+  }
+  return e;
+}
+
+async function api(path, opts) {
+  const r = await fetch(path, opts);
+  const j = await r.json();
+  if (j && j.code === 401) { showLogin(true); return null; }
+  return j;
+}
+const post = (path, body, method = "POST") => api(path, {
+  method, body: body === undefined ? undefined : JSON.stringify(body),
+  headers: { "Content-Type": "application/json" } });
+
+function getPath(obj, path) {
+  return path.split(".").reduce((o, k) => (o == null ? o : o[k]), obj);
+}
+function setPath(obj, path, v) {
+  const ks = path.split("."), last = ks.pop();
+  let o = obj;
+  for (const k of ks) o = (o[k] = o[k] || {});
+  o[last] = v;
+}
+
+// ------------------------------------------------------------------ state
+const S = {
+  apps: [], app: null, view: "metrics", timer: null,
+  machines: [], machineSel: "", range: 300, chartData: {},
+};
+
+function setRefresh(fn, ms) {
+  clearInterval(S.timer);
+  if (fn) { S.timer = setInterval(fn, ms); }
+}
+
+// ------------------------------------------------------------------ auth
+function showLogin(on) {
+  $("#login").style.display = on ? "" : "none";
+  $("#app").style.display = on ? "none" : "flex";
+  if (on) setRefresh(null);
+}
+async function doLogin(ev) {
+  ev.preventDefault();
+  // raw fetch: api() would swallow the 401 envelope of a bad password
+  const r = await fetch("/auth/login", { method: "POST",
+    body: JSON.stringify({ username: $("#u").value, password: $("#p").value }),
+    headers: { "Content-Type": "application/json" } });
+  const j = await r.json();
+  if (!j.success) { $("#lerr").textContent = j.msg; return false; }
+  $("#who").textContent = j.data.username;
+  showLogin(false); boot();
+  return false;
+}
+async function doLogout() {
+  await post("/auth/logout", {});
+  showLogin(true);
+}
+
+// ------------------------------------------------------------------ router
+const RULE_VIEWS = ["flow", "degrade", "paramFlow", "system", "authority",
+                    "gatewayFlow", "gatewayApi"];
+const VIEW_TITLES = {
+  metrics: "Realtime Metrics", resources: "Resource View",
+  machines: "Machine List", cluster: "Cluster Management",
+  flow: "Flow Rules", degrade: "Degrade Rules", paramFlow: "Param Flow Rules",
+  system: "System Rules", authority: "Authority Rules",
+  gatewayFlow: "Gateway Flow Rules", gatewayApi: "API Definitions",
+};
+
+function nav(app, view) {
+  location.hash = `#/${encodeURIComponent(app)}/${view}`;
+}
+function route() {
+  const m = location.hash.match(/^#\/([^/]+)\/([^/]+)/);
+  if (m) {
+    S.app = decodeURIComponent(m[1]);
+    S.view = VIEW_TITLES[m[2]] ? m[2] : "metrics";
+  }
+  render();
+}
+window.addEventListener("hashchange", route);
+
+// ------------------------------------------------------------------ boot
+async function boot() {
+  const j = await api("/app/names.json");
+  if (!j) return;
+  S.apps = j.data || [];
+  if (!S.app || !S.apps.includes(S.app)) S.app = S.apps[0] || null;
+  route();
+}
+
+// ------------------------------------------------------------------ sidebar
+function renderSidebar() {
+  const navEl = $("#nav");
+  navEl.innerHTML = "";
+  navEl.appendChild(h("h4", {}, "Applications"));
+  for (const a of S.apps) {
+    navEl.appendChild(h("div", {
+      class: "item" + (a === S.app ? " sel" : ""),
+      onclick: () => nav(a, S.view) }, a));
+  }
+  if (!S.app) {
+    navEl.appendChild(h("div", { class: "dim" },
+      "no apps yet — waiting for heartbeats"));
+    return;
+  }
+  const menu = [["metrics", "Realtime Metrics"], ["resources", "Resource View"],
+                ["machines", "Machine List"], ["cluster", "Cluster"]];
+  navEl.appendChild(h("h4", {}, "Monitor"));
+  for (const [v, label] of menu) {
+    navEl.appendChild(h("div", {
+      class: "item" + (v === S.view ? " sel" : ""),
+      onclick: () => nav(S.app, v) }, label));
+  }
+  navEl.appendChild(h("h4", {}, "Rules"));
+  for (const v of RULE_VIEWS) {
+    navEl.appendChild(h("div", {
+      class: "item" + (v === S.view ? " sel" : ""),
+      onclick: () => nav(S.app, v) }, VIEW_TITLES[v]));
+  }
+}
+
+// ------------------------------------------------------------------ render
+function render() {
+  renderSidebar();
+  const c = $("#content");
+  c.innerHTML = "";
+  setRefresh(null);
+  if (!S.app) { c.appendChild(h("div", { class: "empty" }, "No applications registered. Start an agent with a HeartbeatSender pointed at this dashboard.")); return; }
+  if (S.view === "metrics") return viewMetrics(c);
+  if (S.view === "resources") return viewResources(c);
+  if (S.view === "machines") return viewMachines(c);
+  if (S.view === "cluster") return viewCluster(c);
+  return viewRules(c, S.view);
+}
+
+// ------------------------------------------------------------------ charts
+function drawChart(cv, pts, hover) {
+  const dpr = window.devicePixelRatio || 1;
+  const W = cv.width = cv.clientWidth * dpr, H = cv.height = 170 * dpr;
+  const ctx = cv.getContext("2d");
+  ctx.clearRect(0, 0, W, H);
+  const padL = 44 * dpr, padR = 44 * dpr, padT = 8 * dpr, padB = 20 * dpr;
+  const plotW = W - padL - padR, plotH = H - padT - padB;
+  ctx.font = `${11 * dpr}px system-ui`;
+  if (!pts.length) {
+    ctx.fillStyle = "#7f8ea0";
+    ctx.fillText("no data in range", padL, H / 2);
+    return null;
+  }
+  const qMax = Math.max(1, ...pts.map(e => Math.max(e.passQps, e.blockQps)));
+  const rMax = Math.max(1, ...pts.map(e => e.rt));
+  const t0 = pts[0].timestamp, t1 = pts[pts.length - 1].timestamp;
+  const x = (t) => padL + (t1 === t0 ? plotW / 2
+                                     : (t - t0) * plotW / (t1 - t0));
+  const yQ = (v) => padT + plotH - v * plotH / qMax;
+  const yR = (v) => padT + plotH - v * plotH / rMax;
+  // gridlines + axes labels
+  ctx.strokeStyle = "#2a3442"; ctx.fillStyle = "#7f8ea0";
+  ctx.lineWidth = 1;
+  for (let i = 0; i <= 4; i++) {
+    const gy = padT + plotH * i / 4;
+    ctx.beginPath(); ctx.moveTo(padL, gy); ctx.lineTo(W - padR, gy);
+    ctx.stroke();
+    ctx.textAlign = "right";
+    ctx.fillText(String(Math.round(qMax * (4 - i) / 4)), padL - 5 * dpr,
+                 gy + 4 * dpr);
+    ctx.textAlign = "left";
+    ctx.fillText(String(Math.round(rMax * (4 - i) / 4)), W - padR + 5 * dpr,
+                 gy + 4 * dpr);
+  }
+  // x time labels
+  ctx.textAlign = "center";
+  for (let i = 0; i <= 3; i++) {
+    const t = t0 + (t1 - t0) * i / 3;
+    ctx.fillText(new Date(t).toTimeString().slice(0, 8),
+                 x(t), H - 5 * dpr);
+  }
+  const line = (key, color, yf) => {
+    ctx.beginPath(); ctx.strokeStyle = color; ctx.lineWidth = 2 * dpr;
+    pts.forEach((e, i) => i ? ctx.lineTo(x(e.timestamp), yf(e[key]))
+                            : ctx.moveTo(x(e.timestamp), yf(e[key])));
+    ctx.stroke();
+  };
+  line("passQps", "#3fb97f", yQ);
+  line("blockQps", "#e06c5c", yQ);
+  line("rt", "#4da3ff", yR);
+  if (hover != null) {
+    const hx = x(hover.timestamp);
+    ctx.strokeStyle = "#7f8ea0"; ctx.lineWidth = 1;
+    ctx.setLineDash([3 * dpr, 3 * dpr]);
+    ctx.beginPath(); ctx.moveTo(hx, padT); ctx.lineTo(hx, padT + plotH);
+    ctx.stroke(); ctx.setLineDash([]);
+  }
+  return { x, t0, t1, padL, padR, dpr };
+}
+
+function attachTooltip(cv, getPts) {
+  cv.onmousemove = (ev) => {
+    const pts = getPts();
+    if (!pts.length) return;
+    const rect = cv.getBoundingClientRect();
+    const dpr = window.devicePixelRatio || 1;
+    const mx = (ev.clientX - rect.left) * dpr;
+    let best = null, bestD = Infinity;
+    const geo = drawChart(cv, pts, null);
+    if (!geo) return;
+    for (const p of pts) {
+      const d = Math.abs(geo.x(p.timestamp) - mx);
+      if (d < bestD) { bestD = d; best = p; }
+    }
+    drawChart(cv, pts, best);
+    let tip = $("#tooltip");
+    if (!tip) { tip = h("div", { id: "tooltip", class: "tooltip" }); document.body.appendChild(tip); }
+    tip.innerHTML =
+      `<b>${new Date(best.timestamp).toTimeString().slice(0, 8)}</b><br>` +
+      `pass ${best.passQps} · block ${best.blockQps} · ` +
+      `ok ${best.successQps} · err ${best.exceptionQps}<br>` +
+      `rt ${best.rt} ms` + (best.count > 1 ? ` · ${best.count} machines` : "");
+    tip.style.left = (ev.clientX + 14) + "px";
+    tip.style.top = (ev.clientY + 10) + "px";
+    tip.style.display = "";
+  };
+  cv.onmouseleave = () => {
+    const tip = $("#tooltip");
+    if (tip) tip.style.display = "none";
+    drawChart(cv, getPts(), null);
+  };
+}
+
+// ------------------------------------------------------------------ metrics
+async function viewMetrics(c) {
+  const head = h("div", { class: "card" }, [
+    h("h3", {}, [
+      h("span", {}, `Realtime Metrics — ${S.app}`),
+      h("span", { class: "toolbar" }, [
+        h("span", { class: "legend", html:
+          '<i style="background:#3fb97f"></i>pass' +
+          '<i style="background:#e06c5c"></i>block' +
+          '<i style="background:#4da3ff"></i>rt (ms, right axis)' }),
+        (() => {
+          const sel = h("select", { onchange: (e) => {
+            S.range = +e.target.value; refresh(); } },
+            [[60, "last 1 min"], [300, "last 5 min"]].map(([v, l]) =>
+              h("option", v === S.range ? { value: v, selected: "" }
+                                        : { value: v }, l)));
+          return sel;
+        })(),
+      ]),
+    ]),
+  ]);
+  const box = h("div", {});
+  c.appendChild(head); c.appendChild(box);
+  const cards = {};   // resource -> {cv}
+  async function refresh() {
+    const j = await api(`/metric/resources.json?app=${encodeURIComponent(S.app)}`);
+    if (!j) return;
+    const end = Date.now(), start = end - S.range * 1000;
+    const resources = (j.data || []).slice(0, 12);
+    if (!resources.length && !box.childElementCount) {
+      box.appendChild(h("div", { class: "empty" },
+        "no metrics yet — traffic appears here within ~10 s of the fetcher polling agents"));
+    }
+    for (const res of resources) {
+      if (!cards[res]) {
+        const cv = h("canvas", { class: "chart" });
+        box.appendChild(h("div", { class: "card" }, [
+          h("h3", {}, [h("span", {}, res)]), cv]));
+        cards[res] = { cv };
+        attachTooltip(cv, () => S.chartData[res] || []);
+      }
+      const m = await api(`/metric/queryByAppAndResource.json?app=${encodeURIComponent(S.app)}&identity=${encodeURIComponent(res)}&startTime=${start}&endTime=${end}`);
+      if (m) {
+        S.chartData[res] = m.data || [];
+        drawChart(cards[res].cv, S.chartData[res], null);
+      }
+    }
+  }
+  await refresh();
+  setRefresh(refresh, 5000);
+}
+
+// ------------------------------------------------------------------ machines
+function heartbeatAge(m) {
+  return Math.max(0, Math.round((Date.now() - m.lastHeartbeat) / 1000));
+}
+async function loadMachines() {
+  const j = await api(`/app/${encodeURIComponent(S.app)}/machines.json`);
+  S.machines = j ? (j.data || []) : [];
+  return S.machines;
+}
+async function viewMachines(c) {
+  const tbody = h("tbody", {});
+  c.appendChild(h("div", { class: "card" }, [
+    h("h3", {}, [h("span", {}, `Machines — ${S.app}`)]),
+    h("table", {}, [h("thead", {}, h("tr", {}, [
+      "hostname", "ip:port", "sentinel version", "heartbeat age", "status",
+    ].map(t => h("th", {}, t)))), tbody]),
+  ]));
+  async function refresh() {
+    await loadMachines();
+    tbody.innerHTML = "";
+    for (const m of S.machines) {
+      tbody.appendChild(h("tr", {}, [
+        h("td", {}, m.hostname || "—"),
+        h("td", {}, `${m.ip}:${m.port}`),
+        h("td", {}, m.version || "—"),
+        h("td", {}, `${heartbeatAge(m)} s ago`),
+        h("td", {}, h("span", {
+          class: "badge " + (m.healthy ? "ok" : "bad") },
+          m.healthy ? "healthy" : "lost")),
+      ]));
+    }
+    if (!S.machines.length) {
+      tbody.appendChild(h("tr", {}, h("td", { colspan: 5, class: "dim" },
+        "no machines")));
+    }
+  }
+  await refresh();
+  setRefresh(refresh, 5000);
+}
+
+// ------------------------------------------------------------------ resources
+async function viewResources(c) {
+  await loadMachines();
+  const healthy = S.machines.filter(m => m.healthy);
+  if (!S.machineSel || !healthy.some(m => `${m.ip}:${m.port}` === S.machineSel)) {
+    S.machineSel = healthy.length ? `${healthy[0].ip}:${healthy[0].port}` : "";
+  }
+  const sel = h("select", { onchange: (e) => { S.machineSel = e.target.value; refresh(); } },
+    healthy.map(m => {
+      const v = `${m.ip}:${m.port}`;
+      return h("option", v === S.machineSel ? { value: v, selected: "" }
+                                            : { value: v }, v);
+    }));
+  const tbody = h("tbody", {});
+  c.appendChild(h("div", { class: "card" }, [
+    h("h3", {}, [h("span", {}, `Resource View — ${S.app}`),
+                 h("span", { class: "toolbar" }, [
+                   h("span", { class: "sub" }, "machine"), sel])]),
+    h("table", {}, [h("thead", {}, h("tr", {}, [
+      ["resource", ""], ["pass", "num"], ["block", "num"], ["total", "num"],
+      ["success", "num"], ["exception", "num"], ["rt ms", "num"],
+      ["threads", "num"], ["", ""],
+    ].map(([t, cl]) => h("th", { class: cl }, t)))), tbody]),
+  ]));
+  async function refresh() {
+    if (!S.machineSel) { tbody.innerHTML = ""; tbody.appendChild(h("tr", {}, h("td", { colspan: 9, class: "dim" }, "no healthy machine"))); return; }
+    const [ip, port] = S.machineSel.split(":");
+    const j = await api(`/resource/machineResource.json?ip=${ip}&port=${port}`);
+    tbody.innerHTML = "";
+    if (!j || !j.success) {
+      tbody.appendChild(h("tr", {}, h("td", { colspan: 9, class: "bad" },
+        j ? j.msg : "error")));
+      return;
+    }
+    for (const n of (j.data || [])) {
+      tbody.appendChild(h("tr", {}, [
+        h("td", {}, n.resource),
+        h("td", { class: "num ok" }, String(n.passQps)),
+        h("td", { class: "num " + (n.blockQps ? "bad" : "") }, String(n.blockQps)),
+        h("td", { class: "num" }, String(n.totalQps)),
+        h("td", { class: "num" }, String(n.successQps)),
+        h("td", { class: "num " + (n.exceptionQps ? "warn" : "") }, String(n.exceptionQps)),
+        h("td", { class: "num" }, String(n.averageRt)),
+        h("td", { class: "num" }, String(n.threadNum)),
+        h("td", {}, h("button", { class: "sm",
+          onclick: () => openRuleModal("flow", { resource: n.resource }) },
+          "+ flow rule")),
+      ]));
+    }
+    if (!(j.data || []).length) {
+      tbody.appendChild(h("tr", {}, h("td", { colspan: 9, class: "dim" },
+        "no live resources on this machine")));
+    }
+  }
+  await refresh();
+  setRefresh(refresh, 3000);
+}
+
+// ------------------------------------------------------------------ cluster
+const MODES = { "-1": "off", 0: "client", 1: "server" };
+async function viewCluster(c) {
+  const tbody = h("tbody", {});
+  const topo = h("div", {});
+  c.appendChild(h("div", { class: "card" }, [
+    h("h3", {}, [h("span", {}, `Cluster — ${S.app}`)]), topo]));
+  c.appendChild(h("div", { class: "card" }, [
+    h("h3", {}, [h("span", {}, "Machines"),
+      h("span", { class: "sub" },
+        "assign = make that machine the token server, bind the rest as clients")]),
+    h("table", {}, [h("thead", {}, h("tr", {}, [
+      "machine", "mode", "token server", "",
+    ].map(t => h("th", {}, t)))), tbody]),
+  ]));
+  async function refresh() {
+    const j = await api(`/cluster/state.json?app=${encodeURIComponent(S.app)}`);
+    if (!j) return;
+    const states = j.data || [];
+    tbody.innerHTML = "";
+    for (const s of states) {
+      const srv = s.serverPort ? `listening :${s.serverPort}`
+        : (s.serverHost ? `→ ${s.serverHost}:${s.clientServerPort ?? s.serverPort ?? ""}` : "—");
+      const modeSel = h("select", {},
+        Object.entries(MODES).map(([v, l]) =>
+          h("option", String(s.mode) === String(v)
+            ? { value: v, selected: "" } : { value: v }, l)));
+      tbody.appendChild(h("tr", {}, [
+        h("td", {}, `${s.ip}:${s.port}`),
+        h("td", {}, [modeSel, " ", h("button", { class: "sm", onclick: async () => {
+          await post("/cluster/mode", { app: S.app, ip: s.ip, port: s.port,
+                                        mode: +modeSel.value });
+          refresh();
+        } }, "apply")]),
+        h("td", {}, srv),
+        h("td", {}, h("button", { class: "sm primary", onclick: async () => {
+          const r = await post("/cluster/assign",
+            { app: S.app, serverIp: s.ip, serverPort: s.port });
+          if (r && !r.success) alert(r.msg);
+          refresh();
+        } }, "assign")),
+      ]));
+    }
+    if (!states.length) {
+      tbody.appendChild(h("tr", {}, h("td", { colspan: 4, class: "dim" },
+        "no machines")));
+    }
+    drawTopology(topo, states);
+  }
+  await refresh();
+  setRefresh(refresh, 10000);
+}
+
+function drawTopology(container, states) {
+  container.innerHTML = "";
+  if (!states.length) {
+    container.appendChild(h("div", { class: "empty" }, "no machines"));
+    return;
+  }
+  const server = states.find(s => s.mode === 1);
+  const others = states.filter(s => s !== server);
+  const W = 700, H = 240, ns = "http://www.w3.org/2000/svg";
+  const svg = document.createElementNS(ns, "svg");
+  svg.setAttribute("class", "topo");
+  svg.setAttribute("viewBox", `0 0 ${W} ${H}`);
+  const node = (x, y, label, cls) => {
+    const g = document.createElementNS(ns, "g");
+    g.setAttribute("class", cls);
+    const rect = document.createElementNS(ns, "rect");
+    rect.setAttribute("x", x - 75); rect.setAttribute("y", y - 18);
+    rect.setAttribute("width", 150); rect.setAttribute("height", 36);
+    rect.setAttribute("rx", 8);
+    const text = document.createElementNS(ns, "text");
+    text.setAttribute("x", x); text.setAttribute("y", y + 4);
+    text.setAttribute("text-anchor", "middle");
+    text.textContent = label;
+    g.appendChild(rect); g.appendChild(text);
+    svg.appendChild(g);
+  };
+  const edge = (x1, y1, x2, y2) => {
+    const l = document.createElementNS(ns, "line");
+    l.setAttribute("x1", x1); l.setAttribute("y1", y1);
+    l.setAttribute("x2", x2); l.setAttribute("y2", y2);
+    svg.appendChild(l);
+  };
+  const sx = W / 2, sy = 40;
+  const n = others.length, step = W / Math.max(1, n);
+  others.forEach((s, i) => {
+    const cx = step * (i + 0.5), cy = H - 50;
+    if (server) edge(sx, sy + 18, cx, cy - 18);
+    node(cx, cy, `${s.ip}:${s.port} (${MODES[String(s.mode)] ?? s.mode})`,
+         s.mode === 0 ? "cli" : "cli off");
+  });
+  if (server) {
+    node(sx, sy, `token server ${server.ip}:${server.port}` +
+         (server.serverPort ? ` :${server.serverPort}` : ""), "srv");
+  } else {
+    const t = document.createElementNS(ns, "text");
+    t.setAttribute("x", W / 2); t.setAttribute("y", 26);
+    t.setAttribute("text-anchor", "middle");
+    t.setAttribute("fill", "#7f8ea0");
+    t.textContent = "no token server assigned";
+    svg.appendChild(t);
+  }
+  container.appendChild(svg);
+}
+
+// ------------------------------------------------------------------ rules
+const E = {   // enum label maps (reference RuleConstant / gateway constants)
+  flowGrade: { 0: "Thread", 1: "QPS" },
+  strategy: { 0: "Direct", 1: "Relate", 2: "Chain" },
+  behavior: { 0: "Reject", 1: "Warm Up", 2: "Rate Limiter",
+              3: "Warm Up + Rate Limiter" },
+  degradeGrade: { 0: "Slow ratio (RT)", 1: "Exception ratio",
+                  2: "Exception count" },
+  authStrategy: { 0: "Whitelist", 1: "Blacklist" },
+  resourceMode: { 0: "Route ID", 1: "API Group" },
+  parseStrategy: { 0: "Client IP", 1: "Host", 2: "Header", 3: "URL Param",
+                   4: "Cookie" },
+  paramMatch: { 0: "Exact", 1: "Prefix", 2: "Regex", 3: "Contains" },
+  urlMatch: { 0: "Exact", 1: "Prefix", 2: "Regex" },
+  thresholdType: { 0: "Avg Local", 1: "Global" },
+};
+
+// field spec: n(ame/path) l(abel) k(ind: text num sel chk json) o(ptions)
+// d(efault) req show(fn of current values)
+const SCHEMAS = {
+  flow: [
+    { n: "resource", l: "Resource", k: "text", req: true },
+    { n: "limitApp", l: "Limit origin (limitApp)", k: "text", d: "default" },
+    { n: "grade", l: "Grade", k: "sel", o: E.flowGrade, d: 1 },
+    { n: "count", l: "Threshold", k: "num", d: 10 },
+    { n: "strategy", l: "Strategy", k: "sel", o: E.strategy, d: 0 },
+    { n: "refResource", l: "Ref resource / entrance", k: "text", d: "",
+      show: v => +v.strategy !== 0 },
+    { n: "controlBehavior", l: "Control behavior", k: "sel", o: E.behavior,
+      d: 0 },
+    { n: "warmUpPeriodSec", l: "Warm-up period (s)", k: "num", d: 10,
+      show: v => +v.controlBehavior === 1 || +v.controlBehavior === 3 },
+    { n: "maxQueueingTimeMs", l: "Max queueing time (ms)", k: "num", d: 500,
+      show: v => +v.controlBehavior === 2 || +v.controlBehavior === 3 },
+    { n: "clusterMode", l: "Cluster mode", k: "chk", d: false },
+    { n: "clusterConfig.flowId", l: "Cluster flow ID", k: "num", d: 0,
+      show: v => v.clusterMode },
+    { n: "clusterConfig.thresholdType", l: "Threshold type", k: "sel",
+      o: E.thresholdType, d: 0, show: v => v.clusterMode },
+    { n: "clusterConfig.fallbackToLocalWhenFail", l: "Fallback to local",
+      k: "chk", d: true, show: v => v.clusterMode },
+  ],
+  degrade: [
+    { n: "resource", l: "Resource", k: "text", req: true },
+    { n: "grade", l: "Strategy", k: "sel", o: E.degradeGrade, d: 0 },
+    { n: "count", l: "Threshold (max RT ms / ratio / count)", k: "num",
+      d: 0.5 },
+    { n: "slowRatioThreshold", l: "Slow ratio threshold", k: "num", d: 1.0,
+      show: v => +v.grade === 0 },
+    { n: "timeWindow", l: "Recovery window (s)", k: "num", d: 10 },
+    { n: "minRequestAmount", l: "Min request amount", k: "num", d: 5 },
+    { n: "statIntervalMs", l: "Stat interval (ms)", k: "num", d: 1000 },
+  ],
+  paramFlow: [
+    { n: "resource", l: "Resource", k: "text", req: true },
+    { n: "paramIdx", l: "Param index", k: "num", d: 0 },
+    { n: "grade", l: "Grade", k: "sel", o: E.flowGrade, d: 1 },
+    { n: "count", l: "Threshold", k: "num", d: 10 },
+    { n: "durationInSec", l: "Duration (s)", k: "num", d: 1 },
+    { n: "burstCount", l: "Burst", k: "num", d: 0 },
+    { n: "controlBehavior", l: "Control behavior", k: "sel",
+      o: { 0: "Reject", 2: "Rate Limiter" }, d: 0 },
+    { n: "maxQueueingTimeMs", l: "Max queueing time (ms)", k: "num", d: 0,
+      show: v => +v.controlBehavior === 2 },
+    { n: "paramFlowItemList", l: "Per-item overrides (JSON)", k: "json",
+      d: [], hint: '[{"object":"vip","count":100,"classType":"String"}]' },
+    { n: "clusterMode", l: "Cluster mode", k: "chk", d: false },
+    { n: "clusterConfig.flowId", l: "Cluster flow ID", k: "num", d: 0,
+      show: v => v.clusterMode },
+  ],
+  system: [
+    { n: "highestSystemLoad", l: "Max load1 (-1 = off)", k: "num", d: -1 },
+    { n: "highestCpuUsage", l: "Max CPU usage 0..1 (-1 = off)", k: "num",
+      d: -1 },
+    { n: "qps", l: "Max total QPS (-1 = off)", k: "num", d: -1 },
+    { n: "avgRt", l: "Max avg RT ms (-1 = off)", k: "num", d: -1 },
+    { n: "maxThread", l: "Max threads (-1 = off)", k: "num", d: -1 },
+  ],
+  authority: [
+    { n: "resource", l: "Resource", k: "text", req: true },
+    { n: "limitApp", l: "Origins (comma-separated)", k: "text", req: true },
+    { n: "strategy", l: "Mode", k: "sel", o: E.authStrategy, d: 0 },
+  ],
+  gatewayFlow: [
+    { n: "resource", l: "Route ID / API group", k: "text", req: true },
+    { n: "resourceMode", l: "Resource mode", k: "sel", o: E.resourceMode,
+      d: 0 },
+    { n: "grade", l: "Grade", k: "sel", o: E.flowGrade, d: 1 },
+    { n: "count", l: "Threshold", k: "num", d: 10 },
+    { n: "intervalSec", l: "Interval (s)", k: "num", d: 1 },
+    { n: "controlBehavior", l: "Control behavior", k: "sel",
+      o: { 0: "Reject", 2: "Rate Limiter" }, d: 0 },
+    { n: "burst", l: "Burst", k: "num", d: 0 },
+    { n: "maxQueueingTimeoutMs", l: "Max queueing timeout (ms)", k: "num",
+      d: 500, show: v => +v.controlBehavior === 2 },
+    { n: "_hasParam", l: "Limit by request attribute", k: "chk", d: false,
+      virtual: true },
+    { n: "paramItem.parseStrategy", l: "Attribute", k: "sel",
+      o: E.parseStrategy, d: 0, show: v => v._hasParam },
+    { n: "paramItem.fieldName", l: "Field name (header/param/cookie)",
+      k: "text", d: "", show: v => v._hasParam && +getPath(v, "paramItem.parseStrategy") >= 2 },
+    { n: "paramItem.pattern", l: "Match pattern (optional)", k: "text", d: "",
+      show: v => v._hasParam },
+    { n: "paramItem.matchStrategy", l: "Match strategy", k: "sel",
+      o: E.paramMatch, d: 0, show: v => v._hasParam && !!getPath(v, "paramItem.pattern") },
+  ],
+  gatewayApi: [
+    { n: "apiName", l: "API group name", k: "text", req: true },
+    { n: "predicateItems", l: "Path predicates (JSON)", k: "json",
+      d: [{ pattern: "/", matchStrategy: 1 }],
+      hint: '[{"pattern":"/foo/**","matchStrategy":1}] — 0 exact, 1 prefix, 2 regex' },
+  ],
+};
+
+// columns shown in each rule table: [header, render(rule)]
+const COLS = {
+  flow: [
+    ["resource", r => r.resource],
+    ["origin", r => r.limitApp],
+    ["grade", r => E.flowGrade[r.grade] ?? r.grade],
+    ["threshold", r => r.count],
+    ["strategy", r => E.strategy[r.strategy] ?? r.strategy],
+    ["behavior", r => E.behavior[r.controlBehavior] ?? r.controlBehavior],
+    ["cluster", r => r.clusterMode ? `yes (#${r.clusterConfig?.flowId ?? 0})` : "no"],
+  ],
+  degrade: [
+    ["resource", r => r.resource],
+    ["strategy", r => E.degradeGrade[r.grade] ?? r.grade],
+    ["threshold", r => r.count],
+    ["recovery (s)", r => r.timeWindow],
+    ["min requests", r => r.minRequestAmount],
+    ["stat interval", r => `${r.statIntervalMs} ms`],
+  ],
+  paramFlow: [
+    ["resource", r => r.resource],
+    ["param idx", r => r.paramIdx],
+    ["grade", r => E.flowGrade[r.grade] ?? r.grade],
+    ["threshold", r => r.count],
+    ["duration (s)", r => r.durationInSec],
+    ["items", r => (r.paramFlowItemList || []).length],
+    ["cluster", r => r.clusterMode ? "yes" : "no"],
+  ],
+  system: [
+    ["load1", r => r.highestSystemLoad],
+    ["cpu", r => r.highestCpuUsage],
+    ["qps", r => r.qps],
+    ["avg rt", r => r.avgRt],
+    ["threads", r => r.maxThread],
+  ],
+  authority: [
+    ["resource", r => r.resource],
+    ["origins", r => r.limitApp],
+    ["mode", r => E.authStrategy[r.strategy] ?? r.strategy],
+  ],
+  gatewayFlow: [
+    ["resource", r => r.resource],
+    ["mode", r => E.resourceMode[r.resourceMode] ?? r.resourceMode],
+    ["grade", r => E.flowGrade[r.grade] ?? r.grade],
+    ["threshold", r => `${r.count} / ${r.intervalSec}s`],
+    ["behavior", r => ({ 0: "Reject", 2: "Rate Limiter" })[r.controlBehavior] ?? r.controlBehavior],
+    ["param", r => r.paramItem
+      ? (E.parseStrategy[r.paramItem.parseStrategy] ?? "?") +
+        (r.paramItem.fieldName ? `:${r.paramItem.fieldName}` : "")
+      : "—"],
+  ],
+  gatewayApi: [
+    ["api group", r => r.apiName],
+    ["predicates", r => (r.predicateItems || [])
+      .map(p => `${E.urlMatch[p.matchStrategy] ?? "?"} ${p.pattern}`)
+      .join(", ")],
+  ],
+};
+
+async function viewRules(c, rtype) {
+  const tbody = h("tbody", {});
+  const errBox = h("div", { class: "err" });
+  const cols = COLS[rtype];
+  c.appendChild(h("div", { class: "card" }, [
+    h("h3", {}, [h("span", {}, `${VIEW_TITLES[rtype]} — ${S.app}`),
+      h("span", { class: "toolbar" }, [
+        h("button", { class: "sm", onclick: () => refreshRules(true) },
+          "reload from machines"),
+        h("button", { class: "sm primary",
+          onclick: () => openRuleModal(rtype) }, "+ new"),
+      ])]),
+    errBox,
+    h("table", {}, [h("thead", {}, h("tr", {},
+      [...cols.map(([t]) => h("th", {}, t)), h("th", {}, "")])), tbody]),
+  ]));
+  async function refreshRules() {
+    const j = await api(`/v1/${rtype}/rules?app=${encodeURIComponent(S.app)}`);
+    tbody.innerHTML = "";
+    errBox.textContent = (j && !j.success) ? j.msg : "";
+    const rules = (j && j.data) || [];
+    for (const r of rules) {
+      tbody.appendChild(h("tr", {}, [
+        ...cols.map(([, f]) => h("td", {}, String(f(r) ?? ""))),
+        h("td", {}, [
+          h("button", { class: "sm",
+            onclick: () => openRuleModal(rtype, r) }, "edit"),
+          " ",
+          h("button", { class: "sm danger", onclick: async () => {
+            if (!confirm("Delete this rule?")) return;
+            const d = await api(`/v1/${rtype}/rule/${r.id}`,
+                                { method: "DELETE" });
+            if (d && !d.success) alert(d.msg);
+            refreshRules();
+          } }, "delete"),
+        ]),
+      ]));
+    }
+    if (!rules.length) {
+      tbody.appendChild(h("tr", {}, h("td", {
+        colspan: cols.length + 1, class: "dim" }, "no rules")));
+    }
+  }
+  S.refreshRules = refreshRules;
+  await refreshRules();
+}
+
+// ------------------------------------------------------------------ modal
+function closeModal() {
+  const m = $("#modal-bg");
+  if (m) m.remove();
+}
+
+function openRuleModal(rtype, rule) {
+  closeModal();
+  if (S.view !== rtype) nav(S.app, rtype);
+  const editing = rule && rule.id;
+  const spec = SCHEMAS[rtype];
+  // working values: defaults <- existing rule
+  const vals = {};
+  for (const f of spec) {
+    const existing = rule ? getPath(rule, f.n) : undefined;
+    setPath(vals, f.n, existing !== undefined ? existing
+      : (f.k === "json" ? JSON.stringify(f.d) : f.d));
+  }
+  if (rtype === "gatewayFlow") vals._hasParam = !!(rule && rule.paramItem);
+  const err = h("div", { class: "err" });
+
+  function buildFields(form) {
+    form.innerHTML = "";
+    for (const f of spec) {
+      if (f.show && !f.show(vals)) continue;
+      const cur = getPath(vals, f.n);
+      let input;
+      if (f.k === "sel") {
+        input = h("select", { onchange: (e) => {
+          setPath(vals, f.n, +e.target.value); buildFields(form); } },
+          Object.entries(f.o).map(([v, l]) =>
+            h("option", String(cur) === String(v)
+              ? { value: v, selected: "" } : { value: v }, l)));
+      } else if (f.k === "chk") {
+        input = h("input", { type: "checkbox", onchange: (e) => {
+          setPath(vals, f.n, e.target.checked); buildFields(form); } });
+        input.checked = !!cur;
+      } else if (f.k === "json") {
+        input = h("textarea", { oninput: (e) => setPath(vals, f.n, e.target.value) });
+        input.value = typeof cur === "string" ? cur : JSON.stringify(cur);
+      } else {
+        input = h("input", {
+          type: f.k === "num" ? "number" : "text",
+          oninput: (e) => setPath(vals, f.n, e.target.value) });
+        input.value = cur ?? "";
+        if (f.k === "num") input.step = "any";
+        if (editing && f.n === "resource") input.disabled = true;
+      }
+      if (f.k === "chk") {
+        form.appendChild(h("div", { class: "field chk" },
+          [input, h("label", {}, f.l)]));
+      } else {
+        form.appendChild(h("div", { class: "field" }, [
+          h("label", {}, f.l + (f.req ? " *" : "")),
+          input,
+          f.hint ? h("div", { class: "legend" }, f.hint) : null,
+        ]));
+      }
+    }
+  }
+
+  function collect() {
+    const body = {};
+    for (const f of spec) {
+      if (f.show && !f.show(vals)) continue;
+      if (f.virtual) continue;
+      let v = getPath(vals, f.n);
+      if (f.k === "num") {
+        v = Number(v);
+        if (Number.isNaN(v)) throw new Error(`${f.l}: not a number`);
+      }
+      if (f.k === "json" && typeof v === "string") {
+        try { v = JSON.parse(v || "null"); }
+        catch (e) { throw new Error(`${f.l}: invalid JSON`); }
+      }
+      if (f.req && (v === "" || v == null)) {
+        throw new Error(`${f.l} is required`);
+      }
+      setPath(body, f.n, v);
+    }
+    // unchecking "limit by attribute" must clear a previously saved
+    // paramItem (PUT merges fields, so absence alone wouldn't remove it)
+    if (rtype === "gatewayFlow" && !vals._hasParam) body.paramItem = null;
+    return body;
+  }
+
+  const form = h("div", {});
+  buildFields(form);
+  const bg = h("div", { id: "modal-bg", onclick: (e) => {
+    if (e.target.id === "modal-bg") closeModal(); } }, [
+    h("div", { id: "modal" }, [
+      h("h3", {}, `${editing ? "Edit" : "New"} — ${VIEW_TITLES[rtype]}`),
+      form, err,
+      h("div", { class: "actions" }, [
+        h("button", { onclick: closeModal }, "Cancel"),
+        h("button", { class: "primary", onclick: async () => {
+          let body;
+          try { body = collect(); }
+          catch (e) { err.textContent = e.message; return; }
+          const j = editing
+            ? await post(`/v1/${rtype}/rule/${rule.id}`, body, "PUT")
+            : await post(`/v1/${rtype}/rule`, { app: S.app, ...body });
+          if (j && !j.success) { err.textContent = j.msg; return; }
+          closeModal();
+          if (S.refreshRules) S.refreshRules();
+        } }, editing ? "Save" : "Create"),
+      ]),
+    ]),
+  ]);
+  document.body.appendChild(bg);
+}
+
+// ------------------------------------------------------------------ init
+(async () => {
+  $("#login form").onsubmit = doLogin;
+  $("#logout").onclick = doLogout;
+  const j = await fetch("/auth/check").then(r => r.json());
+  const logged = j.data && j.data.loggedIn;
+  showLogin(!logged);
+  if (logged) boot();
+  setInterval(async () => {   // keep the app list fresh
+    if ($("#app").style.display === "none") return;
+    const r = await api("/app/names.json");
+    if (r && JSON.stringify(r.data) !== JSON.stringify(S.apps)) {
+      S.apps = r.data || [];
+      if (!S.app && S.apps.length) { S.app = S.apps[0]; route(); }
+      else renderSidebar();
+    }
+  }, 10000);
+})();

@@ -166,10 +166,12 @@ class EtcdDataSource(HttpRefreshableDataSource[T]):
 
     def __init__(self, host: str, port: int, key: str,
                  converter: Converter, *, refresh_ms: int = 3000,
-                 watch: bool = True, watch_reconnect_s: float = 2.0, **kw):
+                 watch: bool = True, watch_reconnect_s: float = 2.0,
+                 watch_idle_timeout_s: float = 120.0, **kw):
         self._range_key = base64.b64encode(key.encode()).decode()
         self._watch_url = f"http://{host}:{port}/v3/watch"
         self._watch_reconnect_s = watch_reconnect_s
+        self._watch_idle_timeout_s = watch_idle_timeout_s
         super().__init__(f"http://{host}:{port}/v3/kv/range",
                          converter, refresh_ms, **kw)
         self._watch_thread: Optional[threading.Thread] = None
@@ -204,7 +206,13 @@ class EtcdDataSource(HttpRefreshableDataSource[T]):
                     self._watch_url, data=body,
                     headers={**self.headers,
                              "Content-Type": "application/json"})
-                with urllib.request.urlopen(req) as r:
+                # idle read timeout: an LB/NAT can drop the long-lived
+                # stream without FIN, which would otherwise block this
+                # thread forever with the reconnect path unreachable;
+                # timing out a healthy-but-quiet stream just re-creates
+                # the watch, which is harmless
+                with urllib.request.urlopen(
+                        req, timeout=self._watch_idle_timeout_s) as r:
                     for line in r:               # one JSON object per change
                         if self._stop.is_set():
                             return

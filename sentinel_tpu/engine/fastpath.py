@@ -117,6 +117,13 @@ class HostFastPath:
     def bucket_of(self, now_ms: int) -> int:
         return now_ms // self.win_ms
 
+    def _retire_lease_locked(self, row: int, lease) -> None:
+        """Queue a dead lease's unused remainder for window reversal at the
+        next flush (callers hold the lock and have unlinked the lease)."""
+        if lease.remaining > 0:
+            self._expired.append((row, lease.created_ms,
+                                  lease.remaining, lease.is_in))
+
     def lease_state(self, row: int, acquire: int, is_in: bool,
                     now_ms: int) -> int:
         """→ ADMIT (token taken from the live lease), RENEW (no live lease
@@ -130,9 +137,7 @@ class HostFastPath:
             if lease is not None and lease.bucket_idx != b:
                 # bucket rotated: unused tokens go back to their window
                 self._leases.pop(row)
-                if lease.remaining > 0:
-                    self._expired.append((row, lease.created_ms,
-                                          lease.remaining, lease.is_in))
+                self._retire_lease_locked(row, lease)
                 lease = None
             if lease is not None:
                 if lease.is_in != is_in:
@@ -181,9 +186,8 @@ class HostFastPath:
                     and lease.is_in == is_in):
                 lease.remaining += chunk - used
             else:
-                if lease is not None and lease.remaining > 0:
-                    self._expired.append((row, lease.created_ms,
-                                          lease.remaining, lease.is_in))
+                if lease is not None:
+                    self._retire_lease_locked(row, lease)
                 self._leases[row] = _Lease(b, chunk - used, is_in, now_ms)
             self.lease_renewals += 1
             self.fast_admits += 1
@@ -192,9 +196,8 @@ class HostFastPath:
         with self._lock:
             self._hot_bucket[row] = self.bucket_of(now_ms)
             lease = self._leases.pop(row, None)
-            if lease is not None and lease.remaining > 0:
-                self._expired.append((row, lease.created_ms,
-                                      lease.remaining, lease.is_in))
+            if lease is not None:
+                self._retire_lease_locked(row, lease)
 
     def _collect_expired_locked(self, drop_all: bool = False,
                                 now_ms: Optional[int] = None) -> None:
@@ -203,9 +206,7 @@ class HostFastPath:
             lease = self._leases[row]
             if drop_all or lease.bucket_idx != b:
                 del self._leases[row]
-                if lease.remaining > 0:
-                    self._expired.append((row, lease.created_ms,
-                                          lease.remaining, lease.is_in))
+                self._retire_lease_locked(row, lease)
 
     def expire_all(self) -> None:
         """Reconcile every live lease (snapshot save / shutdown): unused

@@ -28,10 +28,13 @@ class TransportRuntime:
     heartbeat: Optional[HeartbeatSender]
     cluster_state: ClusterModeState
     port: int
+    metric_timer: Optional[object] = None
 
     def stop(self) -> None:
         if self.heartbeat is not None:
             self.heartbeat.stop()
+        if self.metric_timer is not None:
+            self.metric_timer.stop()
         self.http.stop()
 
 
@@ -39,15 +42,38 @@ def start_transport(sentinel, *, host: str = "0.0.0.0", port: int = 8719,
                     dashboard_addr: Optional[str] = None,
                     metric_searcher=None, writable_registry=None,
                     heartbeat_interval_ms: int = 10_000,
+                    metric_log: bool = True,
+                    gateway_manager=None, api_definition_manager=None,
                     clock=None) -> TransportRuntime:
     """Start the HTTP command center (with port auto-increment) and, when a
     dashboard address is given, a heartbeat loop advertising the port that
-    was actually bound."""
+    was actually bound.
+
+    ``metric_log=True`` (the default, matching the reference where the
+    metric-file timer always runs — ``MetricTimerListener`` is started by
+    FlowRuleManager's static init) also wires the metric pipeline: a 1 s
+    writer flushing window snapshots to the app's metric log plus a searcher
+    serving the ``metric`` command, which is what the dashboard's fetcher
+    polls for the realtime charts. Pass an explicit ``metric_searcher`` (or
+    ``metric_log=False``) to manage the pipeline yourself."""
     center = CommandCenter()
     extra: dict = {}
+    metric_timer = None
+    if metric_searcher is None and metric_log:
+        from sentinel_tpu.metrics.searcher import MetricSearcher
+        from sentinel_tpu.metrics.timer import MetricTimerListener
+        from sentinel_tpu.metrics.writer import form_metric_file_name
+        metric_timer = MetricTimerListener(
+            sentinel, flush_interval_sec=sentinel.cfg.metric_flush_interval_sec)
+        metric_timer.start()
+        metric_searcher = MetricSearcher(
+            sentinel.cfg.metric_dir(),
+            form_metric_file_name(sentinel.cfg.app_name))
     cstate = register_default_handlers(
         center, sentinel, metric_searcher=metric_searcher,
-        extra_info=extra, writable_registry=writable_registry)
+        extra_info=extra, writable_registry=writable_registry,
+        gateway_manager=gateway_manager,
+        api_definition_manager=api_definition_manager)
     http = SimpleHttpCommandCenter(center, host=host, port=port)
     bound = http.start()
     extra["apiPort"] = bound          # basicInfo reflects the bound port
@@ -61,4 +87,5 @@ def start_transport(sentinel, *, host: str = "0.0.0.0", port: int = 8719,
             clock=clock if clock is not None else sentinel.clock)
         hb.start()
     return TransportRuntime(center=center, http=http, heartbeat=hb,
-                            cluster_state=cstate, port=bound)
+                            cluster_state=cstate, port=bound,
+                            metric_timer=metric_timer)

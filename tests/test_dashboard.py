@@ -248,3 +248,96 @@ def test_index_page_served(dash):
     with urllib.request.urlopen(f"http://127.0.0.1:{dport}/") as r:
         body = r.read().decode()
     assert "Sentinel-TPU Dashboard" in body
+
+
+def test_static_assets_served(dash):
+    _d, dport = dash
+    for path, must in [("/static/app.js", "openRuleModal"),
+                       ("/static/style.css", "--panel")]:
+        with urllib.request.urlopen(f"http://127.0.0.1:{dport}{path}") as r:
+            assert must in r.read().decode()
+
+
+# ------------------------------------------------------------------ gateway
+
+@pytest.fixture
+def gateway_agent(clk):
+    """An agent with gateway managers wired into its command center."""
+    from sentinel_tpu.gateway import (
+        GatewayApiDefinitionManager, GatewayRuleManager,
+    )
+    from sentinel_tpu.transport import CommandCenter, \
+        SimpleHttpCommandCenter, register_default_handlers
+    cfg = stpu.load_config(max_resources=64, max_flow_rules=16,
+                           max_degrade_rules=16, max_authority_rules=16)
+    sph = stpu.Sentinel(config=cfg, clock=clk)
+    gw = GatewayRuleManager(sph)
+    apis = GatewayApiDefinitionManager()
+    center = CommandCenter()
+    register_default_handlers(center, sph, gateway_manager=gw,
+                              api_definition_manager=apis)
+    http = SimpleHttpCommandCenter(center, host="0.0.0.0", port=0)
+    port = http.start()
+    yield sph, gw, apis, port
+    http.stop()
+
+
+def test_gateway_rule_crud_writes_through_to_agent(gateway_agent, dash, clk):
+    """gatewayFlow CRUD drives gateway/updateRules on the agent
+    (reference ``GatewayFlowRuleController`` → ``SentinelApiClient
+    .modifyGatewayFlowRules``)."""
+    _sph, gw, _apis, aport = gateway_agent
+    _d, dport = dash
+    _beat(aport, dport, clk)
+
+    out = _send(dport, "/v1/gatewayFlow/rule", body={
+        "app": "sentinel-tpu", "resource": "route-a", "resourceMode": 0,
+        "count": 7.0, "intervalSec": 1,
+        "paramItem": {"parseStrategy": 2, "fieldName": "X-Tenant"}})
+    assert out["success"], out
+    rid = out["data"]["id"]
+    live = gw.all_rules()
+    assert len(live) == 1 and live[0].resource == "route-a"
+    assert live[0].param_item.field_name == "X-Tenant"
+
+    got = _get(dport, "/v1/gatewayFlow/rules?app=sentinel-tpu")["data"]
+    assert len(got) == 1 and got[0]["id"] == rid
+    assert got[0]["paramItem"]["fieldName"] == "X-Tenant"
+
+    up = _send(dport, f"/v1/gatewayFlow/rule/{rid}", method="PUT",
+               body={"count": 11.0})
+    assert up["success"], up
+    assert gw.all_rules()[0].count == 11.0
+
+    _send(dport, f"/v1/gatewayFlow/rule/{rid}", method="DELETE")
+    assert gw.all_rules() == []
+
+
+def test_gateway_api_definitions_crud(gateway_agent, dash, clk):
+    _sph, _gw, apis, aport = gateway_agent
+    _d, dport = dash
+    _beat(aport, dport, clk)
+
+    out = _send(dport, "/v1/gatewayApi/rule", body={
+        "app": "sentinel-tpu", "apiName": "my-api",
+        "predicateItems": [{"pattern": "/foo/**", "matchStrategy": 1}]})
+    assert out["success"], out
+    defs = apis.get_api_definitions()
+    assert len(defs) == 1 and defs[0].api_name == "my-api"
+    assert defs[0].predicate_items[0].pattern == "/foo/**"
+
+    got = _get(dport, "/v1/gatewayApi/rules?app=sentinel-tpu")["data"]
+    assert got[0]["apiName"] == "my-api"
+    _send(dport, f"/v1/gatewayApi/rule/{out['data']['id']}", method="DELETE")
+    assert apis.get_api_definitions() == []
+
+
+def test_json_tree_route(agent, dash, clk):
+    sph, _timer, aport = agent
+    _d, dport = dash
+    _beat(aport, dport, clk)
+    with sph.entry("tree-res"):
+        pass
+    out = _get(dport, f"/resource/jsonTree.json?ip=127.0.0.1&port={aport}")
+    assert out["success"]
+    assert any(n.get("resource") == "tree-res" for n in out["data"])
