@@ -1,0 +1,88 @@
+"""Plugin discovery via the SPI loader (reference: dropping a provider
+jar with ``META-INF/services`` files on the classpath — demos
+``sentinel-demo-slot-spi`` / ``sentinel-demo-command-handler``; here the
+"classpath" is the SENTINEL_TPU_PLUGINS env var listing plugin modules).
+
+The plugin module below registers, purely by being imported:
+* an InitFunc that loads a default flow rule at startup,
+* a HostGate processor slot denying a resource,
+* a custom command-plane handler.
+"""
+
+import os
+import sys
+import tempfile
+import textwrap
+
+PLUGIN_SOURCE = textwrap.dedent('''
+    """A sentinel-tpu plugin: registration happens at import time."""
+    from sentinel_tpu.core.spi import (
+        SERVICE_COMMAND_HANDLER, SERVICE_PROCESSOR_SLOT, SpiLoader, spi,
+    )
+    from sentinel_tpu.core.initexec import init_func
+    from sentinel_tpu.engine.slots import HostGate
+
+    @init_func(order=10)
+    def load_default_rules(sph):
+        import sentinel_tpu as stpu
+        sph.load_flow_rules([stpu.FlowRule(resource="demo", count=5.0)])
+
+    @spi(SERVICE_PROCESSOR_SLOT, order=1)
+    class MaintenanceGate(HostGate):
+        name = "maintenance-gate"
+        def check(self, resource, origin, acquire, args):
+            return resource != "under-maintenance"
+
+    def cmd_plugin_info(req):
+        from sentinel_tpu.transport.command import CommandResponse
+        return CommandResponse.of_success("demo plugin v1")
+    cmd_plugin_info.command_name = "pluginInfo"
+    cmd_plugin_info.command_desc = "demo plugin self-description"
+    SpiLoader.of(SERVICE_COMMAND_HANDLER).register(cmd_plugin_info)
+''')
+
+
+def main() -> None:
+    plugin_dir = tempfile.mkdtemp(prefix="stpu-plugin-")
+    with open(os.path.join(plugin_dir, "demo_sentinel_plugin.py"), "w") as f:
+        f.write(PLUGIN_SOURCE)
+    sys.path.insert(0, plugin_dir)
+    os.environ["SENTINEL_TPU_PLUGINS"] = "demo_sentinel_plugin"
+
+    import sentinel_tpu as stpu
+    import sentinel_tpu.api as sph
+    from sentinel_tpu.transport import (
+        CommandCenter, CommandRequest, register_default_handlers,
+    )
+
+    inst = sph.init(stpu.load_config(
+        max_resources=64, max_flow_rules=8, max_degrade_rules=8,
+        max_authority_rules=8))
+
+    print("init-func rule loaded:",
+          [r.resource for r in inst.get_flow_rules()])
+
+    passed = blocked = 0
+    for _ in range(10):
+        try:
+            with sph.entry("demo"):
+                passed += 1
+        except stpu.BlockException:
+            blocked += 1
+    print(f"demo resource (rule from plugin init-func): "
+          f"{passed} passed, {blocked} blocked")
+
+    try:
+        with sph.entry("under-maintenance"):
+            pass
+    except stpu.CustomSlotException as exc:
+        print(f"plugin slot denied: {exc.slot_name}")
+
+    center = CommandCenter()
+    register_default_handlers(center, inst)
+    print("plugin command:",
+          center.handle("pluginInfo", CommandRequest()).result)
+
+
+if __name__ == "__main__":
+    main()

@@ -35,6 +35,14 @@ from sentinel_tpu.core.errors import (
     ParamFlowException,
     SystemBlockException,
 )
+from sentinel_tpu.core.initexec import InitExecutor, init_func
+from sentinel_tpu.core.spi import (
+    SERVICE_COMMAND_HANDLER,
+    SERVICE_INIT_FUNC,
+    SERVICE_PROCESSOR_SLOT,
+    SpiLoader,
+    spi,
+)
 from sentinel_tpu.engine.slots import DeviceSlot, DeviceSlotView, HostGate
 from sentinel_tpu.rules.authority import STRATEGY_BLACK, STRATEGY_WHITE, AuthorityRule
 from sentinel_tpu.rules.degrade import (

@@ -34,13 +34,17 @@ _tls = threading.local()
 
 def init(config=None, **kw) -> Sentinel:
     """Install the process-wide instance (reference ``Env`` static init);
-    idempotent unless a config is passed."""
+    idempotent unless a config is passed. Runs registered InitFunc SPI
+    hooks once per process (``InitExecutor.doInit``)."""
     global _instance, _generation
     with _lock:
         if _instance is None or config is not None or kw:
             _instance = Sentinel(config, **kw)
             _generation += 1
-        return _instance
+        inst = _instance
+    from sentinel_tpu.core.initexec import InitExecutor
+    InitExecutor.do_init(inst)
+    return inst
 
 
 def instance() -> Sentinel:
@@ -49,6 +53,8 @@ def instance() -> Sentinel:
         with _lock:
             if _instance is None:
                 _instance = Sentinel()
+        from sentinel_tpu.core.initexec import InitExecutor
+        InitExecutor.do_init(_instance)
     return _instance
 
 

@@ -1,0 +1,71 @@
+"""Ordered startup hooks — the ``InitExecutor`` / ``InitFunc`` /
+``@InitOrder`` analog (reference ``init/InitExecutor.java``,
+``init/InitFunc.java``, ``init/InitOrder.java``).
+
+An init func is any callable ``fn(sentinel)`` registered under the
+``init_func`` SPI service (directly, via :func:`init_func`, or from a
+plugin module — see :mod:`sentinel_tpu.core.spi`). ``InitExecutor``
+runs them once per process in ascending order, triggered by the static
+facade's instance creation (``api.init()`` — the analog of ``Env``'s
+static init firing on the first ``SphU.entry``); class-based users call
+:meth:`InitExecutor.do_init` themselves.
+
+Failure semantics match the reference: the first raising func interrupts
+the remaining ones (logged, not propagated — ``InitExecutor.doInit``
+catches at the loop level), and initialization never re-runs.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from sentinel_tpu.core.spi import (
+    LOWEST_PRECEDENCE, SERVICE_INIT_FUNC, SpiLoader,
+)
+
+
+def init_func(order: int = LOWEST_PRECEDENCE,
+              alias: Optional[str] = None) -> Callable:
+    """Decorator registering ``fn(sentinel)`` as an InitFunc::
+
+        @init_func(order=10)
+        def wire_metrics(sph): ...
+    """
+    def wrap(fn):
+        return SpiLoader.of(SERVICE_INIT_FUNC).register(
+            fn, alias=alias, order=order)
+    return wrap
+
+
+class InitExecutor:
+    _lock = threading.Lock()
+    _done = False
+
+    @classmethod
+    def do_init(cls, sentinel) -> bool:
+        """Run all registered init funcs in order, once per process.
+        → True if this call performed the initialization."""
+        with cls._lock:
+            if cls._done:
+                return False
+            cls._done = True
+        from sentinel_tpu.core.logs import record_log
+        try:
+            for fn in SpiLoader.of(
+                    SERVICE_INIT_FUNC).load_instance_list_sorted():
+                record_log().info("[InitExecutor] executing %s",
+                                  getattr(fn, "__name__", fn))
+                fn(sentinel)
+        except Exception as exc:
+            # first failure interrupts the remaining funcs but never
+            # propagates (InitExecutor.java:56-63)
+            record_log().warning("[InitExecutor] initialization failed: %r",
+                                 exc)
+        return True
+
+    @classmethod
+    def reset(cls) -> None:
+        """Test hygiene: allow do_init to run again."""
+        with cls._lock:
+            cls._done = False

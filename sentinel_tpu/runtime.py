@@ -328,6 +328,15 @@ class Sentinel:
             win_ms=self.spec.second.win_ms)
         self._fast_enabled = bool(cfg.host_fast_path)
 
+        # SPI-discovered slots (SlotChainProvider.newSlotChain analog:
+        # every new "chain" is built from the registered ProcessorSlot
+        # providers). Fresh instances per Sentinel — slot state must not
+        # leak across engines.
+        from sentinel_tpu.core.spi import SERVICE_PROCESSOR_SLOT, SpiLoader
+        for slot in SpiLoader.of(
+                SERVICE_PROCESSOR_SLOT).load_new_instance_list_sorted():
+            self.register_slot(slot)
+
     # ------------------------------------------------------------------
     # Rule management (XxxRuleManager.loadRules analog)
     # ------------------------------------------------------------------

@@ -347,4 +347,12 @@ def register_default_handlers(
     ]:
         center.register(fn, name, desc)
 
+    # SPI-discovered custom command handlers (CommandHandler SPI analog —
+    # providers carry command_name/command_desc; see core/spi.py and
+    # demos/command_handler_spi.py)
+    from sentinel_tpu.core.spi import SERVICE_COMMAND_HANDLER, SpiLoader
+    for handler in SpiLoader.of(
+            SERVICE_COMMAND_HANDLER).load_instance_list_sorted():
+        center.register(handler)
+
     return cstate

@@ -1,0 +1,176 @@
+"""SPI loader + InitExecutor (reference ``spi/SpiLoader.java``,
+``init/InitExecutor.java``): provider ordering/alias/default/singleton
+semantics, plugin-module discovery via SENTINEL_TPU_PLUGINS, init-func
+once-only ordered execution, and the auto-wired services (processor
+slots into new Sentinels, command handlers into command centers)."""
+
+import sys
+import textwrap
+
+import pytest
+
+import sentinel_tpu as stpu
+import sentinel_tpu.api as sph_api
+from sentinel_tpu.core import spi as spi_mod
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.core.initexec import InitExecutor, init_func
+from sentinel_tpu.core.spi import (
+    SERVICE_COMMAND_HANDLER, SERVICE_INIT_FUNC, SERVICE_PROCESSOR_SLOT,
+    SpiLoader, spi,
+)
+
+T0 = 1_785_000_000_000
+
+
+@pytest.fixture(autouse=True)
+def _spi_hygiene():
+    yield
+    SpiLoader.reset_and_clear_all()
+    InitExecutor.reset()
+    sph_api.reset()
+
+
+def _cfg(**kw):
+    return stpu.load_config(max_resources=32, max_flow_rules=8,
+                            max_degrade_rules=8, max_authority_rules=8, **kw)
+
+
+# ------------------------------------------------------------------ loader
+
+def test_sorted_alias_default_and_singletons():
+    loader = SpiLoader.of("svc")
+
+    @spi("svc", order=20)
+    class B:
+        pass
+
+    @spi("svc", order=10, alias="first")
+    class A:
+        pass
+
+    @spi("svc", is_default=True)          # LOWEST_PRECEDENCE order
+    class D:
+        pass
+
+    insts = loader.load_instance_list_sorted()
+    assert [type(i) for i in insts] == [A, B, D]
+    # singletons: same instance on re-load
+    assert loader.load_instance_list_sorted()[0] is insts[0]
+    # fresh instances differ
+    assert loader.load_new_instance_list_sorted()[0] is not insts[0]
+    assert isinstance(loader.load_instance_by_alias("first"), A)
+    assert isinstance(loader.load_default_instance(), D)
+    assert isinstance(loader.load_highest_priority_instance(), A)
+
+
+def test_non_class_providers_used_as_is():
+    sentinel = object()
+    SpiLoader.of("svc2").register(sentinel, order=1)
+    assert SpiLoader.of("svc2").load_instance_list_sorted() == [sentinel]
+
+
+def test_equal_order_preserves_registration_sequence():
+    SpiLoader.of("svc3").register("x", order=5)
+    SpiLoader.of("svc3").register("y", order=5)
+    assert SpiLoader.of("svc3").load_instance_list_sorted() == ["x", "y"]
+
+
+# ------------------------------------------------------------------ plugins
+
+def test_plugin_module_discovery(tmp_path, monkeypatch):
+    (tmp_path / "my_sentinel_plugin.py").write_text(textwrap.dedent("""
+        from sentinel_tpu.core.spi import spi
+
+        @spi("plugin_probe", alias="from-plugin")
+        class Probe:
+            pass
+    """))
+    monkeypatch.syspath_prepend(str(tmp_path))
+    monkeypatch.setenv(spi_mod.PLUGINS_ENV, "my_sentinel_plugin")
+    spi_mod.load_plugins(force=True)
+    assert SpiLoader.of("plugin_probe").load_instance_by_alias(
+        "from-plugin") is not None
+    sys.modules.pop("my_sentinel_plugin", None)
+
+
+def test_bad_plugin_module_is_logged_not_fatal(monkeypatch):
+    monkeypatch.setenv(spi_mod.PLUGINS_ENV, "definitely_not_a_module_xyz")
+    assert spi_mod.load_plugins(force=True) == []
+
+
+# ------------------------------------------------------------------ init
+
+def test_init_funcs_run_once_ordered_via_api_init():
+    calls = []
+
+    @init_func(order=2)
+    def second(sph):
+        calls.append(("second", sph))
+
+    @init_func(order=1)
+    def first(sph):
+        calls.append(("first", sph))
+
+    inst = sph_api.init(_cfg(), clock=ManualClock(start_ms=T0))
+    assert [c[0] for c in calls] == ["first", "second"]
+    assert all(c[1] is inst for c in calls)
+    # once per process: a second init() (even with a new instance) won't rerun
+    sph_api.init(_cfg(), clock=ManualClock(start_ms=T0))
+    assert len(calls) == 2
+
+
+def test_init_failure_interrupts_remaining_without_raising():
+    calls = []
+
+    @init_func(order=1)
+    def boom(sph):
+        raise RuntimeError("nope")
+
+    @init_func(order=2)
+    def after(sph):
+        calls.append("after")
+
+    assert InitExecutor.do_init(object()) is True
+    assert calls == []          # interrupted, like InitExecutor.java:56-63
+    assert InitExecutor.do_init(object()) is False
+
+
+# ------------------------------------------------------------------ wiring
+
+def test_spi_host_gate_auto_registered_into_new_sentinel():
+    @spi(SERVICE_PROCESSOR_SLOT, order=1)
+    class DenyVip(stpu.HostGate):
+        name = "deny-vip"
+
+        def check(self, resource, origin, acquire, args):
+            return resource != "vip-only"
+
+    sph = stpu.Sentinel(_cfg(), clock=ManualClock(start_ms=T0))
+    with sph.entry("plain"):
+        pass
+    with pytest.raises(stpu.CustomSlotException) as ei:
+        sph.entry("vip-only").__enter__()
+    assert ei.value.slot_name == "deny-vip"
+    # fresh instance per Sentinel: the class provider yields distinct objects
+    sph2 = stpu.Sentinel(_cfg(), clock=ManualClock(start_ms=T0))
+    assert sph._host_gates[0] is not sph2._host_gates[0]
+
+
+def test_spi_command_handler_auto_registered():
+    from sentinel_tpu.transport import (
+        CommandCenter, CommandRequest, CommandResponse,
+        register_default_handlers,
+    )
+
+    def cmd_hello(req: CommandRequest) -> CommandResponse:
+        return CommandResponse.of_success("hi " + (req.param("who") or "?"))
+    cmd_hello.command_name = "hello"
+    cmd_hello.command_desc = "plugin-provided greeting"
+    SpiLoader.of(SERVICE_COMMAND_HANDLER).register(cmd_hello)
+
+    sph = stpu.Sentinel(_cfg(), clock=ManualClock(start_ms=T0))
+    center = CommandCenter()
+    register_default_handlers(center, sph)
+    resp = center.handle("hello", CommandRequest(parameters={"who": "spi"}))
+    assert resp.success and resp.result == "hi spi"
+    assert "hello" in center.names()
