@@ -136,7 +136,23 @@ class NativeRegistry:
         return rid
 
     def get_or_create_batch(self, names) -> np.ndarray:
-        """Vector path: one lock + one FFI call for the whole batch."""
+        """Vector path: one lock + one FFI call for the whole batch.
+        Batches repeat few distinct names (per-resource serving loops often
+        send ONE name 4k times), so dedup first when it pays — dict hashing
+        a name is ~30× cheaper than encoding + marshalling it."""
+        n = len(names)
+        if n > 64:
+            pos: dict = {}
+            for s in names:
+                if s not in pos:
+                    pos[s] = len(pos)
+            if len(pos) * 2 < n:
+                rows_u = self._intern_encoded(list(pos))
+                return rows_u[np.fromiter((pos[s] for s in names),
+                                          np.int32, count=n)]
+        return self._intern_encoded(names)
+
+    def _intern_encoded(self, names) -> np.ndarray:
         enc = [n.encode("utf-8") for n in names]
         offsets = np.zeros(len(enc) + 1, np.int32)
         np.cumsum([len(b) for b in enc], out=offsets[1:])

@@ -144,6 +144,14 @@ class CompiledParamRules(NamedTuple):
     # are resolved host-side at entry time, so no device gather table exists
     by_row: Dict[int, Tuple[Tuple[int, int, Dict[Any, int]], ...]]
     num_active: int
+    # bool[len(rules)] — THREAD-grade per slot, precomputed so the batch
+    # tier's pin-row masking is one numpy gather instead of a per-pair loop
+    thread_slot_mask: Any = None
+    # (row_slot int32[max_row+1], row_idx int32[max_row+1]) when EVERY ruled
+    # resource has exactly one rule with a non-negative param index and no
+    # per-item overrides — the shape that lets the batch tier resolve pairs
+    # fully vectorized (see resolve_pairs_many); None otherwise
+    vector_meta: Any = None
 
 
 def init_param_dyn(pk: int) -> ParamDynState:
@@ -197,9 +205,24 @@ def compile_param_rules(rules: Sequence[ParamFlowRule], *, resource_registry,
         count=jnp.asarray(count), duration_ms=jnp.asarray(duration_ms),
         burst=jnp.asarray(burst), behavior=jnp.asarray(behavior),
         max_queue_ms=jnp.asarray(max_queue_ms))
+    by_row_t = {k: tuple(v) for k, v in by_row.items()}
+    vector_meta = None
+    if by_row_t and all(
+            len(entries) == 1 and entries[0][1] >= 0 and not entries[0][2]
+            for entries in by_row_t.values()):
+        max_row = max(by_row_t)
+        row_slot = np.full(max_row + 1, -1, np.int32)
+        row_idx = np.zeros(max_row + 1, np.int32)
+        for row, entries in by_row_t.items():
+            row_slot[row] = entries[0][0]
+            row_idx[row] = entries[0][1]
+        vector_meta = (row_slot, row_idx)
     return CompiledParamRules(
         table=table, rules=tuple(valid),
-        by_row={k: tuple(v) for k, v in by_row.items()}, num_active=len(valid))
+        by_row=by_row_t, num_active=len(valid),
+        thread_slot_mask=np.array([r.grade == GRADE_THREAD for r in valid],
+                                  np.bool_),
+        vector_meta=vector_meta)
 
 
 # ---------------------------------------------------------------------------
@@ -263,24 +286,39 @@ class ParamKeyRegistry:
             "all hot-param key rows are pinned by live entries; "
             "raise param_table_slots")
 
+    def _real_pin_counts(self, rows):
+        """Unique (row, multiplicity) among rows below capacity — sentinel
+        pin-noop rows drop out vectorized, so a 4k-event batch with no
+        THREAD-grade pairs costs one numpy filter, not 4k dict ops."""
+        arr = np.asarray(rows)
+        if arr.size == 0:
+            return (), ()
+        arr = arr[arr < self._cap]
+        if arr.size == 0:
+            return (), ()
+        uniq, cnt = np.unique(arr, return_counts=True)
+        return uniq.tolist(), cnt.tolist()
+
     def pin_rows(self, rows) -> None:
         """Hold rows against LRU recycling while an entry is in flight."""
+        uniq, cnt = self._real_pin_counts(rows)
+        if not uniq:
+            return
         with self._lock:
-            for r in rows:
-                r = int(r)
-                if r < self._cap:
-                    self._pins[r] = self._pins.get(r, 0) + 1
+            for r, c in zip(uniq, cnt):
+                self._pins[r] = self._pins.get(r, 0) + c
 
     def unpin_rows(self, rows) -> None:
+        uniq, cnt = self._real_pin_counts(rows)
+        if not uniq:
+            return
         with self._lock:
-            for r in rows:
-                r = int(r)
-                if r < self._cap:
-                    n = self._pins.get(r, 0) - 1
-                    if n <= 0:
-                        self._pins.pop(r, None)
-                    else:
-                        self._pins[r] = n
+            for r, c in zip(uniq, cnt):
+                n = self._pins.get(r, 0) - c
+                if n <= 0:
+                    self._pins.pop(r, None)
+                else:
+                    self._pins[r] = n
 
     def get_or_create_batch(self, items) -> List[int]:
         """Intern many ``(rule_slot, key_form, override_or_None)`` triples
@@ -315,19 +353,25 @@ class ParamKeyRegistry:
             return len(self._map)
 
 
+_PIN_NOOP = 2 ** 31 - 1       # >= any registry capacity → pin/unpin no-op
+
+
 def thread_key_rows(compiled: CompiledParamRules, pair_rules: np.ndarray,
                     pair_keys: np.ndarray) -> np.ndarray:
     """Key rows of THREAD-grade pairs only; others → sentinel (skipped by
     pin/unpin). Only THREAD-grade pairs need pinning: their exit-side
     decrement must hit the same occupant, while QPS state is entry-only and
     survives recycling as a bounded reset."""
-    out = np.asarray(pair_keys).copy().reshape(-1)
-    rj = np.asarray(pair_rules).reshape(-1)
+    keys_flat = np.asarray(pair_keys).reshape(-1)
+    mask = compiled.thread_slot_mask
     nrules = len(compiled.rules)
-    for i, j in enumerate(rj):
-        if not (0 <= j < nrules and compiled.rules[j].grade == GRADE_THREAD):
-            out[i] = 2 ** 31 - 1   # >= any registry capacity → pin/unpin no-op
-    return out
+    if nrules == 0 or mask is None or not mask.any():
+        return np.full(keys_flat.shape, _PIN_NOOP, keys_flat.dtype)
+    rj = np.asarray(pair_rules).reshape(-1)
+    valid = (rj >= 0) & (rj < nrules)
+    is_thread = valid & mask[np.where(valid, rj, 0)]
+    return np.where(is_thread, keys_flat,
+                    keys_flat.dtype.type(_PIN_NOOP))
 
 
 def resolve_pairs(compiled: CompiledParamRules, keys: ParamKeyRegistry,
@@ -375,6 +419,52 @@ def resolve_pairs(compiled: CompiledParamRules, keys: ParamKeyRegistry,
     return pr, pk
 
 
+def _resolve_pairs_vector(compiled: CompiledParamRules,
+                          keys: ParamKeyRegistry, rows, args_list,
+                          pr: np.ndarray, pk: np.ndarray):
+    """Fully vectorized pair resolution for the dominant serving shape:
+    one rule per resource (non-negative index, no per-item overrides —
+    guaranteed by ``vector_meta``) and integer args of uniform arity.
+    Deduplicates (slot, value) via ``np.unique`` so the host dict work is
+    one intern per DISTINCT key, not per event. → (pr, pk) filled, or None
+    to fall back to the general loop (never a wrong answer — any shape
+    this path can't prove safe falls through)."""
+    try:
+        arr = np.asarray(args_list)
+    except (ValueError, TypeError):
+        return None
+    if arr.ndim != 2 or arr.dtype.kind not in "iu" or arr.shape[1] == 0:
+        return None
+    if arr.dtype.kind == "u" and arr.dtype.itemsize == 8:
+        return None                      # uint64 may wrap in the int64 cast
+    n = len(pr)
+    row_slot, row_idx = compiled.vector_meta
+    rows_arr = np.asarray(rows, np.int64)
+    clipped = np.minimum(rows_arr, row_slot.shape[0] - 1)
+    in_range = rows_arr < row_slot.shape[0]
+    slots = np.where(in_range, row_slot[clipped], -1)
+    idxs = np.where(in_range, row_idx[clipped], 0)
+    valid = (slots >= 0) & (idxs < arr.shape[1])
+    if not valid.any():
+        return pr, pk
+    vals = arr[np.arange(n), np.where(valid, idxs, 0)].astype(np.int64)
+    vv = vals[valid]
+    # direct comparisons, NOT np.abs: abs(int64.min) overflows negative
+    if (vv >= 2 ** 31).any() or (vv <= -(2 ** 31)).any():
+        return None                      # combine-key would overflow
+    # pack (slot, value) into one int64 so np.unique runs on a flat array
+    comb = slots.astype(np.int64) * (2 ** 32) + (vals + 2 ** 31)
+    uniq, inv = np.unique(comb[valid], return_inverse=True)
+    u_slot = (uniq // (2 ** 32)).tolist()
+    u_val = (uniq % (2 ** 32) - 2 ** 31).tolist()
+    rows_out = np.asarray(keys.get_or_create_batch(
+        [(s, v, None) for s, v in zip(u_slot, u_val)]), np.int32)
+    vi = np.nonzero(valid)[0]
+    pr[vi, 0] = slots[valid].astype(np.int32)
+    pk[vi, 0] = rows_out[inv]
+    return pr, pk
+
+
 def resolve_pairs_many(compiled: CompiledParamRules, keys: ParamKeyRegistry,
                        rows: Sequence[int], args_list: Sequence[Sequence[Any]],
                        pairs_per_event: int) -> Tuple[np.ndarray, np.ndarray]:
@@ -386,12 +476,28 @@ def resolve_pairs_many(compiled: CompiledParamRules, keys: ParamKeyRegistry,
     pk_sentinel = keys.capacity
     pr = np.full((n_events, pairs_per_event), np_sentinel, np.int32)
     pk = np.full((n_events, pairs_per_event), pk_sentinel, np.int32)
-    # first pass: collect (event, fill, slot, key_form, override) flat
-    want: List[Tuple[int, int, int, Any, Optional[int]]] = []
-    for i, (row, args) in enumerate(zip(rows, args_list)):
-        if not args:
+    if compiled.vector_meta is not None:
+        out = _resolve_pairs_vector(compiled, keys, rows, args_list, pr, pk)
+        if out is not None:
+            return out
+    # first pass: collect (event, fill, slot) with a key-form DEDUPED intern
+    # list — a Zipf-skewed 4k-event batch touches far fewer distinct keys
+    # than events, so interning once per distinct (slot, key) pays for the
+    # small host-side dict. Locals bound for the hot loop.
+    by_row = compiled.by_row
+    by_row_get = by_row.get
+    uniq_pos: Dict[Tuple[int, Any], int] = {}
+    uniq_items: List[Tuple[int, Any, Optional[int]]] = []
+    want_i: List[int] = []
+    want_f: List[int] = []
+    want_slot: List[int] = []
+    want_u: List[int] = []
+    rows_list = (rows.tolist() if isinstance(rows, np.ndarray)
+                 else [int(r) for r in rows])
+    for i, (row, args) in enumerate(zip(rows_list, args_list)):
+        if args is None or len(args) == 0:   # len(): ndarray rows are valid
             continue
-        entries = compiled.by_row.get(int(row))
+        entries = by_row_get(row)
         if not entries:
             continue
         n = len(args)
@@ -404,9 +510,13 @@ def resolve_pairs_many(compiled: CompiledParamRules, keys: ParamKeyRegistry,
             value = args[idx]
             if value is None:
                 continue
-            values = (list(value)
-                      if isinstance(value, (list, tuple, set, frozenset))
-                      else [value])
+            tv = type(value)
+            if tv is int or tv is str:        # dominant scalar fast path
+                values = (value,)
+            elif isinstance(value, (list, tuple, set, frozenset)):
+                values = value
+            else:
+                values = (value,)
             for v in values:
                 if v is None:
                     continue
@@ -414,16 +524,26 @@ def resolve_pairs_many(compiled: CompiledParamRules, keys: ParamKeyRegistry,
                     raise ValueError(
                         f"event needs more than {pairs_per_event} param "
                         f"checks; raise param_pairs_per_event")
-                kf = _key_form(v)
-                want.append((i, fills, slot_j, kf, hot.get(kf)))
+                tv2 = type(v)
+                kf = v if (tv2 is int or tv2 is str) else _key_form(v)
+                ukey = (slot_j, kf)
+                u = uniq_pos.get(ukey)
+                if u is None:
+                    u = uniq_pos[ukey] = len(uniq_items)
+                    uniq_items.append(
+                        (slot_j, kf, hot.get(kf) if hot else None))
+                want_i.append(i)
+                want_f.append(fills)
+                want_slot.append(slot_j)
+                want_u.append(u)
                 fills += 1
-    if not want:
+    if not uniq_items:
         return pr, pk
-    rows_out = keys.get_or_create_batch(
-        [(slot_j, kf, ov) for _i, _f, slot_j, kf, ov in want])
-    for (i, f, slot_j, _kf, _ov), key_row in zip(want, rows_out):
-        pr[i, f] = slot_j
-        pk[i, f] = key_row
+    rows_out = np.asarray(keys.get_or_create_batch(uniq_items), np.int32)
+    ii = np.asarray(want_i, np.int64)
+    ff = np.asarray(want_f, np.int64)
+    pr[ii, ff] = np.asarray(want_slot, np.int32)
+    pk[ii, ff] = rows_out[np.asarray(want_u, np.int64)]
     return pr, pk
 
 

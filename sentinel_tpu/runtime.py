@@ -1191,7 +1191,11 @@ class Sentinel:
         ``.result()``. Callers double-buffer — dispatch batch N+1 while N's
         verdicts are in flight — to hide the device→host latency entirely.
         ``.result()`` MUST be called for every handle: it also releases
-        blocked events' key pins and writes the block log."""
+        blocked events' key pins and writes the block log.
+
+        ``args_list`` may be a 2D numpy integer array (one row per event) —
+        the fastest form: single-rule integer-key workloads then resolve
+        fully vectorized with one intern per distinct key."""
         n = len(resources)
         batch_intern = getattr(self.resources, "get_or_create_batch", None)
         if batch_intern is not None:      # native table: one FFI call, no GIL
@@ -1206,14 +1210,18 @@ class Sentinel:
             compiled = self._param
             registry = self.param_key_registry
             gen = self._param_gen
+        pin_arr = None
         if args_list is not None and compiled.num_active:
             param_gen = gen
             param_rules, param_keys = pf_mod.resolve_pairs_many(
                 compiled, registry, rows, args_list, self.spec.param_pairs)
             # pin THREAD-grade pairs while in flight (released for blocked
-            # events below; allowed events stay pinned until exit_batch)
-            registry.pin_rows(pf_mod.thread_key_rows(
-                compiled, param_rules, param_keys))
+            # events below; allowed events stay pinned until exit_batch);
+            # computed once and reused for the blocked-event release
+            pin_arr = pf_mod.thread_key_rows(
+                compiled, param_rules, param_keys).reshape(
+                    param_keys.shape)
+            registry.pin_rows(pin_arr)
         origin_ids = np.zeros(n, np.int32)
         origin_rows = np.full(n, self.spec.alt_rows, np.int32)
         context_ids = np.zeros(n, np.int32)
@@ -1320,22 +1328,26 @@ class Sentinel:
                 # blocked events never exit → release their pins immediately
                 blocked = ~np.asarray(verdicts.allow)
                 if blocked.any():
-                    registry.unpin_rows(pf_mod.thread_key_rows(
-                        compiled, param_rules[blocked], param_keys[blocked]))
+                    registry.unpin_rows(pin_arr[blocked])
             # LogSlot parity for the batch tier: blocked events roll into
-            # sentinel-block.log (same per-second dedup as the single path);
-            # cluster blocks were already logged in the pre-check
+            # sentinel-block.log (same per-second dedup as the single path,
+            # grouped here so a mostly-blocked batch is a handful of log
+            # calls); cluster blocks were already logged in the pre-check
             denied = np.nonzero(~np.asarray(verdicts.allow))[0]
             if denied.size:
                 reasons = np.asarray(verdicts.reason)
+                grouped: dict = {}
                 for i in denied.tolist():
                     if cl_blocked is not None and cl_blocked[i]:
                         continue
+                    key = (resources[i], int(reasons[i]),
+                           (origins[i] if origins is not None
+                            and origins[i] else ""))
+                    grouped[key] = grouped.get(key, 0) + 1
+                for (res, rcode, origin), cnt in grouped.items():
                     self.block_log.log(
-                        resources[i],
-                        err_mod.exception_name_for(int(reasons[i])),
-                        origin=(origins[i] if origins is not None
-                                and origins[i] else ""))
+                        res, err_mod.exception_name_for(rcode),
+                        origin=origin, count=cnt)
             return verdicts
 
         return PendingVerdicts(_finalize)
@@ -1403,7 +1415,9 @@ class Sentinel:
                             crules, sleep=False, record=False)
                         fallback[i] = fb
                         cl_waits[i] = w
-                    if cprules and args_list is not None and args_list[i]:
+                    if (cprules and args_list is not None
+                            and args_list[i] is not None
+                            and len(args_list[i]) > 0):
                         cl_waits[i] += self._cluster_param_check(
                             resources[i], org, int(rows[i]),
                             int(origin_rows[i]), int(chain_rows[i]),
@@ -1428,7 +1442,9 @@ class Sentinel:
             if crules:
                 for slot_k, r in crules:
                     flow_req.append((i, slot_k, r))
-            if cprules and args_list is not None and args_list[i]:
+            if (cprules and args_list is not None
+                    and args_list[i] is not None
+                    and len(args_list[i]) > 0):
                 a = args_list[i]
                 for r in cprules:
                     idx = (r.param_idx if r.param_idx >= 0

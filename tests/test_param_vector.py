@@ -1,0 +1,151 @@
+"""Vectorized param-pair resolution (``_resolve_pairs_vector``) must be
+semantically identical to the general loop: same rule slots per event, and
+key rows that intern the same (slot, key_form) pairs. Row ids may differ
+between two registries (interning order differs), so equivalence is
+checked through each registry's inverse map."""
+
+import numpy as np
+import pytest
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.rules import param_flow as pf
+
+T0 = 1_785_000_000_000
+CAP = 512
+PV = 4
+
+
+def _compiled(rules):
+    class _Reg:
+        def pin(self, name):
+            return {"a": 3, "b": 7, "c": 11}[name]
+    return pf.compile_param_rules(rules, resource_registry=_Reg(),
+                                  capacity=8, k_per_resource=4)
+
+
+def _invert(reg):
+    # registry _map: (slot, key_form) -> row
+    return {row: key for key, row in reg._map.items()}
+
+
+def _semantic(compiled, reg, pr, pk):
+    """pairs as (slot, key_form) sets per event — registry-order free."""
+    inv = _invert(reg)
+    np_sentinel = compiled.table.active.shape[0] - 1
+    out = []
+    for i in range(pr.shape[0]):
+        pairs = []
+        for j in range(pr.shape[1]):
+            if pr[i, j] == np_sentinel:
+                continue
+            pairs.append((int(pr[i, j]), inv[int(pk[i, j])][1]))
+        out.append(sorted(pairs, key=repr))
+    return out
+
+
+def _general(compiled, reg, rows, args_list):
+    """Force the general loop by nulling vector_meta."""
+    c2 = compiled._replace(vector_meta=None)
+    return pf.resolve_pairs_many(c2, reg, rows, args_list, PV)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_vector_path_matches_general_loop(seed):
+    compiled = _compiled([
+        stpu.ParamFlowRule(resource="a", param_idx=0, count=10),
+        stpu.ParamFlowRule(resource="b", param_idx=1, count=5),
+    ])
+    assert compiled.vector_meta is not None
+    rng = np.random.default_rng(seed)
+    n = 257
+    rows = rng.choice([3, 7, 11, 200], size=n)   # a, b, no-rule, beyond-meta
+    args_list = [tuple(int(v) for v in rng.integers(-50, 50, size=2))
+                 for _ in range(n)]
+
+    reg_v = pf.ParamKeyRegistry(CAP)
+    pr_v = np.full((n, PV), 8, np.int32)
+    pk_v = np.full((n, PV), CAP, np.int32)
+    got = pf._resolve_pairs_vector(compiled, reg_v, rows, args_list,
+                                   pr_v, pk_v)
+    assert got is not None
+
+    reg_g = pf.ParamKeyRegistry(CAP)
+    pr_g, pk_g = _general(compiled, reg_g, rows, args_list)
+
+    assert _semantic(compiled, reg_v, pr_v, pk_v) == \
+        _semantic(compiled, reg_g, pr_g, pk_g)
+    # same distinct-key population interned
+    assert set(reg_v._map) == set(reg_g._map)
+
+
+def test_vector_meta_disabled_by_hot_items_multirule_negidx():
+    assert _compiled([stpu.ParamFlowRule(
+        resource="a", param_idx=-1, count=10)]).vector_meta is None
+    assert _compiled([stpu.ParamFlowRule(
+        resource="a", param_idx=0, count=10,
+        param_flow_item_list=[pf.ParamFlowItem(object=7, count=100)],
+    )]).vector_meta is None
+    assert _compiled([
+        stpu.ParamFlowRule(resource="a", param_idx=0, count=10),
+        stpu.ParamFlowRule(resource="a", param_idx=1, count=5),
+    ]).vector_meta is None
+
+
+def test_vector_path_falls_back_on_ragged_or_nonint():
+    compiled = _compiled([stpu.ParamFlowRule(resource="a", param_idx=0,
+                                             count=10)])
+    reg = pf.ParamKeyRegistry(CAP)
+    pr = np.full((2, PV), 8, np.int32)
+    pk = np.full((2, PV), CAP, np.int32)
+    assert pf._resolve_pairs_vector(
+        compiled, reg, [3, 3], [(1,), (1, 2)], pr, pk) is None  # ragged
+    assert pf._resolve_pairs_vector(
+        compiled, reg, [3, 3], [("x",), ("y",)], pr, pk) is None  # strings
+    assert pf._resolve_pairs_vector(
+        compiled, reg, [3, 3], [(2 ** 40,), (1,)], pr, pk) is None  # overflow
+    assert pf._resolve_pairs_vector(          # int64.min: abs() would wrap
+        compiled, reg, [3, 3], [(-2 ** 63,), (1,)], pr, pk) is None
+
+
+def test_end_to_end_batch_verdicts_identical_with_and_without_vector():
+    """Same traffic through entry_batch must produce identical verdicts
+    whether the vector path is live or suppressed."""
+    def run(disable_vector):
+        clk = ManualClock(start_ms=T0)
+        sph = stpu.Sentinel(stpu.load_config(
+            max_resources=64, max_flow_rules=8, max_degrade_rules=8,
+            max_authority_rules=8, max_param_rules=8,
+            param_table_slots=256), clock=clk)
+        sph.load_param_flow_rules([stpu.ParamFlowRule(
+            resource="hot", param_idx=0, count=3)])
+        if disable_vector:
+            with sph._lock:
+                sph._param = sph._param._replace(vector_meta=None)
+        rng = np.random.default_rng(7)
+        allows = []
+        for step in range(4):
+            ks = rng.integers(0, 5, size=32)
+            v = sph.entry_batch(["hot"] * 32,
+                                args_list=[(int(k),) for k in ks])
+            allows.append(np.asarray(v.allow).copy())
+            clk.advance_ms(250)
+        return np.concatenate(allows)
+
+    a = run(False)
+    b = run(True)
+    assert (a == b).all()
+
+
+def test_entry_batch_accepts_2d_numpy_args():
+    clk = ManualClock(start_ms=T0)
+    sph = stpu.Sentinel(stpu.load_config(
+        max_resources=64, max_flow_rules=8, max_degrade_rules=8,
+        max_authority_rules=8, max_param_rules=8,
+        param_table_slots=256), clock=clk)
+    sph.load_param_flow_rules([stpu.ParamFlowRule(
+        resource="hot", param_idx=0, count=2)])
+    keys = np.array([[5], [5], [5], [9]], np.int64)
+    v = sph.entry_batch(["hot"] * 4, args_list=keys)
+    # count=2 per key per second: third '5' blocks, '9' passes
+    assert list(np.asarray(v.allow)) == [True, True, False, True]
