@@ -90,12 +90,16 @@ class _EmbeddedTokenService:
 class ClusterCoordinator:
     def __init__(self, sentinel, *, namespace: Optional[str] = None,
                  server_port: int = 0, n_shards: int = 1,
-                 flows_per_shard: int = 64, clock=None):
+                 flows_per_shard: int = 64,
+                 param_keys_per_shard: int = 1024, clock=None):
         self.sentinel = sentinel
         self.namespace = namespace or sentinel.cfg.app_name
         self.server_port = server_port
         self.n_shards = n_shards
         self.flows_per_shard = flows_per_shard
+        # >0 so an assigned/embedded token server can serve cluster
+        # hot-param rules too (reference embedded server always can)
+        self.param_keys_per_shard = param_keys_per_shard
         self.clock = clock if clock is not None else sentinel.clock
         self._lock = threading.Lock()
         self.mode = CLUSTER_NOT_STARTED
@@ -107,10 +111,14 @@ class ClusterCoordinator:
         self.request_timeout_ms = 20
 
     # ---------------------------------------------------------------- wiring
-    def bind(self, cluster_state) -> None:
+    def bind(self, cluster_state, command_center=None) -> None:
         """Attach to a transport :class:`ClusterModeState`: mode flips and
         client-config pushes from the dashboard drive this coordinator, and
-        ``getClusterMode`` reports the live token-server port."""
+        ``getClusterMode`` reports the live token-server port. Passing the
+        transport's ``CommandCenter`` also registers the ten
+        ``cluster/server/*`` management commands (rules/config/metrics —
+        reference ``sentinel-cluster-server-default`` handlers), resolved
+        live against whichever engine/server this coordinator is running."""
         cluster_state.add_observer(self.on_mode_change)
         cluster_state.add_config_observer(
             lambda cfg: self.configure_client(
@@ -118,6 +126,12 @@ class ClusterCoordinator:
                 int(cfg["requestTimeout"])
                 if "requestTimeout" in cfg else None))
         cluster_state.info_provider = self.info
+        if command_center is not None:
+            from sentinel_tpu.cluster.commands import (
+                register_cluster_server_handlers,
+            )
+            register_cluster_server_handlers(command_center,
+                                             coordinator=self)
 
     def info(self) -> dict:
         # lock-free snapshot: a mode change can hold the lock for seconds
@@ -198,7 +212,8 @@ class ClusterCoordinator:
         from sentinel_tpu.parallel.cluster import ClusterEngine, ClusterSpec
         engine = ClusterEngine(ClusterSpec(
             n_shards=self.n_shards, flows_per_shard=self.flows_per_shard,
-            namespaces=4))
+            namespaces=4,
+            param_keys_per_shard=self.param_keys_per_shard))
         server = ClusterTokenServer(engine, port=self.server_port,
                                     clock=self.clock)
         server.start()

@@ -472,6 +472,16 @@ class ClusterEngine:
         with self._lock:
             self._ns_limit[self.namespace_id(namespace)] = limit
 
+    def namespace_qps_limit(self, namespace: str) -> float:
+        with self._lock:
+            return float(self._ns_limit[self.namespace_id(namespace)])
+
+    def namespace_flow_ids(self, namespace: str) -> List[int]:
+        """Flow ids registered under a namespace (flow + param rules)."""
+        with self._lock:
+            return sorted(fid for fid, ns in self._flow_ns.items()
+                          if ns == namespace)
+
     def load_rules(self, namespace: str, rules: Sequence[ClusterFlowRule]) -> None:
         """Replace the namespace's rules (ClusterFlowRuleManager property path).
 

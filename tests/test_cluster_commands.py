@@ -1,0 +1,128 @@
+"""cluster/server/* command handlers (reference
+``sentinel-cluster-server-default/.../command/handler``): rule round-trips
+in FlowRule/ParamFlowRule JSON, config fetch/modify, namespace set,
+metricList — against a live embedded token server."""
+
+import json
+
+import pytest
+
+import sentinel_tpu as stpu
+from sentinel_tpu.cluster.commands import register_cluster_server_handlers
+from sentinel_tpu.cluster.coordinator import ClusterCoordinator
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.transport import CommandCenter, CommandRequest
+
+T0 = 1_785_000_000_000
+
+
+@pytest.fixture
+def serving():
+    cfg = stpu.load_config(max_resources=64, max_flow_rules=16,
+                           max_degrade_rules=16, max_authority_rules=16)
+    clk = ManualClock(start_ms=T0)
+    sph = stpu.Sentinel(config=cfg, clock=clk)
+    coord = ClusterCoordinator(sph, namespace="ns-a", clock=clk)
+    center = CommandCenter()
+    register_cluster_server_handlers(center, coordinator=coord, clock=clk)
+    coord.on_mode_change(1)            # SERVER mode: engine + server live
+    yield sph, coord, center, clk
+    coord.stop()
+
+
+def _call(center, name, **params):
+    return center.handle(name, CommandRequest(
+        parameters={k: str(v) for k, v in params.items()}))
+
+
+FLOW_RULES = [{
+    "resource": "svc", "count": 5.0, "grade": 1, "clusterMode": True,
+    "clusterConfig": {"flowId": 101, "thresholdType": 1},
+}]
+
+
+def test_flow_rule_modify_fetch_roundtrip_and_enforcement(serving):
+    _sph, coord, center, clk = serving
+    resp = _call(center, "cluster/server/modifyFlowRules",
+                 namespace="ns-a", data=json.dumps(FLOW_RULES))
+    assert resp.success, resp.result
+
+    got = json.loads(_call(center, "cluster/server/flowRules",
+                           namespace="ns-a").result)
+    assert got[0]["clusterConfig"]["flowId"] == 101
+    assert got[0]["resource"] == "svc"
+
+    # the engine actually enforces the pushed rule (GLOBAL count=5)
+    eng = coord.server.engine
+    res = eng.request_tokens([101] * 8, [1] * 8, now_ms=clk.now_ms())
+    grants = sum(1 for s, _w, _r in res if s == 0)
+    assert grants == 5
+
+
+def test_param_rule_roundtrip(serving):
+    _sph, coord, center, clk = serving
+    rules = [{"resource": "svc", "paramIdx": 0, "count": 2.0,
+              "clusterMode": True, "clusterConfig": {"flowId": 202},
+              "paramFlowItemList": [
+                  {"object": "vip", "count": 50, "classType": "String"}]}]
+    assert _call(center, "cluster/server/modifyParamRules",
+                 namespace="ns-a", data=json.dumps(rules)).success
+    got = json.loads(_call(center, "cluster/server/paramRules",
+                           namespace="ns-a").result)
+    assert got[0]["clusterConfig"]["flowId"] == 202
+    assert coord.server.engine._param_rules[202].items == {"vip": 50.0}
+
+
+def test_fetch_config_and_flow_config_modify(serving):
+    _sph, _coord, center, _clk = serving
+    cfg = json.loads(_call(center, "cluster/server/fetchConfig").result)
+    assert "transport" in cfg and cfg["transport"]["port"] > 0
+    assert cfg["flow"]["sampleCount"] == 10
+
+    assert _call(center, "cluster/server/modifyNamespaceSet",
+                 data=json.dumps(["ns-a", "ns-b"])).success
+    cfg = json.loads(_call(center, "cluster/server/fetchConfig").result)
+    assert cfg["namespaceSet"] == ["ns-a", "ns-b"]
+
+    assert _call(center, "cluster/server/modifyFlowConfig", namespace="ns-a",
+                 data=json.dumps({"maxAllowedQps": 123.0})).success
+    nscfg = json.loads(_call(center, "cluster/server/fetchConfig",
+                             namespace="ns-a").result)
+    assert nscfg["flow"]["maxAllowedQps"] == 123.0
+
+
+def test_metric_list_reports_flow_traffic(serving):
+    _sph, coord, center, clk = serving
+    _call(center, "cluster/server/modifyFlowRules",
+          namespace="ns-a", data=json.dumps(FLOW_RULES))
+    eng = coord.server.engine
+    eng.request_tokens([101] * 8, [1] * 8, now_ms=clk.now_ms())
+    nodes = json.loads(_call(center, "cluster/server/metricList",
+                             namespace="ns-a").result)
+    assert len(nodes) == 1
+    node = nodes[0]
+    assert node["flowId"] == 101 and node["resourceName"] == "svc"
+    assert node["passQps"] == 5.0 and node["blockQps"] == 3.0
+
+
+def test_info_and_not_running_failures():
+    clk = ManualClock(start_ms=T0)
+    center = CommandCenter()
+    register_cluster_server_handlers(center, clock=clk)  # nothing attached
+    assert not _call(center, "cluster/server/modifyFlowRules",
+                     namespace="x", data="[]").success
+    resp = _call(center, "cluster/server/modifyFlowRules", namespace="x",
+                 data=json.dumps(FLOW_RULES))
+    assert not resp.success and "not running" in resp.result
+    assert not _call(center, "cluster/server/metricList",
+                     namespace="x").success
+    assert _call(center, "cluster/server/info").success
+
+
+def test_transport_config_modify_restarts_listener(serving):
+    _sph, coord, center, _clk = serving
+    old_port = coord.server.port
+    assert _call(center, "cluster/server/modifyTransportConfig",
+                 data=json.dumps({"idleSeconds": 99})).success
+    assert coord.server.idle_seconds == 99
+    assert coord.server.port == old_port      # idle-only change: no restart
