@@ -114,12 +114,46 @@ def exit(n: int = 1) -> None:           # noqa: A001 (reference name)
         st[-1].exit()
 
 
+# Tracer exception-class filters (reference ``Tracer.setExceptionsToTrace``
+# / ``setExceptionsToIgnore``; ignore wins on overlap)
+_trace_classes: tuple = (Exception,)
+_ignore_classes: tuple = ()
+
+
+def set_exceptions_to_trace(*classes) -> None:
+    """Only these exception classes (and subclasses) count toward
+    exception stats/breakers via :func:`trace` (``Tracer.java:96``)."""
+    global _trace_classes
+    _trace_classes = tuple(classes) or (Exception,)
+
+
+def set_exceptions_to_ignore(*classes) -> None:
+    """These classes never count, even if listed in the trace set
+    (``Tracer.java:117``; ignore takes precedence)."""
+    global _ignore_classes
+    _ignore_classes = tuple(classes)
+
+
+def should_trace(exc: BaseException) -> bool:
+    return (exc is not None
+            and not isinstance(exc, _ignore_classes or ())
+            and isinstance(exc, _trace_classes))
+
+
 def trace(exc: BaseException) -> None:
     """``Tracer.trace`` — record a business exception on the innermost
-    in-flight entry of this thread."""
+    in-flight entry of this thread, honoring the class filters."""
+    if not should_trace(exc):
+        return
     st = _stack()
     if st:
         st[-1].trace(exc)
+
+
+def trace_entry(exc: BaseException, entry_obj: Entry) -> None:
+    """``Tracer.traceEntry`` — record on an explicit entry."""
+    if entry_obj is not None and should_trace(exc):
+        entry_obj.trace(exc)
 
 
 def current_entry() -> Optional[Entry]:

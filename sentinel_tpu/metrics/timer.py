@@ -46,6 +46,11 @@ class MetricTimerListener:
                 self.writer.write(sec * 1000, nodes)
                 written += 1
             self._last_written_sec = sec
+        # piggyback the breaker-transition poll (EventObserverRegistry
+        # analog notifies within one tick; no-op without observers)
+        check = getattr(self._sentinel, "check_breaker_transitions", None)
+        if check is not None:
+            check()
         return written
 
     def start(self) -> None:

@@ -298,6 +298,9 @@ class Sentinel:
         # per-second rolled-up block log (LogSlot → EagleEyeLogUtil analog)
         self.block_log = BlockStatLogger(self.clock)
         self.callbacks = StatisticCallbackRegistry()
+        # circuit-breaker transition observers (EventObserverRegistry)
+        self._breaker_observers: list = []
+        self._breaker_prev: Optional[List[Tuple[str, int]]] = None
 
         (self._jit_decide, self._jit_decide_prio, self._jit_exit,
          self._jit_invalidate, self._jit_record_blocks) = \
@@ -1839,6 +1842,41 @@ class Sentinel:
     def breaker_states(self) -> List[int]:
         with self._lock:
             return [int(s) for s in np.asarray(self._state.breakers.state[:-1])]
+
+    def add_breaker_observer(self, fn) -> None:
+        """Register ``fn(resource, prev_state, new_state)`` for circuit-
+        breaker transitions (reference ``EventObserverRegistry``). Ours is
+        poll-driven: call :meth:`check_breaker_transitions` (the metric
+        timer does, every second), so notifications arrive within a tick
+        of the transition instead of synchronously inside the slot."""
+        with self._lock:
+            self._breaker_observers = self._breaker_observers + [fn]
+
+    def check_breaker_transitions(self) -> int:
+        """Diff breaker states against the previous check and notify
+        observers → number of transitions seen. Rule reloads reset the
+        baseline (slots re-pair with new rules)."""
+        with self._lock:
+            observers = self._breaker_observers
+        if not observers:
+            return 0
+        current = self.breaker_resources()
+        prev = self._breaker_prev
+        self._breaker_prev = current
+        if prev is None or [r for r, _s in prev] != [r for r, _s in current]:
+            return 0
+        fired = 0
+        for (res, old), (_res, new) in zip(prev, current):
+            if old != new:
+                fired += 1
+                for fn in observers:
+                    try:
+                        fn(res, old, new)
+                    except Exception as exc:
+                        from sentinel_tpu.core.logs import record_log
+                        record_log().warning(
+                            "breaker observer failed: %r", exc)
+        return fired
 
     def breaker_resources(self) -> List[Tuple[str, int]]:
         """(resource, state) per loaded degrade rule, rule-slot order

@@ -86,3 +86,59 @@ def test_lazy_default_instance():
     finally:
         stpu.set_global_clock(prev)
         sph.reset()
+
+
+def test_tracer_exception_class_filters():
+    """Tracer.setExceptionsToTrace/Ignore: only listed classes count;
+    ignore wins on overlap (Tracer.java:96-126)."""
+    class BizError(Exception):
+        pass
+
+    class Uninteresting(Exception):
+        pass
+
+    try:
+        sph.set_exceptions_to_trace(BizError)
+        e = sph.entry("traced")
+        sph.trace(Uninteresting("skip"))
+        assert sph.current_entry().error is None
+        sph.trace(BizError("count me"))
+        assert isinstance(sph.current_entry().error, BizError)
+        e.exit()
+
+        sph.set_exceptions_to_trace(Exception)
+        sph.set_exceptions_to_ignore(BizError)
+        e = sph.entry("traced")
+        sph.trace(BizError("ignored even though Exception is traced"))
+        assert sph.current_entry().error is None
+        sph.trace_entry(ValueError("explicit entry"), e)
+        assert isinstance(e.error, ValueError)
+        e.exit()
+    finally:
+        sph.set_exceptions_to_trace(Exception)
+        sph.set_exceptions_to_ignore()
+
+
+def test_breaker_transition_observer():
+    """EventObserverRegistry analog: poll-driven transition callbacks
+    (CLOSED->OPEN on exception-count breach)."""
+    from sentinel_tpu.rules.degrade import (
+        GRADE_EXCEPTION_COUNT, STATE_CLOSED, STATE_OPEN,
+    )
+    inst = sph.instance()
+    inst.load_degrade_rules([stpu.DegradeRule(
+        resource="frail", grade=GRADE_EXCEPTION_COUNT, count=2,
+        time_window=10, min_request_amount=1)])
+    seen = []
+    inst.add_breaker_observer(lambda res, old, new: seen.append(
+        (res, old, new)))
+    assert inst.check_breaker_transitions() == 0   # baseline snapshot
+    for _ in range(3):
+        try:
+            with sph.entry("frail"):
+                sph.trace(RuntimeError("boom"))
+        except stpu.BlockException:
+            break
+    assert inst.check_breaker_transitions() == 1
+    assert seen == [("frail", STATE_CLOSED, STATE_OPEN)]
+    assert inst.check_breaker_transitions() == 0   # no double fire
