@@ -112,6 +112,13 @@ class SentinelApiClient:
         resp = self._post(ip, port, "setClusterMode", {"mode": str(mode)})
         return "success" in resp
 
+    def fetch_cluster_server_metrics(self, ip: str, port: int,
+                                     namespace: str) -> List[Dict[str, Any]]:
+        """Token-server per-flow current-window metrics
+        (``cluster/server/metricList`` — ClusterMetricNode shapes)."""
+        return json.loads(self._get(ip, port, "cluster/server/metricList",
+                                    {"namespace": namespace}) or "[]")
+
     def set_cluster_client_config(self, ip: str, port: int,
                                   server_host: str, server_port: int,
                                   request_timeout: int = 0) -> bool:

@@ -407,6 +407,16 @@ class _Handler(BaseHTTPRequestHandler):
         if method == "GET" and path == "/cluster/state.json":
             self._json(d.cluster_state(q.get("app", "")))
             return
+        if method == "GET" and path == "/cluster/metrics.json":
+            # token-server per-flow metrics; namespace defaults to the app
+            # name (ClusterCoordinator's default namespace)
+            try:
+                self._json(_ok(d.client.fetch_cluster_server_metrics(
+                    q.get("ip", ""), int(q.get("port", "0") or 0),
+                    q.get("namespace", "") or q.get("app", ""))))
+            except AgentUnreachable as exc:
+                self._json(_fail(str(exc)))
+            return
         if method == "POST" and path == "/cluster/mode":
             p = self._body_params(body)
             self._json(d.set_cluster_mode(

@@ -403,6 +403,7 @@ const MODES = { "-1": "off", 0: "client", 1: "server" };
 async function viewCluster(c) {
   const tbody = h("tbody", {});
   const topo = h("div", {});
+  const srvMetrics = h("div", {});
   c.appendChild(h("div", { class: "card" }, [
     h("h3", {}, [h("span", {}, `Cluster — ${S.app}`)]), topo]));
   c.appendChild(h("div", { class: "card" }, [
@@ -413,6 +414,29 @@ async function viewCluster(c) {
       "machine", "mode", "token server", "",
     ].map(t => h("th", {}, t)))), tbody]),
   ]));
+  c.appendChild(srvMetrics);
+  async function refreshServerMetrics(server) {
+    srvMetrics.innerHTML = "";
+    if (!server) return;
+    const j = await api(`/cluster/metrics.json?app=${encodeURIComponent(S.app)}&ip=${server.ip}&port=${server.port}`);
+    if (!j || !j.success) return;
+    const rows = (j.data || []).map(n => h("tr", {}, [
+      h("td", {}, String(n.flowId)),
+      h("td", {}, n.resourceName),
+      h("td", { class: "num ok" }, String(n.passQps)),
+      h("td", { class: "num " + (n.blockQps ? "bad" : "") },
+        String(n.blockQps)),
+    ]));
+    srvMetrics.appendChild(h("div", { class: "card" }, [
+      h("h3", {}, [h("span", {}, `Token server flows — ${server.ip}:${server.port}`),
+        h("span", { class: "sub" }, "current-window pass/block per cluster flow")]),
+      h("table", {}, [h("thead", {}, h("tr", {},
+        ["flow id", "resource", "pass", "block"].map(t => h("th", {}, t)))),
+        h("tbody", {}, rows.length ? rows
+          : h("tr", {}, h("td", { colspan: 4, class: "dim" },
+              "no cluster rules loaded on the token server")))]),
+    ]));
+  }
   async function refresh() {
     const j = await api(`/cluster/state.json?app=${encodeURIComponent(S.app)}`);
     if (!j) return;
@@ -446,6 +470,7 @@ async function viewCluster(c) {
         "no machines")));
     }
     drawTopology(topo, states);
+    refreshServerMetrics(states.find(s => s.mode === 1));
   }
   await refresh();
   setRefresh(refresh, 10000);

@@ -341,3 +341,38 @@ def test_json_tree_route(agent, dash, clk):
     out = _get(dport, f"/resource/jsonTree.json?ip=127.0.0.1&port={aport}")
     assert out["success"]
     assert any(n.get("resource") == "tree-res" for n in out["data"])
+
+
+def test_cluster_server_metrics_route(dash, clk, tmp_path):
+    """/cluster/metrics.json proxies the token server's
+    cluster/server/metricList through the agent command plane."""
+    from sentinel_tpu.cluster.coordinator import ClusterCoordinator
+    from sentinel_tpu.parallel.cluster import (
+        THRESHOLD_GLOBAL, ClusterFlowRule,
+    )
+    from sentinel_tpu.transport import start_transport
+
+    d, dport = dash
+    cfg = stpu.load_config(max_resources=64, max_flow_rules=16,
+                           max_degrade_rules=16, max_authority_rules=16)
+    sph = stpu.Sentinel(config=cfg, clock=clk)
+    coord = ClusterCoordinator(sph, clock=clk)   # namespace = app name
+    rt = start_transport(sph, host="0.0.0.0", port=0, metric_log=False,
+                         clock=clk)
+    coord.bind(rt.cluster_state, command_center=rt.center)
+    try:
+        coord.on_mode_change(1)
+        eng = coord.server.engine
+        eng.load_rules(coord.namespace, [ClusterFlowRule(
+            flow_id=11, count=4.0, threshold_type=THRESHOLD_GLOBAL)])
+        eng.request_tokens([11] * 6, [1] * 6, now_ms=clk.now_ms())
+        _beat(rt.port, dport, clk)
+        out = _get(dport, f"/cluster/metrics.json?app={cfg.app_name}"
+                          f"&ip=127.0.0.1&port={rt.port}")
+        assert out["success"], out
+        node = out["data"][0]
+        assert node["flowId"] == 11
+        assert node["passQps"] == 4.0 and node["blockQps"] == 2.0
+    finally:
+        coord.stop()
+        rt.stop()
