@@ -142,6 +142,17 @@ def register_default_handlers(
             record_log().warning("setRules: datasource write failed: %s", exc)
         return CommandResponse.of_success("success")
 
+    def cmd_get_param_rules(req: CommandRequest) -> CommandResponse:
+        """Dedicated ``getParamFlowRules`` path — the reference DASHBOARD
+        fetches param rules through this name, not ``getRules?type=``
+        (``SentinelApiClient.java:105``)."""
+        return CommandResponse.of_success(
+            codec.rules_to_json("paramFlow", s.get_param_flow_rules()))
+
+    def cmd_set_param_rules(req: CommandRequest) -> CommandResponse:
+        req.parameters["type"] = "paramFlow"
+        return cmd_set_rules(req)
+
     # ---- switch ----------------------------------------------------------
 
     def cmd_get_switch(req: CommandRequest) -> CommandResponse:
@@ -343,6 +354,16 @@ def register_default_handlers(
         ("getClusterClientConfig", "get cluster client config",
          cmd_get_cluster_client_config),
         ("setClusterClientConfig", "point the token client at a server",
+         cmd_set_cluster_client_config),
+        # reference-dashboard exact paths (SentinelApiClient.java:105-111):
+        # param rules use dedicated commands, client config the cluster/
+        # client/* names — aliases so a REAL Sentinel dashboard can drive
+        # this agent unchanged
+        ("getParamFlowRules", "get param flow rules", cmd_get_param_rules),
+        ("setParamFlowRules", "set param flow rules", cmd_set_param_rules),
+        ("cluster/client/fetchConfig", "get cluster client config",
+         cmd_get_cluster_client_config),
+        ("cluster/client/modifyConfig", "modify cluster client config",
          cmd_set_cluster_client_config),
     ]:
         center.register(fn, name, desc)

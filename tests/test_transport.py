@@ -349,3 +349,36 @@ def test_command_interceptors_short_circuit(sentinel):
         "type": "flow", "data": "[]"}))
     assert not resp.success and resp.code == 403
     assert seen == ["version", "setRules"]
+
+
+def test_reference_dashboard_alias_commands(clk):
+    """The exact command names the reference dashboard's SentinelApiClient
+    drives (getParamFlowRules/setParamFlowRules,
+    cluster/client/fetchConfig|modifyConfig) must work."""
+    import json as _json
+
+    import sentinel_tpu as stpu
+    from sentinel_tpu.transport import (
+        CommandCenter, CommandRequest, register_default_handlers,
+    )
+    sph = stpu.Sentinel(stpu.load_config(
+        max_resources=64, max_flow_rules=16, max_degrade_rules=16,
+        max_authority_rules=16, max_param_rules=8), clock=clk)
+    center = CommandCenter()
+    cstate = register_default_handlers(center, sph)
+
+    rules = _json.dumps([{"resource": "hot", "paramIdx": 0, "count": 9.0}])
+    resp = center.handle("setParamFlowRules",
+                         CommandRequest(parameters={"data": rules}))
+    assert resp.success, resp.result
+    got = _json.loads(center.handle("getParamFlowRules",
+                                    CommandRequest()).result)
+    assert got[0]["resource"] == "hot" and got[0]["count"] == 9.0
+
+    cfg = _json.dumps({"serverHost": "10.0.0.9", "serverPort": 18730})
+    assert center.handle("cluster/client/modifyConfig", CommandRequest(
+        parameters={"data": cfg})).success
+    back = _json.loads(center.handle("cluster/client/fetchConfig",
+                                     CommandRequest()).result)
+    assert back["serverHost"] == "10.0.0.9"
+    assert cstate.client_config["serverPort"] == 18730
