@@ -10,3 +10,6 @@ from sentinel_tpu.transport.heartbeat import HeartbeatSender  # noqa: F401
 from sentinel_tpu.transport.bootstrap import (  # noqa: F401
     TransportRuntime, start_transport,
 )
+from sentinel_tpu.transport.mounted import (  # noqa: F401
+    command_asgi_app, command_wsgi_app,
+)

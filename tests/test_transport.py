@@ -382,3 +382,54 @@ def test_reference_dashboard_alias_commands(clk):
                                      CommandRequest()).result)
     assert back["serverHost"] == "10.0.0.9"
     assert cstate.client_config["serverPort"] == 18730
+
+
+def test_mounted_wsgi_and_asgi_command_apps(clk):
+    """sentinel-transport-spring-mvc analog: the command surface mounted
+    into a host app's own WSGI/ASGI stack."""
+    import asyncio
+    import io
+    import json as _json
+
+    import sentinel_tpu as stpu
+    from sentinel_tpu.transport import (
+        CommandCenter, command_asgi_app, command_wsgi_app,
+        register_default_handlers,
+    )
+    sph = stpu.Sentinel(stpu.load_config(
+        max_resources=64, max_flow_rules=16, max_degrade_rules=16,
+        max_authority_rules=16), clock=clk)
+    center = CommandCenter()
+    register_default_handlers(center, sph)
+
+    # WSGI: POST setRules through the mounted app, then GET them back
+    wsgi = command_wsgi_app(center, prefix="/sentinel")
+    rules = _json.dumps([{"resource": "r", "count": 3.0}])
+    body = f"type=flow&data={rules}".encode()
+    status_seen = {}
+
+    def start_response(status, headers):
+        status_seen["status"] = status
+    out = b"".join(wsgi({
+        "PATH_INFO": "/sentinel/setRules", "QUERY_STRING": "",
+        "REQUEST_METHOD": "POST", "CONTENT_LENGTH": str(len(body)),
+        "CONTENT_TYPE": "application/x-www-form-urlencoded",
+        "wsgi.input": io.BytesIO(body)}, start_response))
+    assert status_seen["status"].startswith("200") and b"success" in out
+    assert sph.get_flow_rules()[0].count == 3.0
+
+    # ASGI: version over the mounted app
+    asgi = command_asgi_app(center)
+    sent = []
+
+    async def drive():
+        async def receive():
+            return {"type": "http.request", "body": b"", "more_body": False}
+
+        async def send(msg):
+            sent.append(msg)
+        await asgi({"type": "http", "path": "/version",
+                    "query_string": b"", "headers": []}, receive, send)
+    asyncio.run(drive())
+    assert sent[0]["status"] == 200
+    assert sent[1]["body"]          # version string payload
