@@ -79,6 +79,17 @@ class AppManagement:
         with self._lock:
             return self._apps.get(app, {}).get(f"{ip}:{port}")
 
+    def remove_machine(self, app: str, ip: str, port: int) -> bool:
+        """``AppManagement.removeMachine`` (AppController machine/remove
+        flow); drops the app entirely when its last machine goes."""
+        with self._lock:
+            machines = self._apps.get(app)
+            if machines is None or machines.pop(f"{ip}:{port}", None) is None:
+                return False
+            if not machines:
+                self._apps.pop(app, None)
+            return True
+
     def remove_app(self, app: str) -> None:
         with self._lock:
             self._apps.pop(app, None)

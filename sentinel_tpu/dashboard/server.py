@@ -373,6 +373,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(_ok([mi.to_dict(now) for mi in
                             d.apps.machines(m.group(1))]))
             return
+        m = re.fullmatch(r"/app/([^/]+)/machine/remove\.json", path)
+        if method == "POST" and m:
+            p = self._body_params(body)
+            ok = d.apps.remove_machine(m.group(1), str(p.get("ip", "")),
+                                       int(p.get("port", 0) or 0))
+            self._json(_ok("success") if ok
+                       else _fail("machine not found"))
+            return
         if method == "GET" and path == "/metric/resources.json":
             self._json(d.top_resources(q.get("app", "")))
             return

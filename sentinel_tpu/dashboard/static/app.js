@@ -315,6 +315,7 @@ async function viewMachines(c) {
     h("h3", {}, [h("span", {}, `Machines — ${S.app}`)]),
     h("table", {}, [h("thead", {}, h("tr", {}, [
       "hostname", "ip:port", "sentinel version", "heartbeat age", "status",
+      "",
     ].map(t => h("th", {}, t)))), tbody]),
   ]));
   async function refresh() {
@@ -329,10 +330,16 @@ async function viewMachines(c) {
         h("td", {}, h("span", {
           class: "badge " + (m.healthy ? "ok" : "bad") },
           m.healthy ? "healthy" : "lost")),
+        h("td", {}, h("button", { class: "sm danger", onclick: async () => {
+          if (!confirm(`Remove ${m.ip}:${m.port}? It re-registers on its next heartbeat if still alive.`)) return;
+          await post(`/app/${encodeURIComponent(S.app)}/machine/remove.json`,
+                     { ip: m.ip, port: m.port });
+          refresh();
+        } }, "remove")),
       ]));
     }
     if (!S.machines.length) {
-      tbody.appendChild(h("tr", {}, h("td", { colspan: 5, class: "dim" },
+      tbody.appendChild(h("tr", {}, h("td", { colspan: 6, class: "dim" },
         "no machines")));
     }
   }

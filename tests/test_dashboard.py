@@ -376,3 +376,20 @@ def test_cluster_server_metrics_route(dash, clk, tmp_path):
     finally:
         coord.stop()
         rt.stop()
+
+
+def test_machine_remove_route(dash, clk):
+    d, dport = dash
+    d.receive_heartbeat({"app": "a", "ip": "1.2.3.4", "port": "8719"})
+    d.receive_heartbeat({"app": "a", "ip": "1.2.3.5", "port": "8719"})
+    out = _send(dport, "/app/a/machine/remove.json",
+                body={"ip": "1.2.3.4", "port": 8719})
+    assert out["success"]
+    left = _get(dport, "/app/a/machines.json")["data"]
+    assert [m["ip"] for m in left] == ["1.2.3.5"]
+    # removing the last machine drops the app from the list
+    _send(dport, "/app/a/machine/remove.json",
+          body={"ip": "1.2.3.5", "port": 8719})
+    assert "a" not in _get(dport, "/app/names.json")["data"]
+    assert not _send(dport, "/app/a/machine/remove.json",
+                     body={"ip": "9.9.9.9", "port": 1})["success"]
