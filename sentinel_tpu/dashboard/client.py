@@ -112,6 +112,10 @@ class SentinelApiClient:
         resp = self._post(ip, port, "setClusterMode", {"mode": str(mode)})
         return "success" in resp
 
+    def fetch_cluster_server_info(self, ip: str, port: int) -> Dict[str, Any]:
+        """``cluster/server/info`` (FetchClusterServerInfoCommandHandler)."""
+        return json.loads(self._get(ip, port, "cluster/server/info") or "{}")
+
     def fetch_cluster_server_metrics(self, ip: str, port: int,
                                      namespace: str) -> List[Dict[str, Any]]:
         """Token-server per-flow current-window metrics

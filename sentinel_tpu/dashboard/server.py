@@ -237,6 +237,16 @@ class Dashboard:
                 st = self.client.get_cluster_mode(m.ip, m.port)
             except AgentUnreachable:
                 st = {"mode": -1}
+            if st.get("mode") == 1:
+                # enrich server machines with live token-server info
+                # (connected count, idle seconds — cluster/server/info)
+                try:
+                    info = self.client.fetch_cluster_server_info(m.ip, m.port)
+                    st.setdefault("connectedCount",
+                                  info.get("connectedCount"))
+                    st.setdefault("idleSeconds", info.get("idleSeconds"))
+                except AgentUnreachable:
+                    pass
             st.update(ip=m.ip, port=m.port)
             out.append(st)
         return _ok(out)

@@ -450,7 +450,9 @@ async function viewCluster(c) {
     const states = j.data || [];
     tbody.innerHTML = "";
     for (const s of states) {
-      const srv = s.serverPort ? `listening :${s.serverPort}`
+      const srv = s.serverPort
+        ? `listening :${s.serverPort}` +
+          (s.connectedCount != null ? ` · ${s.connectedCount} clients` : "")
         : (s.serverHost ? `→ ${s.serverHost}:${s.clientServerPort ?? s.serverPort ?? ""}` : "—");
       const modeSel = h("select", {},
         Object.entries(MODES).map(([v, l]) =>
