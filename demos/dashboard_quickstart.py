@@ -44,6 +44,11 @@ def main() -> None:
         except stpu.BlockException:
             blocked += 1
     print(f"traffic under dashboard-pushed rule: pass={passed} block={blocked}")
+    import os
+    if os.environ.get("SENTINEL_DEMO_ONESHOT"):   # CI smoke: no serve loop
+        agent.stop()
+        dash.stop()
+        return
     print("press Ctrl-C to stop")
     try:
         while True:

@@ -19,7 +19,24 @@ def main() -> None:
     sph.load_flow_rules([stpu.FlowRule(resource="GET:/", count=5)])
     guarded = SentinelWSGIMiddleware(app, sph)
 
+    import os
     with make_server("127.0.0.1", 8000, guarded) as srv:
+        if os.environ.get("SENTINEL_DEMO_ONESHOT"):   # CI smoke: one probe
+            import threading
+            import urllib.error
+            import urllib.request
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+            codes = []
+            for _ in range(8):
+                try:
+                    with urllib.request.urlopen(
+                            "http://127.0.0.1:8000/") as r:
+                        codes.append(r.status)
+                except urllib.error.HTTPError as e:
+                    codes.append(e.code)
+            print("status codes:", codes)
+            srv.shutdown()
+            return
         print("serving on http://127.0.0.1:8000 — try "
               "`for i in $(seq 10); do curl -s -o /dev/null -w '%{http_code} ' "
               "http://127.0.0.1:8000/; done` (expect five 200s then 429s)")
