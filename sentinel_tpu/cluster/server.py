@@ -87,6 +87,11 @@ class ClusterTokenServer:
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
         self._stopping = False
+        # start-attempt epoch: a boot thread abandoned by start()'s timeout
+        # must not publish its loop/server over a newer attempt's (the
+        # transport-config rollback would otherwise signal the wrong loop)
+        self._epoch = 0
+        self._state_lock = threading.Lock()
         # micro-batch queues: (request, conn, future-resolution callback)
         self._flow_q: List[Tuple[codec.Request, _Conn]] = []
         self._param_q: List[Tuple[codec.Request, _Conn]] = []
@@ -153,10 +158,15 @@ class ClusterTokenServer:
         if self._thread is not None:
             return
         self._boot_error: Optional[BaseException] = None
-        self._thread = threading.Thread(target=self._run, daemon=True,
+        epoch = self._epoch
+        self._thread = threading.Thread(target=self._run, args=(epoch,),
+                                        daemon=True,
                                         name="sentinel-cluster-server")
         self._thread.start()
         if not self._started.wait(timeout=10):
+            with self._state_lock:
+                self._epoch += 1     # the late boot must not publish
+            self._thread = None
             raise RuntimeError("cluster token server failed to start")
         if self._boot_error is not None:
             self._thread.join(timeout=1)
@@ -189,29 +199,48 @@ class ClusterTokenServer:
             c.writer.close()
         await asyncio.sleep(0)  # let handler tasks observe the closes
 
-    def _run(self) -> None:
+    def _run(self, epoch: int) -> None:
         loop = asyncio.new_event_loop()
         asyncio.set_event_loop(loop)
-        self._loop = loop
-        self._q_event = asyncio.Event()
 
         async def boot():
-            self._server = await asyncio.start_server(
+            return await asyncio.start_server(
                 self._handle_conn, self.host, self.port)
-            if self.port == 0:
-                self.port = self._server.sockets[0].getsockname()[1]
-            loop.create_task(self._batch_loop())
-            loop.create_task(self._sweep_loop())
-            loop.create_task(self._idle_loop())
-            self._started.set()
 
         try:
-            loop.run_until_complete(boot())
+            server = loop.run_until_complete(boot())
         except BaseException as exc:    # bind failure → report, clean up
-            self._boot_error = exc
-            self._started.set()
+            with self._state_lock:
+                if self._epoch == epoch:
+                    self._boot_error = exc
+                    self._started.set()
             loop.close()
             return
+        with self._state_lock:
+            if self._epoch != epoch:
+                # start() timed this attempt out and moved on (e.g. the
+                # rollback server is already up) — release the socket and
+                # vanish without touching published state
+                abandoned = True
+            else:
+                abandoned = False
+                self._loop = loop
+                self._server = server
+                self._q_event = asyncio.Event()
+                if self.port == 0:
+                    self.port = server.sockets[0].getsockname()[1]
+        if abandoned:
+            server.close()
+            try:
+                loop.run_until_complete(server.wait_closed())
+            except Exception:
+                pass
+            loop.close()
+            return
+        loop.create_task(self._batch_loop())
+        loop.create_task(self._sweep_loop())
+        loop.create_task(self._idle_loop())
+        self._started.set()
         try:
             loop.run_forever()
         finally:

@@ -88,6 +88,9 @@ class HostFastPath:
         self._buf_bucket = -1
         self._last_flush_ms = 0
         self._lock = threading.Lock()
+        # bumped on every set_tables: a pre-charge granted under an older
+        # generation must not install (its budget belongs to the old rules)
+        self.table_gen = 0
         # observability: how many device dispatches the fast path avoided
         self.fast_admits = 0
         self.lease_renewals = 0
@@ -103,6 +106,7 @@ class HostFastPath:
             self._ineligible = ineligible
             self._lease_count = lease_counts
             self.sys_active = sys_active
+            self.table_gen += 1
             self._collect_expired_locked(drop_all=True)
             self._hot_bucket.clear()
 
@@ -175,11 +179,21 @@ class HostFastPath:
         return max(int(acquire), int(per_window * self.lease_fraction))
 
     def install_lease(self, row: int, chunk: int, used: int, is_in: bool,
-                      now_ms: int) -> None:
+                      now_ms: int, gen: Optional[int] = None) -> None:
         """Credit a granted pre-charge. MERGES into a live matching lease
         (every granted chunk was already recorded on device — dropping one
-        would waste budget, never over-admit)."""
+        would waste budget, never over-admit). ``gen`` (from
+        :attr:`table_gen` before the device pre-charge) guards a renewal
+        racing a rule reload: a chunk granted under the OLD tables must not
+        serve under the new (possibly lower) limit — its unused remainder
+        queues straight for window reversal instead (bounded
+        under-admission, the safe direction)."""
         with self._lock:
+            if gen is not None and gen != self.table_gen:
+                if chunk - used > 0:
+                    self._expired.append((row, now_ms, chunk - used, is_in))
+                self.fast_admits += 1
+                return
             b = self.bucket_of(now_ms)
             lease = self._leases.get(row)
             if (lease is not None and lease.bucket_idx == b

@@ -87,11 +87,20 @@ class HostGate:
     def check_batch(self, resources: Sequence[str],
                     origins: Optional[Sequence[str]],
                     acquire, args_list) -> Sequence[bool]:
+        from sentinel_tpu.core.errors import BlockException
+
         out = []
         for i, r in enumerate(resources):
             org = origins[i] if origins is not None and origins[i] else ""
             args = args_list[i] if args_list is not None else ()
-            out.append(bool(self.check(r, org, int(acquire[i]), args)))
+            try:
+                ok = bool(self.check(r, org, int(acquire[i]), args))
+            except BlockException:
+                # the documented deny style on the entry() path denies
+                # just this event on the batch tier (custom exception
+                # classes collapse to the gate's reason code here)
+                ok = False
+            out.append(ok)
         return out
 
 

@@ -301,6 +301,9 @@ class Sentinel:
         # circuit-breaker transition observers (EventObserverRegistry)
         self._breaker_observers: list = []
         self._breaker_prev: Optional[List[Tuple[str, int]]] = None
+        # serializes the poll: concurrent diffs against one baseline would
+        # double-fire observers and lose interleaved transitions
+        self._breaker_poll_lock = threading.Lock()
 
         (self._jit_decide, self._jit_decide_prio, self._jit_exit,
          self._jit_invalidate, self._jit_record_blocks) = \
@@ -330,6 +333,11 @@ class Sentinel:
             lease_fraction=cfg.fast_path_lease_fraction,
             win_ms=self.spec.second.win_ms)
         self._fast_enabled = bool(cfg.host_fast_path)
+        # serializes drain→dispatch in _flush_fast: without it a concurrent
+        # flush could land a buffered EXIT before the flush carrying its
+        # matching pass, leaving the thread gauge permanently skewed (the
+        # exit decrement clamps at 0, the late pass increment doesn't)
+        self._flush_lock = threading.Lock()
 
         # SPI-discovered slots (SlotChainProvider.newSlotChain analog:
         # every new "chain" is built from the registered ProcessorSlot
@@ -467,7 +475,16 @@ class Sentinel:
         recorded like every other block."""
         from sentinel_tpu.engine import slots as slots_mod
 
+        # reason codes live in int8 verdict arrays: DeviceSlot i maps to
+        # CUSTOM_BASE+i (must stay below CUSTOM_GATE_BASE), HostGate i to
+        # CUSTOM_GATE_BASE+i (must stay below 128) — enforce the caps
+        # loudly instead of silently wrapping into another slot's code
+        max_dev = int(BlockReason.CUSTOM_GATE_BASE) - int(
+            BlockReason.CUSTOM_BASE)
+        max_gate = 128 - int(BlockReason.CUSTOM_GATE_BASE)
         if isinstance(slot, slots_mod.DeviceSlot):
+            if len(self._device_slots) >= max_dev:
+                raise ValueError(f"at most {max_dev} device slots")
             self._flush_fast()      # land buffered stats via the old step
             with self._lock:
                 self._device_slots = self._device_slots + (slot,)
@@ -476,6 +493,8 @@ class Sentinel:
                 self._fast_enabled = False
                 self._reload_custom_jits_locked()
         elif isinstance(slot, slots_mod.HostGate):
+            if len(self._host_gates) >= max_gate:
+                raise ValueError(f"at most {max_gate} host gates")
             with self._lock:
                 self._host_gates = self._host_gates + (slot,)
         else:
@@ -1014,10 +1033,20 @@ class Sentinel:
                 try:
                     # re-check under the claim (another thread may have
                     # installed a lease between lease_state and here)
-                    if fast.lease_state(row, acquire, is_in,
-                                        now) != fp_mod.ADMIT:
+                    recheck = fast.lease_state(row, acquire, is_in, now)
+                    if recheck == fp_mod.DEVICE:
+                        # a mismatched-entry-type lease went live meanwhile:
+                        # pre-charging a second chunk would double-spend
+                        # the window — exactly what DEVICE exists to avoid
+                        return None
+                    if recheck != fp_mod.ADMIT:
                         chunk = fast.lease_chunk(row, acquire)
+                        gen0 = fast.table_gen
                         ra = self.spec.alt_rows
+                        # at_ms=now: the chunk's PASS must land in the SAME
+                        # bucket the lease is stamped with — a rotation
+                        # mid-pre-charge would otherwise make the expiry
+                        # uncount target a bucket that never held the chunk
                         v = self.decide_raw(
                             np.array([row], np.int32), np.zeros(1, np.int32),
                             np.array([ra], np.int32), np.zeros(1, np.int32),
@@ -1026,11 +1055,13 @@ class Sentinel:
                             np.array([is_in], np.bool_),
                             np.zeros(1, np.bool_),
                             count_thread=np.zeros(1, np.bool_),
-                            record_block=np.zeros(1, np.bool_))
+                            record_block=np.zeros(1, np.bool_),
+                            at_ms=now)
                         if not bool(v.allow[0]):
                             fast.mark_hot(row, now)
                             return None
-                        fast.install_lease(row, chunk, acquire, is_in, now)
+                        fast.install_lease(row, chunk, acquire, is_in, now,
+                                           gen=gen0)
                 finally:
                     fast.end_renewal(row)
             mode = "leased"
@@ -1055,6 +1086,10 @@ class Sentinel:
         block → pure StatisticSlot recording), exits through the batched
         exit step."""
         now = self.clock.now_ms() if now_ms is None else now_ms
+        with self._flush_lock:
+            self._flush_fast_locked(now)
+
+    def _flush_fast_locked(self, now: int) -> None:
         passes, exits, expired = self._fast.drain(now)
         if not passes and not exits and not expired:
             return
@@ -1213,18 +1248,6 @@ class Sentinel:
             compiled = self._param
             registry = self.param_key_registry
             gen = self._param_gen
-        pin_arr = None
-        if args_list is not None and compiled.num_active:
-            param_gen = gen
-            param_rules, param_keys = pf_mod.resolve_pairs_many(
-                compiled, registry, rows, args_list, self.spec.param_pairs)
-            # pin THREAD-grade pairs while in flight (released for blocked
-            # events below; allowed events stay pinned until exit_batch);
-            # computed once and reused for the blocked-event release
-            pin_arr = pf_mod.thread_key_rows(
-                compiled, param_rules, param_keys).reshape(
-                    param_keys.shape)
-            registry.pin_rows(pin_arr)
         origin_ids = np.zeros(n, np.int32)
         origin_rows = np.full(n, self.spec.alt_rows, np.int32)
         context_ids = np.zeros(n, np.int32)
@@ -1248,13 +1271,28 @@ class Sentinel:
             else np.zeros(n, np.bool_)
 
         # user host gates veto first (slot-chain SPI tier 1); denials are
-        # logged in the gate runner and device-recorded batched below
+        # logged in the gate runner and device-recorded batched below.
+        # Gates run BEFORE param-key pinning: a gate that raises must not
+        # leak pins (a custom check_batch raising propagates to the caller)
         gate_blocked = gate_reasons = None
         if self._host_gates:
             gate_blocked, gate_reasons = self._run_host_gates_batch(
                 resources, origins, acq, args_list, is_in, n)
             if not gate_blocked.any():
                 gate_blocked = gate_reasons = None
+
+        pin_arr = None
+        if args_list is not None and compiled.num_active:
+            param_gen = gen
+            param_rules, param_keys = pf_mod.resolve_pairs_many(
+                compiled, registry, rows, args_list, self.spec.param_pairs)
+            # pin THREAD-grade pairs while in flight (released for blocked
+            # events below; allowed events stay pinned until exit_batch);
+            # computed once and reused for the blocked-event release
+            pin_arr = pf_mod.thread_key_rows(
+                compiled, param_rules, param_keys).reshape(
+                    param_keys.shape)
+            registry.pin_rows(pin_arr)
 
         # cluster-mode rules: token delegation BEFORE the local decide, ONE
         # batched RPC for the whole batch when the service supports it.
@@ -1534,7 +1572,8 @@ class Sentinel:
                    acquire, is_in, prioritized, *, param_rules=None,
                    param_keys=None, param_gen: int = -1,
                    cluster_fallback=None, valid=None,
-                   count_thread=None, record_block=None) -> Verdicts:
+                   count_thread=None, record_block=None,
+                   at_ms: Optional[int] = None) -> Verdicts:
         """Lowest-level host entry point: pre-resolved numpy arrays.
         ``param_gen`` is the generation the pair arrays were resolved against;
         stale pairs (a reload raced the resolve) are dropped, not misapplied."""
@@ -1543,7 +1582,8 @@ class Sentinel:
             is_in, prioritized, param_rules=param_rules,
             param_keys=param_keys, param_gen=param_gen,
             cluster_fallback=cluster_fallback, valid=valid,
-            count_thread=count_thread, record_block=record_block).result()
+            count_thread=count_thread, record_block=record_block,
+            at_ms=at_ms).result()
 
     def decide_raw_nowait(self, rows, origin_ids, origin_rows, context_ids,
                           chain_rows, acquire, is_in, prioritized, *,
@@ -1860,23 +1900,25 @@ class Sentinel:
             observers = self._breaker_observers
         if not observers:
             return 0
-        current = self.breaker_resources()
-        prev = self._breaker_prev
-        self._breaker_prev = current
-        if prev is None or [r for r, _s in prev] != [r for r, _s in current]:
-            return 0
-        fired = 0
-        for (res, old), (_res, new) in zip(prev, current):
-            if old != new:
-                fired += 1
-                for fn in observers:
-                    try:
-                        fn(res, old, new)
-                    except Exception as exc:
-                        from sentinel_tpu.core.logs import record_log
-                        record_log().warning(
-                            "breaker observer failed: %r", exc)
-        return fired
+        with self._breaker_poll_lock:
+            current = self.breaker_resources()
+            prev = self._breaker_prev
+            self._breaker_prev = current
+            if (prev is None
+                    or [r for r, _s in prev] != [r for r, _s in current]):
+                return 0
+            fired = 0
+            for (res, old), (_res, new) in zip(prev, current):
+                if old != new:
+                    fired += 1
+                    for fn in observers:
+                        try:
+                            fn(res, old, new)
+                        except Exception as exc:
+                            from sentinel_tpu.core.logs import record_log
+                            record_log().warning(
+                                "breaker observer failed: %r", exc)
+            return fired
 
     def breaker_resources(self) -> List[Tuple[str, int]]:
         """(resource, state) per loaded degrade rule, rule-slot order

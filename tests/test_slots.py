@@ -191,3 +191,37 @@ def test_reason_code_spaces_disjoint(clk):
     with pytest.raises(stpu.CustomSlotException) as e2:
         sph.entry("svc", acquire=3)
     assert e2.value.slot_name == "odd-acquire"
+
+
+def test_gate_raising_block_exception_denies_event_in_batch(clk):
+    """A gate whose check() RAISES (the documented entry()-path deny
+    style) must deny just that event on the batch tier, not crash the
+    whole entry_batch (review finding: the raise used to propagate out
+    of entry_batch_nowait and leak param-key pins)."""
+    class RaisingGate(stpu.HostGate):
+        name = "raising-gate"
+
+        def check(self, resource, origin, acquire, args):
+            if resource == "forbidden":
+                raise stpu.AuthorityException(resource)
+            return True
+
+    sph = make(clk, max_param_rules=8, param_table_slots=64)
+    sph.load_param_flow_rules([stpu.ParamFlowRule(
+        resource="hot", param_idx=0, count=100)])
+    sph.register_slot(RaisingGate())
+    v = sph.entry_batch(["hot", "forbidden", "hot"],
+                        args_list=[(1,), (2,), (3,)])
+    assert list(np.asarray(v.allow)) == [True, False, True]
+    # no pins leaked: the registry has no live pin refcounts (QPS-grade
+    # rules never pin; a leak would show as stale entries here)
+    assert sph.param_key_registry._pins == {}
+
+
+def test_slot_registration_caps_are_enforced(clk):
+    sph = make(clk)
+    max_gates = 128 - int(stpu.BlockReason.CUSTOM_GATE_BASE)
+    for i in range(max_gates):
+        sph.register_slot(stpu.HostGate())
+    with pytest.raises(ValueError):
+        sph.register_slot(stpu.HostGate())
