@@ -29,3 +29,6 @@ __all__ = [
     "sentinel_resource", "SentinelWSGIMiddleware", "SentinelASGIMiddleware",
     "async_entry", "SentinelSession", "guarded_urlopen",
 ]
+from sentinel_tpu.adapters.asgi_gateway import (  # noqa: F401
+    AsgiRequestItemParser, SentinelGatewayASGIMiddleware,
+)

@@ -265,3 +265,65 @@ def test_gateway_command_surface():
     resp = center.handle("gateway/updateRules", CommandRequest(
         parameters={"data": "not json"}))
     assert not resp.success and resp.code == 400
+
+
+def test_gateway_asgi_middleware_end_to_end(clk):
+    """SentinelGatewayFilter analog: route + API-group resources with a
+    header matcher, driven through a fake ASGI app."""
+    import asyncio
+
+    from sentinel_tpu.adapters import SentinelGatewayASGIMiddleware
+    from sentinel_tpu.gateway import (
+        ApiDefinition, ApiPathPredicateItem, GatewayApiDefinitionManager,
+        GatewayFlowRule, GatewayParamFlowItem, GatewayRuleManager,
+    )
+    from sentinel_tpu.gateway.api import URL_MATCH_STRATEGY_PREFIX
+    from sentinel_tpu.gateway.rules import PARAM_PARSE_STRATEGY_HEADER
+
+    sph, mgr = make(clk)
+    apis = GatewayApiDefinitionManager()
+    apis.load_api_definitions([ApiDefinition("orders_api", (
+        ApiPathPredicateItem("/orders/**", URL_MATCH_STRATEGY_PREFIX),))])
+    mgr.load_rules([
+        # per-tenant (header) limit on the API group
+        GatewayFlowRule(resource="orders_api", resource_mode=1, count=2,
+                        param_item=GatewayParamFlowItem(
+                            parse_strategy=PARAM_PARSE_STRATEGY_HEADER,
+                            field_name="X-Tenant")),
+    ])
+
+    served = []
+
+    async def app(scope, receive, send):
+        served.append(scope["path"])
+        await send({"type": "http.response.start", "status": 200,
+                    "headers": []})
+        await send({"type": "http.response.body", "body": b"ok"})
+
+    guarded = SentinelGatewayASGIMiddleware(app, sph, mgr, apis)
+
+    def request(path, tenant):
+        sent = []
+
+        async def drive():
+            async def receive():
+                return {"type": "http.request", "body": b"",
+                        "more_body": False}
+
+            async def send(msg):
+                sent.append(msg)
+            await guarded({"type": "http", "path": path, "method": "GET",
+                           "query_string": b"",
+                           "headers": [(b"x-tenant",
+                                        tenant.encode())]},
+                          receive, send)
+        asyncio.run(drive())
+        return sent[0]["status"]
+
+    codes_a = [request("/orders/1", "tenant-a") for _ in range(4)]
+    codes_b = [request("/orders/2", "tenant-b") for _ in range(2)]
+    assert codes_a == [200, 200, 429, 429]   # per-tenant count=2
+    assert codes_b == [200, 200]             # other tenant unaffected
+    assert len(served) == 4
+    # non-matching path: only the route resource (no rules) → passes
+    assert request("/health", "tenant-a") == 200
