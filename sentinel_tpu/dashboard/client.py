@@ -112,6 +112,13 @@ class SentinelApiClient:
         resp = self._post(ip, port, "setClusterMode", {"mode": str(mode)})
         return "success" in resp
 
+    def fetch_origin_stats(self, ip: str, port: int,
+                           resource: str) -> List[Dict[str, Any]]:
+        """Per-origin rolling stats of one resource (agent ``origin``
+        command — ``FetchOriginCommandHandler``)."""
+        return json.loads(self._get(ip, port, "origin",
+                                    {"id": resource}) or "[]")
+
     def fetch_cluster_server_info(self, ip: str, port: int) -> Dict[str, Any]:
         """``cluster/server/info`` (FetchClusterServerInfoCommandHandler)."""
         return json.loads(self._get(ip, port, "cluster/server/info") or "{}")

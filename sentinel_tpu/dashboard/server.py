@@ -408,6 +408,14 @@ class _Handler(BaseHTTPRequestHandler):
             except AgentUnreachable as exc:
                 self._json(_fail(str(exc)))
             return
+        if method == "GET" and path == "/resource/origin.json":
+            try:
+                self._json(_ok(d.client.fetch_origin_stats(
+                    q.get("ip", ""), int(q.get("port", "0") or 0),
+                    q.get("id", ""))))
+            except AgentUnreachable as exc:
+                self._json(_fail(str(exc)))
+            return
         if method == "GET" and path == "/resource/jsonTree.json":
             try:
                 self._json(_ok(d.client.fetch_json_tree(

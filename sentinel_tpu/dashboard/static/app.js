@@ -382,7 +382,7 @@ async function viewResources(c) {
       return;
     }
     for (const n of (j.data || [])) {
-      tbody.appendChild(h("tr", {}, [
+      const row = h("tr", {}, [
         h("td", {}, n.resource),
         h("td", { class: "num ok" }, String(n.passQps)),
         h("td", { class: "num " + (n.blockQps ? "bad" : "") }, String(n.blockQps)),
@@ -391,10 +391,41 @@ async function viewResources(c) {
         h("td", { class: "num " + (n.exceptionQps ? "warn" : "") }, String(n.exceptionQps)),
         h("td", { class: "num" }, String(n.averageRt)),
         h("td", { class: "num" }, String(n.threadNum)),
-        h("td", {}, h("button", { class: "sm",
-          onclick: () => openRuleModal("flow", { resource: n.resource }) },
-          "+ flow rule")),
-      ]));
+        h("td", {}, [
+          h("button", { class: "sm", onclick: async (ev) => {
+            // per-origin drill-down (agent `origin` command)
+            const next = row.nextSibling;
+            if (next && next.dataset && next.dataset.originFor === n.resource) {
+              next.remove(); return;
+            }
+            const o = await api(`/resource/origin.json?ip=${ip}&port=${port}&id=${encodeURIComponent(n.resource)}`);
+            const origins = (o && o.data) || [];
+            const detail = h("tr", {}, h("td", { colspan: 9 },
+              origins.length
+                ? h("table", {}, [
+                    h("thead", {}, h("tr", {}, ["origin", "pass", "block",
+                      "success", "exception", "threads"].map(t =>
+                        h("th", {}, t)))),
+                    h("tbody", {}, origins.map(g => h("tr", {}, [
+                      h("td", {}, g.origin),
+                      h("td", { class: "num ok" }, String(g.passQps)),
+                      h("td", { class: "num" }, String(g.blockQps)),
+                      h("td", { class: "num" }, String(g.successQps)),
+                      h("td", { class: "num" }, String(g.exceptionQps)),
+                      h("td", { class: "num" }, String(g.threadNum)),
+                    ])))])
+                : h("span", { class: "dim" },
+                    "no per-origin traffic on this resource")));
+            detail.dataset.originFor = n.resource;
+            row.after(detail);
+          } }, "origins"),
+          " ",
+          h("button", { class: "sm",
+            onclick: () => openRuleModal("flow", { resource: n.resource }) },
+            "+ flow rule"),
+        ]),
+      ]);
+      tbody.appendChild(row);
     }
     if (!(j.data || []).length) {
       tbody.appendChild(h("tr", {}, h("td", { colspan: 9, class: "dim" },

@@ -393,3 +393,18 @@ def test_machine_remove_route(dash, clk):
     assert "a" not in _get(dport, "/app/names.json")["data"]
     assert not _send(dport, "/app/a/machine/remove.json",
                      body={"ip": "9.9.9.9", "port": 1})["success"]
+
+
+def test_origin_stats_route(agent, dash, clk):
+    sph, _timer, aport = agent
+    _d, dport = dash
+    _beat(aport, dport, clk)
+    for origin in ("web-app", "job-runner", "web-app"):
+        with stpu.ContextScope("ctx", origin=origin):
+            with sph.entry("svc"):
+                pass
+    out = _get(dport,
+               f"/resource/origin.json?ip=127.0.0.1&port={aport}&id=svc")
+    assert out["success"]
+    by = {o["origin"]: o["passQps"] for o in out["data"]}
+    assert by == {"web-app": 2, "job-runner": 1}
