@@ -311,6 +311,8 @@ async function loadMachines() {
 }
 async function viewMachines(c) {
   const tbody = h("tbody", {});
+  const sysBox = h("div", {});
+  c.appendChild(sysBox);
   c.appendChild(h("div", { class: "card" }, [
     h("h3", {}, [h("span", {}, `Machines — ${S.app}`)]),
     h("table", {}, [h("thead", {}, h("tr", {}, [
@@ -318,8 +320,40 @@ async function viewMachines(c) {
       "",
     ].map(t => h("th", {}, t)))), tbody]),
   ]));
+  async function refreshSystem() {
+    // adaptive-protection live gauges per healthy machine (systemStatus)
+    const rows = [];
+    for (const m of S.machines.filter(x => x.healthy)) {
+      const j = await api(`/systemStatus.json?ip=${m.ip}&port=${m.port}`);
+      if (!j || !j.success || !j.data) continue;
+      const s = j.data;
+      rows.push(h("tr", {}, [
+        h("td", {}, `${m.ip}:${m.port}`),
+        h("td", { class: "num" }, String(s.qps ?? "—")),
+        h("td", { class: "num" }, String(s.thread ?? "—")),
+        h("td", { class: "num" }, String(s.rt ?? "—")),
+        h("td", { class: "num" },
+          s.load != null && s.load >= 0 ? s.load.toFixed(2) : "—"),
+        h("td", { class: "num" },
+          s.cpuUsage != null && s.cpuUsage >= 0
+            ? (s.cpuUsage * 100).toFixed(1) + " %" : "—"),
+      ]));
+    }
+    sysBox.innerHTML = "";
+    if (rows.length) {
+      sysBox.appendChild(h("div", { class: "card" }, [
+        h("h3", {}, [h("span", {}, "System status"),
+          h("span", { class: "sub" },
+            "inbound QPS · concurrency · avg RT · load1 · CPU (SystemSlot inputs)")]),
+        h("table", {}, [h("thead", {}, h("tr", {},
+          ["machine", "qps", "threads", "rt ms", "load1", "cpu"].map(t =>
+            h("th", {}, t)))), h("tbody", {}, rows)]),
+      ]));
+    }
+  }
   async function refresh() {
     await loadMachines();
+    refreshSystem();
     tbody.innerHTML = "";
     for (const m of S.machines) {
       tbody.appendChild(h("tr", {}, [
