@@ -331,7 +331,8 @@ async function viewMachines(c) {
         h("td", {}, `${m.ip}:${m.port}`),
         h("td", { class: "num" }, String(s.qps ?? "—")),
         h("td", { class: "num" }, String(s.thread ?? "—")),
-        h("td", { class: "num" }, String(s.rt ?? "—")),
+        h("td", { class: "num" },
+          s.rt != null ? Number(s.rt).toFixed(2) : "—"),
         h("td", { class: "num" },
           s.load != null && s.load >= 0 ? s.load.toFixed(2) : "—"),
         h("td", { class: "num" },
