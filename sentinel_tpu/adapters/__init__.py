@@ -15,6 +15,8 @@ ecosystem's equivalents:
   (``sentinel-okhttp-adapter`` / ``sentinel-apache-httpclient-adapter``)
 - :mod:`.asyncio_support` — async entry helper (``sentinel-reactor-adapter``
   ``AsyncEntry`` analog for asyncio)
+- :mod:`.asgi_gateway` — gateway middleware: route + API-group resources
+  with request-attribute matchers (``sentinel-spring-cloud-gateway-adapter``)
 """
 
 from sentinel_tpu.adapters.decorator import sentinel_resource
@@ -24,11 +26,12 @@ from sentinel_tpu.adapters.asyncio_support import async_entry
 from sentinel_tpu.adapters.http_client import (
     SentinelSession, guarded_urlopen,
 )
+from sentinel_tpu.adapters.asgi_gateway import (
+    AsgiRequestItemParser, SentinelGatewayASGIMiddleware,
+)
 
 __all__ = [
     "sentinel_resource", "SentinelWSGIMiddleware", "SentinelASGIMiddleware",
     "async_entry", "SentinelSession", "guarded_urlopen",
+    "AsgiRequestItemParser", "SentinelGatewayASGIMiddleware",
 ]
-from sentinel_tpu.adapters.asgi_gateway import (  # noqa: F401
-    AsgiRequestItemParser, SentinelGatewayASGIMiddleware,
-)

@@ -19,9 +19,9 @@ from typing import Callable, List, Optional, Tuple
 
 from sentinel_tpu.core.context import ContextScope
 from sentinel_tpu.core.errors import BlockException
+from sentinel_tpu.metrics.node import TYPE_GATEWAY
 
 WEB_CONTEXT_NAME = "sentinel_gateway_context"
-TYPE_GATEWAY = 4                    # ResourceTypeConstants.COMMON_API_GATEWAY
 RESOURCE_MODE_ROUTE_ID = 0
 RESOURCE_MODE_CUSTOM_API_NAME = 1
 
@@ -119,6 +119,13 @@ class SentinelGatewayASGIMiddleware:
                     e.exit()
                 await self._blocked(send)
                 return
+            except BaseException:
+                # non-Block failure mid-loop (a raising host gate, an
+                # internal error): already-opened entries must not leak
+                # concurrency
+                for e in reversed(entries):
+                    e.exit()
+                raise
         try:
             if wait_ms > 0:         # pacing verdict: await, don't block
                 await asyncio.sleep(wait_ms / 1000.0)

@@ -46,6 +46,7 @@ function setPath(obj, path, v) {
 const S = {
   apps: [], app: null, view: "metrics", timer: null,
   machines: [], machineSel: "", range: 300, chartData: {},
+  openOrigins: new Set(),   // resources with the origin drill-down expanded
 };
 
 function setRefresh(fn, ms) {
@@ -321,10 +322,14 @@ async function viewMachines(c) {
     ].map(t => h("th", {}, t)))), tbody]),
   ]));
   async function refreshSystem() {
-    // adaptive-protection live gauges per healthy machine (systemStatus)
+    // adaptive-protection live gauges per healthy machine (systemStatus);
+    // fetched in parallel so one slow agent can't stall the rest
+    const healthy = S.machines.filter(x => x.healthy);
+    const results = await Promise.all(healthy.map(m =>
+      api(`/systemStatus.json?ip=${m.ip}&port=${m.port}`)));
     const rows = [];
-    for (const m of S.machines.filter(x => x.healthy)) {
-      const j = await api(`/systemStatus.json?ip=${m.ip}&port=${m.port}`);
+    for (let i = 0; i < healthy.length; i++) {
+      const m = healthy[i], j = results[i];
       if (!j || !j.success || !j.data) continue;
       const s = j.data;
       rows.push(h("tr", {}, [
@@ -427,32 +432,12 @@ async function viewResources(c) {
         h("td", { class: "num" }, String(n.averageRt)),
         h("td", { class: "num" }, String(n.threadNum)),
         h("td", {}, [
-          h("button", { class: "sm", onclick: async (ev) => {
-            // per-origin drill-down (agent `origin` command)
-            const next = row.nextSibling;
-            if (next && next.dataset && next.dataset.originFor === n.resource) {
-              next.remove(); return;
-            }
-            const o = await api(`/resource/origin.json?ip=${ip}&port=${port}&id=${encodeURIComponent(n.resource)}`);
-            const origins = (o && o.data) || [];
-            const detail = h("tr", {}, h("td", { colspan: 9 },
-              origins.length
-                ? h("table", {}, [
-                    h("thead", {}, h("tr", {}, ["origin", "pass", "block",
-                      "success", "exception", "threads"].map(t =>
-                        h("th", {}, t)))),
-                    h("tbody", {}, origins.map(g => h("tr", {}, [
-                      h("td", {}, g.origin),
-                      h("td", { class: "num ok" }, String(g.passQps)),
-                      h("td", { class: "num" }, String(g.blockQps)),
-                      h("td", { class: "num" }, String(g.successQps)),
-                      h("td", { class: "num" }, String(g.exceptionQps)),
-                      h("td", { class: "num" }, String(g.threadNum)),
-                    ])))])
-                : h("span", { class: "dim" },
-                    "no per-origin traffic on this resource")));
-            detail.dataset.originFor = n.resource;
-            row.after(detail);
+          h("button", { class: "sm", onclick: () => {
+            // per-origin drill-down (agent `origin` command); state
+            // survives the 3 s auto-refresh rebuild
+            if (S.openOrigins.has(n.resource)) S.openOrigins.delete(n.resource);
+            else S.openOrigins.add(n.resource);
+            refresh();
           } }, "origins"),
           " ",
           h("button", { class: "sm",
@@ -461,6 +446,26 @@ async function viewResources(c) {
         ]),
       ]);
       tbody.appendChild(row);
+      if (S.openOrigins.has(n.resource)) {
+        const o = await api(`/resource/origin.json?ip=${ip}&port=${port}&id=${encodeURIComponent(n.resource)}`);
+        const origins = (o && o.data) || [];
+        tbody.appendChild(h("tr", {}, h("td", { colspan: 9 },
+          origins.length
+            ? h("table", {}, [
+                h("thead", {}, h("tr", {}, ["origin", "pass", "block",
+                  "success", "exception", "threads"].map(t =>
+                    h("th", {}, t)))),
+                h("tbody", {}, origins.map(g => h("tr", {}, [
+                  h("td", {}, g.origin),
+                  h("td", { class: "num ok" }, String(g.passQps)),
+                  h("td", { class: "num" }, String(g.blockQps)),
+                  h("td", { class: "num" }, String(g.successQps)),
+                  h("td", { class: "num" }, String(g.exceptionQps)),
+                  h("td", { class: "num" }, String(g.threadNum)),
+                ])))])
+            : h("span", { class: "dim" },
+                "no per-origin traffic on this resource"))));
+      }
     }
     if (!(j.data || []).length) {
       tbody.appendChild(h("tr", {}, h("td", { colspan: 9, class: "dim" },
