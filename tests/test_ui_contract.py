@@ -1,0 +1,125 @@
+"""UI ⇄ server contract: with no JS engine in the image, the SPA can't be
+executed — so pin the two drift-prone seams mechanically instead:
+
+1. every URL path the SPA references must match a route the dashboard
+   server actually serves;
+2. every field name in the SPA's rule-editor schemas must survive the
+   server's codec canonicalization (a renamed codec field would silently
+   drop the editor's input).
+"""
+
+import json
+import re
+from pathlib import Path
+
+import pytest
+
+from sentinel_tpu.dashboard.server import RULE_TYPES, Dashboard
+
+STATIC = Path(__file__).resolve().parent.parent / \
+    "sentinel_tpu" / "dashboard" / "static"
+SERVER_SRC = (Path(__file__).resolve().parent.parent /
+              "sentinel_tpu" / "dashboard" / "server.py").read_text()
+APP_JS = (STATIC / "app.js").read_text()
+
+
+def _served_paths():
+    """Literal paths + regex routes from the server source."""
+    literals = set(re.findall(r'path == "([^"]+)"', SERVER_SRC))
+    literals |= {m for m in re.findall(r'path in \(([^)]+)\)', SERVER_SRC)
+                 for m in re.findall(r'"([^"]+)"', m)}
+    patterns = [re.compile(p) for p in
+                re.findall(r're\.fullmatch\(r"([^"]+)"', SERVER_SRC)]
+    return literals, patterns
+
+
+def _spa_paths():
+    """URL paths the SPA fetches (template params normalized)."""
+    raw = set(re.findall(r'[`"](/[A-Za-z0-9_./${}()-]*)[`"?]', APP_JS))
+    raw |= set(re.findall(r'[`"](/[A-Za-z0-9_./${}()-]*)\?', APP_JS))
+    out = set()
+    for p in raw:
+        p = re.sub(r"\$\{[^}]*\}", "X", p)     # ${...} → X
+        if p in ("/", "/static/app.js", "/static/style.css"):
+            continue
+        out.add(p)
+    return sorted(out)
+
+
+def test_every_spa_path_is_served():
+    literals, patterns = _served_paths()
+    missing = []
+    for p in _spa_paths():
+        if p in literals:
+            continue
+        # /v1/X/... carries a rule type; substitute a real one for the
+        # type-check inside the route, the regex itself takes any segment
+        candidates = [p, p.replace("/v1/X/", "/v1/flow/"),
+                      p.replace("/v1/X/", "/v1/flow/").replace("/rule/X",
+                                                               "/rule/1")]
+        if p.startswith("/app/"):
+            candidates.append(p.replace("/app/X/", "/app/anyapp/"))
+        if any(pat.fullmatch(c) for pat in patterns for c in candidates):
+            continue
+        missing.append(p)
+    assert not missing, f"SPA references unserved paths: {missing}"
+
+
+def _schema_fields():
+    """rtype → top-level field names from the SPA's SCHEMAS block."""
+    m = re.search(r"const SCHEMAS = \{(.*?)\n\};", APP_JS, re.S)
+    assert m, "SCHEMAS block not found in app.js"
+    body = m.group(1)
+    out = {}
+    for tm in re.finditer(r"\n  (\w+): \[(.*?)\n  \],", body, re.S):
+        rtype, fields_src = tm.group(1), tm.group(2)
+        fields = set()
+        # (?<![A-Za-z]) so `pattern: "/"` can't false-match as `n: "/"`
+        for fm in re.finditer(r'(?<![A-Za-z])n: "([^"]+)"', fields_src):
+            name = fm.group(1)
+            if name.startswith("_"):     # virtual UI-only fields
+                continue
+            fields.add(name.split(".")[0])
+        out[rtype] = fields
+    return out
+
+
+# representative rule per type with every cluster/param branch active, so
+# canonicalization emits the conditional keys too
+SAMPLES = {
+    "flow": {"resource": "r", "limitApp": "default", "grade": 1, "count": 1,
+             "strategy": 1, "refResource": "other", "controlBehavior": 3,
+             "warmUpPeriodSec": 10, "maxQueueingTimeMs": 500,
+             "clusterMode": True, "clusterConfig": {"flowId": 1}},
+    "degrade": {"resource": "r", "grade": 0, "count": 0.5,
+                "slowRatioThreshold": 0.6, "timeWindow": 10,
+                "minRequestAmount": 5, "statIntervalMs": 1000},
+    "paramFlow": {"resource": "r", "paramIdx": 0, "grade": 1, "count": 1,
+                  "durationInSec": 1, "burstCount": 0, "controlBehavior": 2,
+                  "maxQueueingTimeMs": 100, "clusterMode": True,
+                  "clusterConfig": {"flowId": 2},
+                  "paramFlowItemList": [{"object": "v", "count": 1,
+                                         "classType": "String"}]},
+    "system": {"highestSystemLoad": 1.0, "highestCpuUsage": 0.5, "qps": 10,
+               "avgRt": 5, "maxThread": 8},
+    "authority": {"resource": "r", "limitApp": "a,b", "strategy": 0},
+    "gatewayFlow": {"resource": "route", "resourceMode": 0, "grade": 1,
+                    "count": 1, "intervalSec": 1, "controlBehavior": 2,
+                    "burst": 0, "maxQueueingTimeoutMs": 100,
+                    "paramItem": {"parseStrategy": 2, "fieldName": "H",
+                                  "pattern": "x", "matchStrategy": 0}},
+    "gatewayApi": {"apiName": "api", "predicateItems": [
+        {"pattern": "/x/**", "matchStrategy": 1}]},
+}
+
+
+@pytest.mark.parametrize("rtype", sorted(SAMPLES))
+def test_editor_fields_survive_codec_roundtrip(rtype):
+    assert rtype in RULE_TYPES
+    fields = _schema_fields()[rtype]
+    assert fields, f"no fields scraped for {rtype}"
+    canonical = Dashboard._canonical(rtype, json.loads(
+        json.dumps(SAMPLES[rtype])))
+    dropped = [f for f in fields if f not in canonical]
+    assert not dropped, (
+        f"{rtype}: editor fields silently dropped by the codec: {dropped}")
