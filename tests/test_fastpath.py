@@ -385,3 +385,39 @@ def test_threaded_leased_path_never_overadmits():
         pair = per_bucket.get(b, 0) + per_bucket.get(b + 1, 0)
         assert pair <= COUNT, (
             f"window [{b},{b + 1}]: {pair} admissions > {COUNT}")
+
+
+def test_threaded_free_path_thread_gauge_returns_to_zero():
+    """Concurrent entry/exit churn on a rule-free resource with aggressive
+    flushing: after the dust settles the device thread gauge must be 0 —
+    the drain→dispatch ordering guarantee of the flush lock (a reordered
+    exit-before-pass would leave a permanent +1)."""
+    import threading
+
+    import sentinel_tpu as stpu
+
+    sph = stpu.Sentinel(stpu.load_config(
+        max_resources=32, max_flow_rules=8, max_degrade_rules=8,
+        max_authority_rules=8, host_fast_path=True,
+        fast_path_flush_events=4, fast_path_flush_ms=1))
+    with sph.entry("free-res"):
+        pass
+
+    stop = threading.Event()
+
+    def worker():
+        while not stop.is_set():
+            with sph.entry("free-res"):
+                pass
+
+    threads = [threading.Thread(target=worker) for _ in range(6)]
+    for t in threads:
+        t.start()
+    stop.wait(2.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    sph._flush_fast()
+    totals = sph.node_totals("free-res")
+    assert totals["threads"] == 0, totals
+    assert totals["pass"] >= 0          # and no negative counters anywhere
