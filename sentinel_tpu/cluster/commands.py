@@ -268,7 +268,9 @@ class ClusterServerCommands:
                 "resourceName": names.get(fid, str(fid)),
                 "passQps": round(m.get("pass", 0) / secs, 2),
                 "blockQps": round(m.get("block", 0) / secs, 2),
-                "rt": 0, "topParams": {},
+                "rt": 0,
+                "topParams": {str(k): v for k, v in
+                              eng.top_params(fid, now_ms=now).items()},
             })
         return CommandResponse.of_success(json.dumps(nodes))
 

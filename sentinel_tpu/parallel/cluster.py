@@ -408,6 +408,14 @@ class ClusterEngine:
         self._rules: Dict[int, ClusterFlowRule] = {}
         self._param_rules: Dict[int, ClusterParamFlowRule] = {}
         self._fid_lookup = None       # dense fid→row (vectorized prep)
+        # host-side hot-value sightings per param flow for metricList's
+        # topParams (ClusterParamMetric.getTopValues analog): fid →
+        # {value: count} over the current window, previous window kept so
+        # a read right after rotation isn't empty
+        self._param_hits: Dict[int, Dict[object, int]] = {}
+        self._param_hits_prev: Dict[int, Dict[object, int]] = {}
+        self._param_hits_win = -1
+        self._param_hits_cap = 64     # values tracked per flow (LRU-ish)
         self._connected = np.ones(spec.namespaces, np.float32)
         self._ns_limit = np.full(spec.namespaces, default_ns_qps, np.float32)
         self._next_row_per_shard = [0] * spec.n_shards
@@ -612,6 +620,12 @@ class ClusterEngine:
             is_param = np.zeros((S, blp), np.bool_)
             prow = np.full((S, blp, PV), PK, np.int32)
             pcnt = np.zeros((S, blp, PV), np.float32)
+            win = now_ms // (self.spec.window.win_ms
+                             * self.spec.window.buckets)
+            if win != self._param_hits_win:
+                self._param_hits_prev = self._param_hits
+                self._param_hits = {}
+                self._param_hits_win = win
             for s in range(S):
                 for k, i in enumerate(per_shard[s]):
                     fid = int(flow_ids[i])
@@ -620,9 +634,12 @@ class ClusterEngine:
                     acq[s, k] = acquire[i]
                     valid[s, k] = True
                     is_param[s, k] = True
+                    hits = self._param_hits.setdefault(fid, {})
                     for j, v in enumerate(list(params[i])[:PV]):
                         prow[s, k, j] = self._param_key(fid, v)
                         pcnt[s, k, j] = rule.value_threshold(v)
+                        if v in hits or len(hits) < self._param_hits_cap:
+                            hits[v] = hits.get(v, 0) + int(acquire[i])
 
             batch = jax.device_put(TokenBatch(
                 local_rows=jnp.asarray(rows.reshape(-1)),
@@ -865,6 +882,22 @@ class ClusterEngine:
         wt_o[src] = wt[sh_s, pos]
         rm_o[src] = rm[sh_s, pos]
         return list(zip(st_o.tolist(), wt_o.tolist(), rm_o.tolist()))
+
+    def top_params(self, flow_id: int, *, now_ms: int,
+                   top_n: int = 10) -> Dict[object, int]:
+        """Most-requested param values of a flow over the current (or,
+        right after a rotation, the previous) window — feeds metricList's
+        ``topParams`` (``ClusterParamMetric.getTopValues``). Counts are
+        REQUESTED acquire sums, host-observed; grant/deny split stays in
+        the device counters."""
+        with self._lock:
+            win = now_ms // (self.spec.window.win_ms
+                             * self.spec.window.buckets)
+            if win - self._param_hits_win > 1:
+                return {}            # tracker is stale by more than a window
+            hits = (self._param_hits.get(flow_id)
+                    or self._param_hits_prev.get(flow_id) or {})
+            return dict(sorted(hits.items(), key=lambda kv: -kv[1])[:top_n])
 
     def flow_metrics(self, flow_id: int, *, now_ms: int) -> dict:
         """Per-flow current-window snapshot (ClusterMetricNodeGenerator)."""

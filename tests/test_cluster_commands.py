@@ -126,3 +126,30 @@ def test_transport_config_modify_restarts_listener(serving):
                  data=json.dumps({"idleSeconds": 99})).success
     assert coord.server.idle_seconds == 99
     assert coord.server.port == old_port      # idle-only change: no restart
+
+
+def test_metric_list_top_params_for_param_flows(serving):
+    """topParams surfaces the hottest values of a cluster param flow
+    (ClusterParamMetric.getTopValues analog, host-observed)."""
+    _sph, coord, center, clk = serving
+    rules = [{"resource": "svc", "paramIdx": 0, "count": 100.0,
+              "clusterMode": True, "clusterConfig": {"flowId": 303}}]
+    assert _call(center, "cluster/server/modifyParamRules",
+                 namespace="ns-a", data=json.dumps(rules)).success
+    eng = coord.server.engine
+    now = clk.now_ms()
+    eng.request_param_tokens([303] * 6, [1] * 6,
+                             [("vip",), ("vip",), ("vip",), ("basic",),
+                              ("basic",), ("solo",)], now_ms=now)
+    top = eng.top_params(303, now_ms=now)
+    assert top == {"vip": 3, "basic": 2, "solo": 1}
+    nodes = json.loads(_call(center, "cluster/server/metricList",
+                             namespace="ns-a").result)
+    node = [n for n in nodes if n["flowId"] == 303][0]
+    assert node["topParams"] == {"vip": 3, "basic": 2, "solo": 1}
+    # a read a full window later still serves the previous window's view;
+    # two windows later it's stale and empty
+    w = eng.spec.window.win_ms * eng.spec.window.buckets
+    assert eng.top_params(303, now_ms=now + w) == {"vip": 3, "basic": 2,
+                                                   "solo": 1}
+    assert eng.top_params(303, now_ms=now + 2 * w + 1) == {}
