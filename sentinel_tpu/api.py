@@ -24,6 +24,7 @@ import threading
 from typing import Optional, Sequence
 
 from sentinel_tpu.core.errors import BlockException
+from sentinel_tpu.core.initexec import InitExecutor
 from sentinel_tpu.runtime import ENTRY_TYPE_IN, Entry, Sentinel
 
 _lock = threading.Lock()
@@ -42,7 +43,6 @@ def init(config=None, **kw) -> Sentinel:
             _instance = Sentinel(config, **kw)
             _generation += 1
         inst = _instance
-    from sentinel_tpu.core.initexec import InitExecutor
     InitExecutor.do_init(inst)
     return inst
 
@@ -53,8 +53,11 @@ def instance() -> Sentinel:
         with _lock:
             if _instance is None:
                 _instance = Sentinel()
-        from sentinel_tpu.core.initexec import InitExecutor
-        InitExecutor.do_init(_instance)
+    # Always rendezvous with InitExecutor: if another thread is mid-init,
+    # this blocks until its hooks complete, so no caller can use the
+    # instance before "hooks run before first use" holds. Steady state is
+    # one lock-free Event.is_set() check.
+    InitExecutor.do_init(_instance)
     return _instance
 
 

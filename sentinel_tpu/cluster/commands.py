@@ -36,8 +36,10 @@ class ClusterServerCommands:
         self._server = server
         self.coordinator = coordinator
         self._clock = clock
-        # raw rule payloads per namespace so fetch round-trips exactly what
-        # was pushed (the reference stores full FlowRule beans)
+        # raw rule payloads per namespace: display-field enrichment for
+        # fetch (resource names, grades) and verbatim round-trip of
+        # non-cluster-mode beans; enforcement fields always come from the
+        # engine (see _engine_rule_beans)
         self._raw_flow: Dict[str, List[dict]] = {}
         self._raw_param: Dict[str, List[dict]] = {}
         self._namespace_set: List[str] = []
@@ -77,12 +79,75 @@ class ClusterServerCommands:
         return eng, None
 
     # ------------------------------------------------------------ rules
+    def _engine_rule_beans(self, ns: str, *, param: bool) -> List[dict]:
+        """Rule beans for a namespace derived from ENGINE state (the
+        authoritative enforcement tables), enriched with the raw JSON pushed
+        through the modify commands when available.  Rules loaded through any
+        other path (direct ``engine.load_rules``, a standalone server's own
+        config) are synthesized from the engine's rule structs so fetch and
+        ``metricList`` never disagree with live enforcement."""
+        eng = self._resolve_engine()
+        if eng is None:
+            return list((self._raw_param if param else
+                         self._raw_flow).get(ns, []))
+        raw_list = (self._raw_param if param else self._raw_flow).get(ns, [])
+        raw = {}
+        for d in raw_list:
+            fid = (d.get("clusterConfig") or {}).get("flowId")
+            if fid is not None and d.get("clusterMode"):
+                raw[int(fid)] = d
+        beans: List[dict] = []
+        for fid, r in eng.namespace_rules(ns, param=param).items():
+            if fid in raw:
+                # raw bean supplies display fields (resource name, grade…)
+                # but ENFORCEMENT fields come from the engine — a direct
+                # engine.load_rules after the push must win in fetch too
+                bean = dict(raw[fid])
+                bean["count"] = float(r.count)
+                cc = dict(bean.get("clusterConfig") or {})
+                cc["flowId"] = int(fid)
+                cc["thresholdType"] = int(r.threshold_type)
+                bean["clusterConfig"] = cc
+            else:
+                bean = {"resource": str(fid), "count": float(r.count),
+                        "clusterMode": True,
+                        "clusterConfig": {
+                            "flowId": int(fid),
+                            "thresholdType": int(r.threshold_type)}}
+                if param:
+                    bean["grade"] = 1
+            if param:
+                # per-item thresholds are enforcement too: always rebuilt
+                # from the engine rule, never served from the stale bean
+                # (classType display strings are kept from the pushed bean
+                # when the item survives)
+                ctypes = {str(it.get("object")): it.get("classType")
+                          for it in bean.get("paramFlowItemList", [])}
+                items = getattr(r, "items", None)
+                if items:
+                    bean["paramFlowItemList"] = [
+                        {"object": str(k), "count": float(v),
+                         "classType": ctypes.get(str(k),
+                                                 type(k).__name__)}
+                        for k, v in items.items()]
+                else:
+                    bean.pop("paramFlowItemList", None)
+            beans.append(bean)
+        # non-cluster-mode beans pushed through modify are not enforced by
+        # the cluster engine but must still round-trip verbatim (the
+        # reference stores full FlowRule beans)
+        for d in raw_list:
+            fid = (d.get("clusterConfig") or {}).get("flowId")
+            if fid is None or not d.get("clusterMode"):
+                beans.append(d)
+        return beans
+
     def cmd_fetch_flow_rules(self, req: CommandRequest) -> CommandResponse:
         ns = self._need(req, "namespace")
         if ns is None:
             return CommandResponse.of_failure("empty namespace", 400)
         return CommandResponse.of_success(
-            json.dumps(self._raw_flow.get(ns, [])))
+            json.dumps(self._engine_rule_beans(ns, param=False)))
 
     def cmd_modify_flow_rules(self, req: CommandRequest) -> CommandResponse:
         from sentinel_tpu.parallel.cluster import ClusterFlowRule
@@ -116,7 +181,7 @@ class ClusterServerCommands:
         if ns is None:
             return CommandResponse.of_failure("empty namespace", 400)
         return CommandResponse.of_success(
-            json.dumps(self._raw_param.get(ns, [])))
+            json.dumps(self._engine_rule_beans(ns, param=True)))
 
     def cmd_modify_param_rules(self, req: CommandRequest) -> CommandResponse:
         from sentinel_tpu.parallel.cluster import ClusterParamFlowRule
@@ -159,7 +224,9 @@ class ClusterServerCommands:
         ns = req.param("namespace")
         if ns:
             if eng is not None:
-                flow_cfg["maxAllowedQps"] = eng.namespace_qps_limit(ns)
+                # read-only: must not allocate a namespace slot for typos
+                flow_cfg["maxAllowedQps"] = eng.namespace_qps_limit(
+                    ns, create=False)
             return CommandResponse.of_success(json.dumps({"flow": flow_cfg}))
         out = {"flow": flow_cfg, "namespaceSet": list(self._namespace_set)}
         if srv is not None:
@@ -252,7 +319,8 @@ class ClusterServerCommands:
             return fail
         now = self._now_ms()
         names = {}
-        for d in self._raw_flow.get(ns, []):
+        for d in (self._engine_rule_beans(ns, param=False)
+                  + self._engine_rule_beans(ns, param=True)):
             fid = (d.get("clusterConfig") or {}).get("flowId")
             if fid is not None:
                 names[int(fid)] = d.get("resource", "")

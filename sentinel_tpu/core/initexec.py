@@ -39,17 +39,49 @@ def init_func(order: int = LOWEST_PRECEDENCE,
 
 
 class InitExecutor:
+    # Claim-then-Event design: the lock is held only to CLAIM the init (never
+    # while hooks run — user callbacks under a held lock would be an AB/BA
+    # deadlock hazard); losers wait on the completion Event, so no caller can
+    # observe (and use) the instance mid-initialization. The Event also gives
+    # the steady-state fast path: one lock-free is_set() per call, so hot-path
+    # accessors (api.instance) can rendezvous on every call for free.
     _lock = threading.Lock()
-    _done = False
+    _done = False                        # claimed
+    _complete = threading.Event()        # hooks finished
+    _owner: Optional[int] = None         # claiming thread id (re-entrancy)
+    WAIT_TIMEOUT_S = 10.0                # bound on the loser rendezvous
 
     @classmethod
     def do_init(cls, sentinel) -> bool:
         """Run all registered init funcs in order, once per process.
-        → True if this call performed the initialization."""
+        → True if this call performed the initialization. Concurrent calls
+        block until the winning call's hooks have completed ("hooks run
+        before first use")."""
+        if cls._complete.is_set():       # steady state: lock-free
+            return False
         with cls._lock:
             if cls._done:
-                return False
-            cls._done = True
+                winner = False
+            else:
+                cls._done = True
+                cls._owner = threading.get_ident()
+                winner = True
+            complete = cls._complete     # reset() swaps the Event
+        if not winner:
+            if cls._owner != threading.get_ident():
+                # Bounded wait: an init hook that spawns a helper thread
+                # which itself reaches do_init would otherwise deadlock
+                # (hook waits on helper, helper waits on hook's Event).
+                # After the timeout we log and proceed — weaker ordering
+                # beats a silent process hang.
+                if not complete.wait(timeout=cls.WAIT_TIMEOUT_S):
+                    from sentinel_tpu.core.logs import record_log
+                    record_log().warning(
+                        "[InitExecutor] waited %.0fs for init hooks to "
+                        "finish; proceeding before completion (is an init "
+                        "hook blocking on a thread that uses the facade?)",
+                        cls.WAIT_TIMEOUT_S)
+            return False
         from sentinel_tpu.core.logs import record_log
         try:
             for fn in SpiLoader.of(
@@ -60,8 +92,11 @@ class InitExecutor:
         except Exception as exc:
             # first failure interrupts the remaining funcs but never
             # propagates (InitExecutor.java:56-63)
-            record_log().warning("[InitExecutor] initialization failed: %r",
-                                 exc)
+            record_log().warning(
+                "[InitExecutor] initialization failed: %r", exc)
+        finally:
+            cls._owner = None
+            complete.set()
         return True
 
     @classmethod
@@ -69,3 +104,5 @@ class InitExecutor:
         """Test hygiene: allow do_init to run again."""
         with cls._lock:
             cls._done = False
+            cls._owner = None
+            cls._complete = threading.Event()

@@ -898,6 +898,12 @@ function openRuleModal(rtype, rule) {
       if (f.virtual) continue;
       let v = getPath(vals, f.n);
       if (f.k === "num") {
+        // Number("") === 0, which would silently save a 0 threshold (i.e.
+        // block all traffic) when a field is cleared — empty/whitespace is
+        // always a validation error, never a silent default substitution.
+        if (v == null || String(v).trim() === "") {
+          throw new Error(`${f.l}: not a number`);
+        }
         v = Number(v);
         if (Number.isNaN(v)) throw new Error(`${f.l}: not a number`);
       }

@@ -417,6 +417,7 @@ class ClusterEngine:
         self._param_hits_win = -1
         self._param_hits_cap = 64     # values tracked per flow (LRU-ish)
         self._connected = np.ones(spec.namespaces, np.float32)
+        self._default_ns_qps = float(default_ns_qps)
         self._ns_limit = np.full(spec.namespaces, default_ns_qps, np.float32)
         self._next_row_per_shard = [0] * spec.n_shards
         self._free_rows: List[List[int]] = [[] for _ in range(spec.n_shards)]
@@ -480,15 +481,39 @@ class ClusterEngine:
         with self._lock:
             self._ns_limit[self.namespace_id(namespace)] = limit
 
-    def namespace_qps_limit(self, namespace: str) -> float:
+    def namespace_qps_limit(self, namespace: str, *,
+                            create: bool = True) -> float:
+        """Per-namespace maxAllowedQps. ``create=False`` is a pure read: an
+        unregistered namespace returns the default limit without consuming
+        one of the ``spec.namespaces`` slots (read-only command-plane
+        fetches must not allocate capacity)."""
         with self._lock:
-            return float(self._ns_limit[self.namespace_id(namespace)])
+            nid = self._ns_ids.get(namespace)
+            if nid is None:
+                if not create:
+                    return float(self._default_ns_qps)
+                nid = self.namespace_id(namespace)
+            return float(self._ns_limit[nid])
 
     def namespace_flow_ids(self, namespace: str) -> List[int]:
         """Flow ids registered under a namespace (flow + param rules)."""
         with self._lock:
             return sorted(fid for fid, ns in self._flow_ns.items()
                           if ns == namespace)
+
+    def namespace_rules(self, namespace: str, *, param: bool = False
+                        ) -> Dict[int, object]:
+        """Read-only snapshot {flow_id: rule} of what this engine ENFORCES
+        for a namespace — ``param=False`` → :class:`ClusterFlowRule` entries
+        (excluding param-rule proxy rows), ``param=True`` →
+        :class:`ClusterParamFlowRule` entries. The supported surface for
+        command-plane fetch/metricList (don't reach into ``_rules``)."""
+        with self._lock:
+            store = self._param_rules if param else self._rules
+            return {fid: store[fid]
+                    for fid, ns in sorted(self._flow_ns.items())
+                    if ns == namespace and fid in store
+                    and (param or fid not in self._param_rules)}
 
     def load_rules(self, namespace: str, rules: Sequence[ClusterFlowRule]) -> None:
         """Replace the namespace's rules (ClusterFlowRuleManager property path).

@@ -66,8 +66,23 @@ def command_asgi_app(center: CommandCenter, prefix: str = ""):
     """ASGI (http-scope) app serving the command center."""
 
     async def app(scope, receive, send):
+        # ASGI frameworks route lifespan (when mounted at an app root) and
+        # websocket scopes to mounted apps too — complete/close them cleanly
+        # instead of surfacing a server-side exception.
+        if scope["type"] == "lifespan":
+            while True:
+                msg = await receive()
+                if msg["type"] == "lifespan.startup":
+                    await send({"type": "lifespan.startup.complete"})
+                elif msg["type"] == "lifespan.shutdown":
+                    await send({"type": "lifespan.shutdown.complete"})
+                    return
+        if scope["type"] == "websocket":
+            await receive()                     # websocket.connect
+            await send({"type": "websocket.close", "code": 1000})
+            return
         if scope["type"] != "http":
-            raise RuntimeError("command_asgi_app only handles http scopes")
+            return                              # unknown scope: ignore
         path = scope.get("path", "")
         if prefix and path.startswith(prefix):
             path = path[len(prefix):]

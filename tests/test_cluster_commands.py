@@ -119,6 +119,115 @@ def test_info_and_not_running_failures():
     assert _call(center, "cluster/server/info").success
 
 
+def test_fetch_config_unknown_namespace_does_not_allocate(serving):
+    """A read-only fetchConfig with stray/typo'd namespaces must not consume
+    namespace slots (coordinator engines have only 4) — after many stray
+    reads, legitimate registration still works."""
+    _sph, coord, center, _clk = serving
+    eng = coord.server.engine
+    before = dict(eng._ns_ids)
+    for i in range(8):                      # > spec.namespaces stray reads
+        resp = _call(center, "cluster/server/fetchConfig",
+                     namespace=f"typo-{i}")
+        assert resp.success
+        cfg = json.loads(resp.result)
+        assert cfg["flow"]["maxAllowedQps"] == eng._default_ns_qps
+    assert eng._ns_ids == before            # nothing allocated
+    eng.namespace_id("legit-ns")            # capacity still available
+
+
+def test_fetch_rules_reflect_engine_loaded_state(serving):
+    """Rules loaded directly through engine.load_rules (not via the modify
+    commands) are still visible to fetch and named in metricList — fetch is
+    derived from engine state, not a handler-private cache."""
+    from sentinel_tpu.parallel.cluster import ClusterFlowRule
+    _sph, coord, center, clk = serving
+    eng = coord.server.engine
+    eng.load_rules("ns-a", [ClusterFlowRule(flow_id=777, count=3.0,
+                                            threshold_type=1)])
+    got = json.loads(_call(center, "cluster/server/flowRules",
+                           namespace="ns-a").result)
+    assert [d["clusterConfig"]["flowId"] for d in got] == [777]
+    assert got[0]["count"] == 3.0 and got[0]["clusterMode"] is True
+
+    eng.request_tokens([777] * 5, [1] * 5, now_ms=clk.now_ms())
+    nodes = json.loads(_call(center, "cluster/server/metricList",
+                             namespace="ns-a").result)
+    node = [n for n in nodes if n["flowId"] == 777][0]
+    assert node["passQps"] == 3.0 and node["blockQps"] == 2.0
+
+
+def test_fetch_param_rules_reflect_engine_loaded_state(serving):
+    from sentinel_tpu.parallel.cluster import ClusterParamFlowRule
+    _sph, coord, center, _clk = serving
+    eng = coord.server.engine
+    eng.load_param_rules("ns-a", [ClusterParamFlowRule(
+        flow_id=888, count=9.0, items={"vip": 50.0})])
+    got = json.loads(_call(center, "cluster/server/paramRules",
+                           namespace="ns-a").result)
+    assert [d["clusterConfig"]["flowId"] for d in got] == [888]
+    assert got[0]["paramFlowItemList"][0]["object"] == "vip"
+    # and the param proxy row does NOT leak into the flow-rule fetch
+    flows = json.loads(_call(center, "cluster/server/flowRules",
+                             namespace="ns-a").result)
+    assert 888 not in [d["clusterConfig"]["flowId"] for d in flows]
+
+
+def test_fetch_enforcement_fields_track_engine_after_direct_reload(serving):
+    """A direct engine.load_rules AFTER a dashboard push must win in fetch:
+    display fields stay from the pushed bean, enforcement fields (count,
+    thresholdType) come from the engine."""
+    from sentinel_tpu.parallel.cluster import ClusterFlowRule
+    _sph, coord, center, _clk = serving
+    _call(center, "cluster/server/modifyFlowRules",
+          namespace="ns-a", data=json.dumps(FLOW_RULES))   # count=5
+    eng = coord.server.engine
+    eng.load_rules("ns-a", [ClusterFlowRule(flow_id=101, count=2.0,
+                                            threshold_type=0)])
+    got = json.loads(_call(center, "cluster/server/flowRules",
+                           namespace="ns-a").result)
+    assert got[0]["resource"] == "svc"          # display from pushed bean
+    assert got[0]["count"] == 2.0               # enforcement from engine
+    assert got[0]["clusterConfig"]["thresholdType"] == 0
+
+
+def test_fetch_param_items_track_engine_after_direct_reload(serving):
+    """Per-item thresholds are enforcement fields: a direct
+    engine.load_param_rules after a dashboard push must win in fetch."""
+    from sentinel_tpu.parallel.cluster import ClusterParamFlowRule
+    _sph, coord, center, _clk = serving
+    rules = [{"resource": "svc", "paramIdx": 0, "count": 2.0,
+              "clusterMode": True, "clusterConfig": {"flowId": 202},
+              "paramFlowItemList": [
+                  {"object": "vip", "count": 50, "classType": "String"}]}]
+    assert _call(center, "cluster/server/modifyParamRules",
+                 namespace="ns-a", data=json.dumps(rules)).success
+    eng = coord.server.engine
+    eng.load_param_rules("ns-a", [ClusterParamFlowRule(
+        flow_id=202, count=9.0, items={"vip": 5.0})])
+    got = json.loads(_call(center, "cluster/server/paramRules",
+                           namespace="ns-a").result)
+    assert got[0]["count"] == 9.0
+    assert got[0]["paramFlowItemList"] == [
+        {"object": "vip", "count": 5.0, "classType": "String"}]
+
+
+def test_fetch_round_trips_non_cluster_mode_beans(serving):
+    """clusterMode=false beans in a mixed push are not enforced by the
+    cluster engine but must still round-trip through fetch verbatim."""
+    _sph, _coord, center, _clk = serving
+    mixed = FLOW_RULES + [{"resource": "local-only", "count": 9.0,
+                           "grade": 1, "clusterMode": False}]
+    assert _call(center, "cluster/server/modifyFlowRules",
+                 namespace="ns-a", data=json.dumps(mixed)).success
+    got = json.loads(_call(center, "cluster/server/flowRules",
+                           namespace="ns-a").result)
+    by_res = {d["resource"]: d for d in got}
+    assert by_res["local-only"]["count"] == 9.0
+    assert by_res["local-only"]["clusterMode"] is False
+    assert by_res["svc"]["clusterConfig"]["flowId"] == 101
+
+
 def test_transport_config_modify_restarts_listener(serving):
     _sph, coord, center, _clk = serving
     old_port = coord.server.port

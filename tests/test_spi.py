@@ -119,6 +119,36 @@ def test_init_funcs_run_once_ordered_via_api_init():
     assert len(calls) == 2
 
 
+def test_concurrent_instance_waits_for_init_hooks():
+    """Startup-ordering: no caller may obtain (and use) the facade instance
+    before init funcs have completed — a concurrent instance() blocks until
+    the winning do_init's hooks finish."""
+    import threading
+    import time as _time
+
+    hook_done = threading.Event()
+    observed_before_done = []
+
+    @init_func(order=1)
+    def slow_hook(sph):
+        _time.sleep(0.3)            # window in which the race would show
+        hook_done.set()
+
+    def racer():
+        inst = sph_api.instance()
+        observed_before_done.append((inst, hook_done.is_set()))
+
+    t0 = threading.Thread(target=racer)
+    t1 = threading.Thread(target=racer)
+    t0.start()
+    _time.sleep(0.05)               # t0 is inside the slow hook now
+    t1.start()
+    t0.join()
+    t1.join()
+    assert all(done for _inst, done in observed_before_done)
+    assert observed_before_done[0][0] is observed_before_done[1][0]
+
+
 def test_init_failure_interrupts_remaining_without_raising():
     calls = []
 

@@ -433,3 +433,41 @@ def test_mounted_wsgi_and_asgi_command_apps(clk):
     asyncio.run(drive())
     assert sent[0]["status"] == 200
     assert sent[1]["body"]          # version string payload
+
+
+def test_mounted_asgi_non_http_scopes_handled_gracefully():
+    """ASGI hosts route lifespan/websocket scopes to mounted apps too —
+    they must complete/close cleanly, not raise server-side."""
+    import asyncio
+
+    from sentinel_tpu.transport import CommandCenter, command_asgi_app
+
+    asgi = command_asgi_app(CommandCenter())
+
+    async def drive_lifespan():
+        msgs = [{"type": "lifespan.startup"}, {"type": "lifespan.shutdown"}]
+        sent = []
+
+        async def receive():
+            return msgs.pop(0)
+
+        async def send(msg):
+            sent.append(msg)
+        await asgi({"type": "lifespan"}, receive, send)
+        return sent
+    sent = asyncio.run(drive_lifespan())
+    assert [m["type"] for m in sent] == [
+        "lifespan.startup.complete", "lifespan.shutdown.complete"]
+
+    async def drive_ws():
+        sent = []
+
+        async def receive():
+            return {"type": "websocket.connect"}
+
+        async def send(msg):
+            sent.append(msg)
+        await asgi({"type": "websocket", "path": "/x"}, receive, send)
+        return sent
+    sent = asyncio.run(drive_ws())
+    assert sent == [{"type": "websocket.close", "code": 1000}]
