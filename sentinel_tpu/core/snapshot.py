@@ -154,6 +154,9 @@ def load_state(sentinel, path: str):
                 second=full.second, minute=full.minute,
                 alt_second=full.alt_second)
         sentinel._state = new_state
+        # meshed engines: restored host arrays must land on their canonical
+        # shardings, not default single-device placement
+        sentinel._pin_state_locked()
         # window indices are derived from absolute wall time, so they stay
         # valid across the restart; the relative-ms epoch must carry over
         # for pacing clocks/warm-up state to stay meaningful

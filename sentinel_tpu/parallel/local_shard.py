@@ -1,0 +1,125 @@
+"""Row-sharding of the LOCAL engine over a device mesh (product mode).
+
+The north-star scale axis (SURVEY §7 phase 1): the local ``[R, B, E]``
+window tensors — the dense rebuild of the reference's per-resource
+StatisticNode forest — shard on the RESOURCE axis across the mesh, the
+distributed analog of the reference's checker running against shared
+state (``sentinel-cluster-server-default/.../flow/ClusterFlowChecker.java:38-118``
+generalized to the whole slot chain). Rules, batches, and verdicts are
+replicated; XLA's SPMD partitioner keeps the scatter-adds local to the
+owning shard and inserts the gathers the decision reads need.
+
+Usage::
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("rows",))
+    sph = Sentinel(config, mesh=mesh)      # everything else is unchanged
+
+Design notes (why GSPMD annotations, not ``shard_map``): one local entry
+event touches up to four DIFFERENT row spaces — its main row, the global
+ENTRY row, and two hashed alt rows (origin/chain) — each owned by a
+potentially different shard, plus replicated per-rule state (breakers,
+pacing clocks). ``shard_map`` with host-side owner routing (the
+:mod:`~sentinel_tpu.parallel.cluster` pattern) fits the token engine,
+where a request targets exactly one flow row; for the full slot chain the
+sharding is expressed as annotations on the state pytree and XLA
+partitions the fused step. Parity with the single-device engine is
+bit-exact (asserted in tests and the driver dry run).
+
+Field map (state pytree → PartitionSpec), the single source of truth:
+
+==================  ==========================  =====================
+state field          shape                       sharding
+==================  ==========================  =====================
+second/minute        WindowState [R, B, ...]     P("rows") on axis 0
+alt_second           WindowState [RA, B, ...]    P("rows") on axis 0
+threads              int32[R]                    P("rows")
+alt_threads          int32[RA]                   P("rows")
+flow_dyn.occupied_*  [R, B+1]                    P("rows") on axis 0
+flow_dyn (pacing)    [NF+1]                      replicated
+breakers             [ND+1]                      replicated
+param_dyn            [PK+1]                      replicated
+custom               user DeviceSlot pytrees     replicated
+==================  ==========================  =====================
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sentinel_tpu.engine.pipeline import EngineSpec, SentinelState, Verdicts
+
+MESH_AXIS = "rows"
+
+
+def validate_mesh(spec: EngineSpec, mesh: Mesh) -> None:
+    """Fail fast (with a fix) when the geometry can't shard over the mesh."""
+    if MESH_AXIS not in mesh.axis_names:
+        raise ValueError(
+            f"local-engine mesh needs a {MESH_AXIS!r} axis; got "
+            f"{mesh.axis_names} — build it as Mesh(devices, ({MESH_AXIS!r},))")
+    n = mesh.shape[MESH_AXIS]
+    for name, dim in (("max_resources", spec.rows),
+                      ("alt_rows", spec.alt_rows)):
+        if dim % n:
+            raise ValueError(
+                f"{name}={dim} does not divide over {n} mesh devices; "
+                f"round max_resources up to a multiple of {n} "
+                f"(alt_rows follows it)")
+
+
+def state_shardings(spec: EngineSpec, mesh: Mesh,
+                    state: SentinelState) -> SentinelState:
+    """A ``SentinelState``-shaped pytree of :class:`NamedSharding` per the
+    field map above. ``state`` supplies the structure of the variable-shape
+    parts (custom slot states, rt-tracking window leaves)."""
+    row = NamedSharding(mesh, P(MESH_AXIS))
+    rep = NamedSharding(mesh, P())
+
+    def rows_first(sub):          # every leaf leads with the row axis
+        return jax.tree.map(lambda _: row, sub)
+
+    def replicated(sub):
+        return jax.tree.map(lambda _: rep, sub)
+
+    return SentinelState(
+        second=rows_first(state.second),
+        # minute is [R]-rowed when enabled, a 1-row stub when disabled
+        minute=(rows_first(state.minute) if spec.minute
+                else replicated(state.minute)),
+        alt_second=rows_first(state.alt_second),
+        threads=row,
+        alt_threads=row,
+        flow_dyn=state.flow_dyn._replace(
+            latest_passed_ms=rep, stored_tokens=rep, last_filled_sec=rep,
+            occupied_count=row, occupied_window=row),
+        breakers=replicated(state.breakers),
+        param_dyn=replicated(state.param_dyn),
+        custom=replicated(state.custom),
+    )
+
+
+def verdict_shardings(mesh: Mesh) -> Verdicts:
+    rep = NamedSharding(mesh, P())
+    return Verdicts(allow=rep, reason=rep, wait_ms=rep)
+
+
+def pin_state(state: SentinelState,
+              shardings: SentinelState) -> SentinelState:
+    """Place (or re-place) every state leaf on its canonical sharding —
+    used at init and whenever host code rebuilds a leaf (window geometry
+    change, snapshot restore), so a freshly created unsharded array can't
+    silently drop the engine back to single-device execution."""
+    return jax.tree.map(jax.device_put, state, shardings)
+
+
+def shardings_for(spec: EngineSpec, mesh: Optional[Mesh],
+                  state: SentinelState):
+    """→ (state_shardings, verdict_shardings) or (None, None) without a
+    mesh; the one call sites use."""
+    if mesh is None:
+        return None, None
+    validate_mesh(spec, mesh)
+    return state_shardings(spec, mesh, state), verdict_shardings(mesh)

@@ -69,16 +69,26 @@ ENTRY_TYPE_OUT = 0
 ENTRY_TYPE_IN = 1
 
 
-def _build_steps(spec: EngineSpec, custom_slots: tuple):
+def _build_steps(spec: EngineSpec, custom_slots: tuple, shardings=None):
+    """``shardings`` = (state_shardings, verdict_shardings) pins every
+    step's state output to the mesh layout (parallel/local_shard.py) so
+    sharded state can never silently decay to replicated across steps."""
+    if shardings is None:
+        st_out = vd_out = None
+        kw_sv = kw_s = {}
+    else:
+        st_out, vd_out = shardings
+        kw_sv = {"out_shardings": (st_out, vd_out)}
+        kw_s = {"out_shardings": st_out}
     return (jax.jit(functools.partial(decide_entries, spec,
                                       enable_occupy=False,
-                                      custom_slots=custom_slots)),
+                                      custom_slots=custom_slots), **kw_sv),
             jax.jit(functools.partial(decide_entries, spec,
                                       enable_occupy=True,
-                                      custom_slots=custom_slots)),
-            jax.jit(functools.partial(record_exits, spec)),
-            jax.jit(functools.partial(invalidate_resource_rows, spec)),
-            jax.jit(functools.partial(record_blocks, spec)))
+                                      custom_slots=custom_slots), **kw_sv),
+            jax.jit(functools.partial(record_exits, spec), **kw_s),
+            jax.jit(functools.partial(invalidate_resource_rows, spec), **kw_s),
+            jax.jit(functools.partial(record_blocks, spec), **kw_s))
 
 
 @functools.lru_cache(maxsize=None)
@@ -86,15 +96,15 @@ def _jitted_steps_cached(spec: EngineSpec):
     return _build_steps(spec, ())
 
 
-def _jitted_steps(spec: EngineSpec, custom_slots: tuple = ()):
+def _jitted_steps(spec: EngineSpec, custom_slots: tuple = (), shardings=None):
     """Compiled steps shared across Sentinel instances with the same geometry
     (EngineSpec is a frozen, hashable dataclass). Variants WITH custom
-    DeviceSlots are deliberately NOT cached globally: the owning Sentinel
-    holds the only reference, so stale compilations (and the slot objects)
-    are garbage-collected on every register/unregister instead of pinned
-    forever by an unbounded cache key."""
-    if custom_slots:
-        return _build_steps(spec, custom_slots)
+    DeviceSlots or mesh shardings are deliberately NOT cached globally: the
+    owning Sentinel holds the only reference, so stale compilations (and the
+    slot objects / mesh) are garbage-collected on every register/unregister
+    instead of pinned forever by an unbounded cache key."""
+    if custom_slots or shardings is not None:
+        return _build_steps(spec, custom_slots, shardings)
     return _jitted_steps_cached(spec)
 
 # jitted once at import; shapes are padded to powers of two so the trace
@@ -231,9 +241,18 @@ class Sentinel:
     """The framework instance (Env/CtSph + rule managers, in one object)."""
 
     def __init__(self, config: Optional[SentinelConfig] = None,
-                 clock: Optional[Clock] = None):
+                 clock: Optional[Clock] = None, mesh=None):
+        """``mesh`` (a ``jax.sharding.Mesh`` with a ``"rows"`` axis) turns
+        on the row-sharded multi-chip mode: the ``[R, B, E]`` window tensors
+        and thread gauges shard on the resource axis across the mesh
+        (parallel/local_shard.py), the product form of the north-star
+        "single sharded counter tensor". Semantics are identical to the
+        single-device engine (parity is pinned by tests); max_resources
+        must divide the mesh size."""
         self.cfg = config or load_config()
         self.clock = clock or global_clock()
+        self.mesh = mesh
+        self._mesh_shardings = None      # (state_sh, verdict_sh) when meshed
         cfg = self.cfg
 
         # factories pick the native C++ interning table when buildable
@@ -269,6 +288,10 @@ class Sentinel:
         # the recycled row's origin/context stats are cleared too
         self._alt_rows_by_row: dict = {}
         self._state = init_state(self.spec, cfg.max_flow_rules, cfg.max_degrade_rules)
+        if mesh is not None:
+            from sentinel_tpu.parallel.local_shard import validate_mesh
+            validate_mesh(self.spec, mesh)
+            self._refresh_shardings_locked()
         self._compile_empty_rules()
 
         self.flow_property: SentinelProperty = SentinelProperty()
@@ -307,7 +330,7 @@ class Sentinel:
 
         (self._jit_decide, self._jit_decide_prio, self._jit_exit,
          self._jit_invalidate, self._jit_record_blocks) = \
-            _jitted_steps(self.spec)
+            _jitted_steps(self.spec, shardings=self._mesh_shardings)
         self._token_service = None          # cluster TokenService (client or
         # embedded server facade); set via set_token_service
         self._cluster_rules_by_row: dict = {}
@@ -449,6 +472,7 @@ class Sentinel:
                 flow_dyn=flow_mod.init_flow_dyn(cfg.max_flow_rules,
                                                 self.spec.second.buckets,
                                                 self.spec.rows))
+            self._pin_state_locked()
             self._rebuild_fastpath()
 
     def set_token_service(self, svc) -> None:
@@ -516,12 +540,54 @@ class Sentinel:
                 self._host_gates = tuple(
                     g for g in self._host_gates if g is not slot)
 
+    def _refresh_shardings_locked(self) -> None:
+        """Meshed mode: re-derive the sharding pytree from the CURRENT state
+        structure (custom-slot registration / geometry changes alter it) and
+        re-place every leaf on its canonical device layout."""
+        if self.mesh is None:
+            return
+        from sentinel_tpu.parallel.local_shard import (
+            pin_state, shardings_for,
+        )
+        self._mesh_shardings = shardings_for(self.spec, self.mesh,
+                                             self._state)
+        self._state = pin_state(self._state, self._mesh_shardings[0])
+
+    def _uncount_step(self):
+        """Lease-uncount step; the meshed variant pins the state output to
+        the canonical shardings (the global cache can't — it's keyed on spec
+        alone and shardings are per-instance). Cached per (spec, shardings)
+        on the instance so flushes don't retrace."""
+        if self.mesh is None:
+            return _jit_uncount_reserved(self.spec)
+        cached = getattr(self, "_uncount_cache", None)
+        # identity compare on the live shardings object (a freed tuple's id
+        # could be reused; holding the reference makes 'is' sound)
+        if (cached is None or cached[0] is not self._mesh_shardings
+                or cached[1] != self.spec):
+            from sentinel_tpu.engine.pipeline import uncount_reserved
+            fn = jax.jit(functools.partial(uncount_reserved, self.spec),
+                         out_shardings=self._mesh_shardings[0])
+            self._uncount_cache = cached = (self._mesh_shardings, self.spec,
+                                            fn)
+        return cached[2]
+
+    def _pin_state_locked(self) -> None:
+        """Re-place state leaves after host code rebuilt some of them
+        (rule reloads swap in fresh unsharded arrays); no-op without a
+        mesh, and a cheap no-op for leaves already placed correctly."""
+        if self.mesh is not None:
+            from sentinel_tpu.parallel.local_shard import pin_state
+            self._state = pin_state(self._state, self._mesh_shardings[0])
+
     def _reload_custom_jits_locked(self) -> None:
-        (self._jit_decide, self._jit_decide_prio, self._jit_exit,
-         self._jit_invalidate, self._jit_record_blocks) = \
-            _jitted_steps(self.spec, self._device_slots)
         self._state = self._state._replace(custom=tuple(
             s.init_state(self.spec) for s in self._device_slots))
+        self._refresh_shardings_locked()    # custom states change structure
+        (self._jit_decide, self._jit_decide_prio, self._jit_exit,
+         self._jit_invalidate, self._jit_record_blocks) = \
+            _jitted_steps(self.spec, self._device_slots,
+                          self._mesh_shardings)
 
     def _slot_code(self, kind: str, index: int) -> int:
         """Reason code for a custom slot denial (disjoint sub-spaces: the
@@ -592,6 +658,7 @@ class Sentinel:
             self._ruleset = self._build_ruleset()
             self._state = self._state._replace(
                 breakers=deg_mod.init_breaker_state(cfg.max_degrade_rules))
+            self._pin_state_locked()
             self._rebuild_fastpath()
 
     def load_param_flow_rules(self, rules: Sequence[pf_mod.ParamFlowRule]) -> None:
@@ -631,6 +698,7 @@ class Sentinel:
             self._param_gen += 1
             self._state = self._state._replace(
                 param_dyn=pf_mod.init_param_dyn(self.spec.param_keys))
+            self._pin_state_locked()
             self._rebuild_fastpath()
 
     def load_system_rules(self, rules: Sequence[sys_mod.SystemRule]) -> None:
@@ -690,9 +758,11 @@ class Sentinel:
                 flow_dyn=flow_mod.init_flow_dyn(
                     self.cfg.max_flow_rules, new_second.buckets,
                     self.spec.rows))
+            self._refresh_shardings_locked()
             (self._jit_decide, self._jit_decide_prio, self._jit_exit,
              self._jit_invalidate, self._jit_record_blocks) = \
-                _jitted_steps(self.spec, self._device_slots)
+                _jitted_steps(self.spec, self._device_slots,
+                              self._mesh_shardings)
             self._occupy_live_until_ms = -1
             self._seen_idx = -(2 ** 62)
             self._fast.win_ms = max(1, new_second.win_ms)
@@ -1134,7 +1204,7 @@ class Sentinel:
             m = len(rows)
             bm = self._pad(m)
             with self._lock:
-                self._state = _jit_uncount_reserved(self.spec)(
+                self._state = self._uncount_step()(
                     self._state,
                     jnp.asarray(_pad_to(np.asarray(rows, np.int32), bm,
                                         self.spec.rows, np.int32)),
