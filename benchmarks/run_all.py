@@ -31,28 +31,45 @@ SMALL = os.environ.get("BENCH_SMALL") == "1"
 
 def _run_pipelined(dispatch, steps: int, depth: int):
     """Depth-N double-buffered driver: ``dispatch(s)`` returns a handle
-    with ``.result()``. → ``(dt, t_dispatch, t_read)`` with the drain
-    included in ``dt`` (all work completes inside the timed region) and the
-    per-step timers split into dispatch vs readback-stall."""
+    with ``.result()``. → ``(dt, t_dispatch, t_read, lat)`` with the drain
+    included in ``dt`` (all work completes inside the timed region), the
+    per-step timers split into dispatch vs readback-stall, and ``lat[s]`` =
+    dispatch→verdict-materialized latency of step s — pipelining trades this
+    per-grant latency for throughput (a verdict sits in flight while up to
+    ``depth-1`` younger steps dispatch), so it is reported, not hidden."""
     from collections import deque
 
     t_dispatch = 0.0
     t_read = 0.0
-    inflight = deque()
+    inflight = deque()               # (step, t_dispatched, handle)
+    lat = np.empty(steps)
     t0 = time.perf_counter()
     for s in range(steps):
         td = time.perf_counter()
-        inflight.append(dispatch(s))
+        inflight.append((s, td, dispatch(s)))
         t_dispatch += time.perf_counter() - td
         if len(inflight) >= depth:
             tr = time.perf_counter()
-            inflight.popleft().result()
-            t_read += time.perf_counter() - tr
+            i, ts, h = inflight.popleft()
+            h.result()
+            now = time.perf_counter()
+            t_read += now - tr
+            lat[i] = now - ts
     while inflight:
         tr = time.perf_counter()
-        inflight.popleft().result()
-        t_read += time.perf_counter() - tr
-    return time.perf_counter() - t0, t_dispatch, t_read
+        i, ts, h = inflight.popleft()
+        h.result()
+        now = time.perf_counter()
+        t_read += now - tr
+        lat[i] = now - ts
+    return time.perf_counter() - t0, t_dispatch, t_read, lat
+
+
+def _pcts(lat):
+    """p50/p99 of per-step latencies in ms (a caller's grant waits the whole
+    batch round-trip, so batch latency IS the per-grant latency)."""
+    return (round(float(np.percentile(lat, 50)) * 1000, 3),
+            round(float(np.percentile(lat, 99)) * 1000, 3))
 
 
 def bench_entry_latency():
@@ -166,12 +183,19 @@ def bench_all_controllers():
         state, v = step(ruleset, state, batch, times(i), sysv)
     jax.block_until_ready(state)
     t0 = time.perf_counter()
+    t_disp = 0.0
     for i in range(STEPS):
+        td = time.perf_counter()
         state, v = step(ruleset, state, batch, times(3 + i), sysv)
+        t_disp += time.perf_counter() - td
     jax.block_until_ready((state, v))
     dt = time.perf_counter() - t0
+    # dispatch returns async: total >> dispatch ⇒ the run is device-bound
     return {"config": "2-all-controllers-10k-resources",
-            "decisions_per_sec": round(B * STEPS / dt, 0)}
+            "decisions_per_sec": round(B * STEPS / dt, 0),
+            "host_dispatch_ms_per_step": round(t_disp / STEPS * 1000, 3),
+            "device_bound_ms_per_step": round(
+                (dt - t_disp) / STEPS * 1000, 3)}
 
 
 def bench_breakers():
@@ -233,9 +257,11 @@ def bench_breakers():
         rt_ms=jnp.asarray(rng.integers(1, 200, B).astype(np.int32)),
         error=jnp.asarray(rng.random(B) < 0.3),
         is_in=jnp.ones(B, jnp.bool_), valid=jnp.ones(B, jnp.bool_))
+    from sentinel_tpu.engine.pipeline import decide_and_record_exits
     step = jax.jit(functools.partial(decide_entries, spec,
                                      enable_occupy=False))
     exit_step = jax.jit(functools.partial(record_exits, spec))
+    fused = jax.jit(functools.partial(decide_and_record_exits, spec))
     sysv = jnp.asarray(np.array([0.5, 0.1], np.float32))
 
     def times(i):
@@ -243,17 +269,41 @@ def bench_breakers():
         return jnp.asarray(np.array(
             [spec.second.index_of(now), 0, now, now % 500], np.int32))
 
+    # ---- two-dispatch form (the round-1/2 shape: decide, then exit) ----
     state, _ = step(ruleset, state, ebatch, times(0), sysv)
     state = exit_step(ruleset, state, xbatch, times(0))
     jax.block_until_ready(state)
     t0 = time.perf_counter()
+    t_disp = 0.0
     for i in range(STEPS):
+        td = time.perf_counter()
         state, v = step(ruleset, state, ebatch, times(i), sysv)
         state = exit_step(ruleset, state, xbatch, times(i))
+        t_disp += time.perf_counter() - td
     jax.block_until_ready(state)
-    dt = time.perf_counter() - t0
+    dt2 = time.perf_counter() - t0
+
+    # ---- fused single-dispatch form (decide_and_record_exits) ----
+    state, _ = fused(ruleset, state, ebatch, xbatch, times(0), sysv)
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    t_disp_f = 0.0
+    for i in range(STEPS):
+        td = time.perf_counter()
+        state, v = fused(ruleset, state, ebatch, xbatch,
+                         times(STEPS + i), sysv)
+        t_disp_f += time.perf_counter() - td
+    jax.block_until_ready((state, v))
+    dt1 = time.perf_counter() - t0
     return {"config": "3-circuit-breakers-entry+exit",
-            "entry_exit_pairs_per_sec": round(B * STEPS / dt, 0)}
+            "entry_exit_pairs_per_sec": round(B * STEPS / dt1, 0),
+            "two_dispatch_pairs_per_sec": round(B * STEPS / dt2, 0),
+            "host_dispatch_ms_per_step_fused": round(
+                t_disp_f / STEPS * 1000, 3),
+            "host_dispatch_ms_per_step_2disp": round(
+                t_disp / STEPS * 1000, 3),
+            "device_bound_ms_per_step_fused": round(
+                (dt1 - t_disp_f) / STEPS * 1000, 3)}
 
 
 def bench_hot_param_zipf():
@@ -283,21 +333,30 @@ def bench_hot_param_zipf():
     resources = ["hot"] * B
     for s in range(2):
         sph.entry_batch(resources, args_list=keys[0])
-    # sync reference point (per-step verdict readback on the critical path)
+    # sync reference point (per-step verdict readback on the critical path);
+    # per-call latency here IS the per-grant latency a sync caller sees
     sync_steps = min(STEPS, 10)
+    sync_lat = np.empty(sync_steps)
     t0 = time.perf_counter()
     for s in range(sync_steps):
+        ts = time.perf_counter()
         sph.entry_batch(resources, args_list=keys[s])
+        sync_lat[s] = time.perf_counter() - ts
     sync_dt = time.perf_counter() - t0
 
     def dispatch(s):
         return sph.entry_batch_nowait(resources, args_list=keys[s])
 
-    dt, t_dispatch, t_read = _run_pipelined(dispatch, STEPS, DEPTH)
+    dt, t_dispatch, t_read, lat = _run_pipelined(dispatch, STEPS, DEPTH)
+    sp50, sp99 = _pcts(sync_lat)
+    pp50, pp99 = _pcts(lat)
     return {"config": "4-hot-param-zipf",
             "param_checks_per_sec": round(B * STEPS / dt, 0),
             "sync_checks_per_sec": round(B * sync_steps / sync_dt, 0),
             "pipeline_depth": DEPTH,
+            "sync_grant_p50_ms": sp50, "sync_grant_p99_ms": sp99,
+            "pipelined_grant_p50_ms": pp50, "pipelined_grant_p99_ms": pp99,
+            "budget_ms": 20.0,          # ClusterConstants DEFAULT_REQUEST_TIMEOUT
             "host_prep_dispatch_ms_per_step": round(
                 t_dispatch / STEPS * 1000, 3),
             "readback_stall_ms_per_step": round(t_read / STEPS * 1000, 3)}
@@ -327,23 +386,31 @@ def bench_cluster_tokens():
     ones = np.ones(B, np.int64)
     now = 10_000_000
     eng.request_tokens(ids, ones, now_ms=now)
-    # sync reference point
+    # sync reference point; per-call latency IS the per-grant latency
     sync_steps = min(STEPS, 10)
+    sync_lat = np.empty(sync_steps)
     t0 = time.perf_counter()
     for s in range(sync_steps):
+        ts = time.perf_counter()
         eng.request_tokens(ids, ones, now_ms=now + s)
+        sync_lat[s] = time.perf_counter() - ts
     sync_dt = time.perf_counter() - t0
     # double-buffered grants: dispatch N+1..N+DEPTH while N reads back
     DEPTH = _env("BENCH_PIPE_DEPTH", 8)
-    dt, t_dispatch, t_read = _run_pipelined(
+    dt, t_dispatch, t_read, lat = _run_pipelined(
         lambda s: eng.request_tokens_nowait(
             ids, ones, now_ms=now + sync_steps + s),
         STEPS, DEPTH)
+    sp50, sp99 = _pcts(sync_lat)
+    pp50, pp99 = _pcts(lat)
     return {"config": "5-cluster-token-grants",
             "shards": n_shards,
             "grants_per_sec": round(B * STEPS / dt, 0),
             "sync_grants_per_sec": round(B * sync_steps / sync_dt, 0),
             "pipeline_depth": DEPTH,
+            "sync_grant_p50_ms": sp50, "sync_grant_p99_ms": sp99,
+            "pipelined_grant_p50_ms": pp50, "pipelined_grant_p99_ms": pp99,
+            "budget_ms": 20.0,          # ClusterConstants DEFAULT_REQUEST_TIMEOUT
             "host_prep_dispatch_ms_per_step": round(
                 t_dispatch / STEPS * 1000, 3),
             "readback_stall_ms_per_step": round(t_read / STEPS * 1000, 3)}

@@ -428,6 +428,36 @@ def record_exits(
         custom=state.custom)
 
 
+def decide_and_record_exits(
+    spec: EngineSpec,
+    rules: RuleSet,
+    state: SentinelState,
+    entry_batch: EntryBatch,
+    exit_batch: ExitBatch,
+    times: jnp.ndarray,          # int32[4]
+    sys_scalars: jnp.ndarray,    # float32[2]
+    enable_occupy: bool = False,
+    custom_slots: Tuple = (),
+) -> Tuple[SentinelState, Verdicts]:
+    """Fused entry+exit step: one dispatch where serving loops would pay two.
+
+    A steady-state workload completes a batch of calls per step
+    (``DegradeSlot.entry`` feeding breakers on the way in,
+    ``StatisticSlot.exit`` + ``DegradeSlot.exit`` on the way out —
+    ``StatisticSlot.java:133-178``); the exit batch is known at dispatch time
+    (it is the *previous* step's completions), so both halves fuse into one
+    jitted call. Ordering matches the two-dispatch form: exits land AFTER
+    this step's decisions, exactly like the separate ``record_exits``
+    dispatch that immediately follows ``decide_entries`` — XLA fuses the
+    window scatters of both halves into one pass over the tables, and a
+    tunneled TPU pays one dispatch RTT instead of two."""
+    state, verdicts = decide_entries(
+        spec, rules, state, entry_batch, times, sys_scalars,
+        enable_occupy=enable_occupy, custom_slots=custom_slots)
+    state = record_exits(spec, rules, state, exit_batch, times)
+    return state, verdicts
+
+
 def record_blocks(
     spec: EngineSpec,
     state: SentinelState,
