@@ -1,0 +1,154 @@
+"""Batched gRPC token service (cluster/grpc_token.py — SURVEY §7 phase 3(a),
+the clean-batched-API sibling of the Netty frame server; reference analogs:
+``SentinelRlsGrpcServer.java`` for the gRPC shape,
+``DefaultTokenService.java`` for the token semantics)."""
+
+import pytest
+
+from sentinel_tpu.cluster.grpc_token import (
+    GrpcTokenClient, TokenGrpcServer, TokenGrpcService,
+)
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.parallel.cluster import (
+    STATUS_BAD_REQUEST, STATUS_BLOCKED, STATUS_FAIL, STATUS_NO_RULE_EXISTS,
+    STATUS_OK, STATUS_SHOULD_WAIT, THRESHOLD_GLOBAL, ClusterEngine,
+    ClusterFlowRule, ClusterParamFlowRule, ClusterSpec,
+)
+
+T0 = 1_785_000_000_000
+
+
+@pytest.fixture
+def engine():
+    clk = ManualClock(start_ms=T0)
+    eng = ClusterEngine(ClusterSpec(n_shards=2, flows_per_shard=16,
+                                    namespaces=4, param_keys_per_shard=64))
+    eng.load_rules("ns-g", [
+        ClusterFlowRule(flow_id=1, count=5.0,
+                        threshold_type=THRESHOLD_GLOBAL),
+        ClusterFlowRule(flow_id=2, count=100.0,
+                        threshold_type=THRESHOLD_GLOBAL),
+    ])
+    eng.load_param_rules("ns-g", [
+        ClusterParamFlowRule(flow_id=7, count=2.0,
+                             threshold_type=THRESHOLD_GLOBAL)])
+    # warm both step compilations (first CPU compile can exceed a client's
+    # RPC deadline); burns one fid-2 token (capacity 100) and one token on
+    # a throwaway param value — no test below depends on either
+    eng.request_tokens([2], [1], now_ms=clk.now_ms())
+    eng.request_param_tokens([7], [1], [["_warm"]], now_ms=clk.now_ms())
+    return eng, clk
+
+
+def test_service_mixed_batch_alignment(engine):
+    """One RPC mixing flow + param + bad rows comes back aligned, each
+    sub-batch one engine step."""
+    eng, clk = engine
+    svc = TokenGrpcService(eng, clock=clk)
+    items = [
+        (1, 1, False, ()),          # flow rule, capacity 5
+        (7, 1, False, ["vip"]),     # param rule, per-value capacity 2
+        (1, 1, False, ()),
+        (999, 1, False, ()),        # unknown flow
+        (1, 0, False, ()),          # acquire<=0 → BAD_REQUEST
+        (7, 1, False, ["vip"]),
+    ]
+    out = svc.request_tokens(items)
+    assert [s for s, _, _ in out] == [
+        STATUS_OK, STATUS_OK, STATUS_OK, STATUS_NO_RULE_EXISTS,
+        STATUS_BAD_REQUEST, STATUS_OK]
+    # capacity drains across calls: 3 more on flow 1 → 3 OK then blocked
+    out = svc.request_tokens([(1, 1, False, ())] * 5)
+    assert [s for s, _, _ in out].count(STATUS_OK) == 3
+    assert [s for s, _, _ in out].count(STATUS_BLOCKED) == 2
+    # param value capacity 2 exhausted
+    s, _, _ = svc.request_tokens([(7, 1, False, ["vip"])])[0]
+    assert s == STATUS_BLOCKED
+
+
+def test_grpc_roundtrip_mixed_verdicts(engine):
+    """In-process gRPC server + client: mixed OK/BLOCKED/SHOULD_WAIT batch."""
+    grpc = pytest.importorskip("grpc")   # noqa: F841  (image has grpc)
+    eng, clk = engine
+    srv = TokenGrpcServer(eng, host="127.0.0.1", port=0, clock=clk)
+    port = srv.start()
+    try:
+        cli = GrpcTokenClient(f"127.0.0.1:{port}", namespace="ns-g",
+                              timeout_ms=2000)
+        res = cli.request_tokens_batch(
+            [(1, 1, False)] * 6 + [(2, 1, True)])
+        statuses = [r.status for r in res]
+        assert statuses.count(STATUS_OK) == 6       # 5 from fid 1 + fid 2
+        assert statuses.count(STATUS_BLOCKED) == 1
+        # prioritized over-capacity → SHOULD_WAIT with a wait hint
+        res = cli.request_tokens_batch([(1, 1, True)])
+        assert res[0].status == STATUS_SHOULD_WAIT
+        assert res[0].wait_ms > 0
+        # param path over the same channel
+        res = cli.request_param_tokens_batch([(7, 1, ["basic"]),
+                                              (7, 1, ["basic"]),
+                                              (7, 1, ["basic"])])
+        assert [r.status for r in res] == [STATUS_OK, STATUS_OK,
+                                           STATUS_BLOCKED]
+        # single-call facade (the Sentinel.set_token_service duck type)
+        assert cli.request_token(2, 1).status == STATUS_OK
+        # acquire=0 is a BAD_REQUEST on this surface too (parity with the
+        # engine and Netty paths — proto3 default-0 must not grant 1)
+        assert cli.request_token(2, 0).status == STATUS_BAD_REQUEST
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_grpc_deadline_maps_to_fail_per_item(engine):
+    """Deadline exceeded / unreachable server → STATUS_FAIL per item (the
+    caller's fallbackToLocalWhenFail semantics), never an exception."""
+    pytest.importorskip("grpc")
+    # port 1 on localhost: nothing listening → UNAVAILABLE fast
+    cli = GrpcTokenClient("127.0.0.1:1", timeout_ms=50)
+    res = cli.request_tokens_batch([(1, 1, False), (2, 1, False)])
+    assert [r.status for r in res] == [STATUS_FAIL, STATUS_FAIL]
+    cli.close()
+
+
+def test_grpc_client_plugs_into_sentinel_fallback(engine):
+    """End-to-end: a Sentinel with a cluster-mode rule delegates to the gRPC
+    token service; when the server goes away, per-rule fallbackToLocal
+    enforces locally instead of failing open."""
+    pytest.importorskip("grpc")
+    import sentinel_tpu as stpu
+
+    eng, clk = engine
+    srv = TokenGrpcServer(eng, host="127.0.0.1", port=0, clock=clk)
+    port = srv.start()
+    sph = stpu.Sentinel(stpu.load_config(
+        max_resources=64, max_flow_rules=16, max_degrade_rules=16,
+        max_authority_rules=16, host_fast_path=False),
+        clock=ManualClock(start_ms=T0))
+    sph.load_flow_rules([stpu.FlowRule(
+        resource="svc", count=3.0, cluster_mode=True, cluster_flow_id=1,
+        cluster_fallback_to_local=True)])
+    cli = GrpcTokenClient(f"127.0.0.1:{port}", namespace="ns-g",
+                          timeout_ms=2000)
+    sph.set_token_service(cli)
+    ok = blocked = 0
+    for _ in range(8):                      # server enforces count=5
+        try:
+            with sph.entry("svc"):
+                ok += 1
+        except stpu.BlockException:
+            blocked += 1
+    assert (ok, blocked) == (5, 3)          # cluster verdicts, not local
+    srv.stop()                              # server gone → FAIL → fallback
+    # fresh window: phase-1 passes recorded locally too and would (rightly)
+    # count against the local budget inside the same second
+    sph.clock.advance_ms(1100)
+    ok = blocked = 0
+    for _ in range(6):                      # local rule count=3 now applies
+        try:
+            with sph.entry("svc"):
+                ok += 1
+        except stpu.BlockException:
+            blocked += 1
+    assert (ok, blocked) == (3, 3)
+    cli.close()
