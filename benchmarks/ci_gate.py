@@ -1,20 +1,31 @@
-"""CI perf-regression gate: run the headline bench at CI-sized shapes on
-the CPU backend and fail on a large regression of decisions/sec.
+"""CI perf-regression gate: (a) the headline bench at CI-sized shapes on
+the CPU backend, gated on decisions/sec; (b) the serving-path HOST-PREP
+gate, portable across machines.
 
 Usage:
     python benchmarks/ci_gate.py            # gate (exit 1 on regression)
     python benchmarks/ci_gate.py --update   # re-baseline after intentional
                                             # perf-relevant changes
 
-The committed baseline is machine-relative, so it is only *enforced* on a
-machine with the same fingerprint (cpu count + node name) that produced it
-— there the gate uses a 2× margin over the best of three runs. On any other
-machine (e.g. a shared CI runner of a different hardware class) the gate
-falls back to an absolute sanity floor instead: the failure mode that
-matters — an accidental per-event host loop, lost fusion, or an accidental
-device sync per event — costs 3-5 orders of magnitude, which the sanity
-floor catches on any hardware, while honest 2-4× machine-class differences
-pass. Run ``--update`` on the machine whose floor you want enforced.
+Gate (a): the committed baseline is machine-relative, so it is only
+*enforced* on a machine with the same fingerprint (cpu count + node name)
+that produced it — there the gate uses a 2× margin over the best of three
+runs. On any other machine (e.g. a shared CI runner of a different hardware
+class) the gate falls back to an absolute sanity floor instead: the failure
+mode that matters — an accidental per-event host loop, lost fusion, or an
+accidental device sync per event — costs 3-5 orders of magnitude, which the
+sanity floor catches on any hardware.
+
+Gate (b) — the portable one: serving-path host prep (entry_batch /
+request_tokens dispatch cost per step) is tunnel-independent (BASELINE.md:
+stalls are tunnel weather, host cost is code), but raw ms/step still scales
+with machine class — so the gate measures a fixed pure-Python+numpy
+CALIBRATION workload on the same machine and enforces the RATIO
+host_prep/calibration. Machine speed cancels to first order; what's left is
+the code: re-introducing a per-event Python loop moves the ratio by the
+same factor on a laptop, this VM, or a shared CI runner, and fails the gate
+everywhere. Margin 2.5× over the committed ratio. Run ``--update`` after
+intentional host-prep changes.
 """
 
 from __future__ import annotations
@@ -55,30 +66,132 @@ def measure_once() -> float:
     return float(json.loads(line)["value"])
 
 
+HOST_PREP_MARGIN = 2.5
+
+
+def calibrate() -> float:
+    """Fixed CPU reference workload (numpy vector ops + dict/string churn,
+    the same primitive mix the host-prep paths use) → seconds. Used to
+    normalize host-prep timings into a machine-independent ratio."""
+    import time as _time
+
+    import numpy as np
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 5000, 200_000)
+    t0 = _time.perf_counter()
+    for _ in range(10):
+        u, inv = np.unique(keys, return_inverse=True)
+        _ = u[inv][:1000].tolist()
+        d = {}
+        for i in range(20_000):
+            d[f"k{i & 1023}"] = i
+        _ = np.argsort(keys[:50_000], kind="stable")
+    return _time.perf_counter() - t0
+
+
+def measure_host_prep() -> dict:
+    """Serving-path host-prep seconds/step on the CPU backend: the dispatch
+    side of entry_batch_nowait (param keys) and request_tokens_nowait
+    (cluster grouping) — the two vectorized prep paths BASELINE.md gates."""
+    import time as _time
+
+    import numpy as np
+
+    sys.path.insert(0, str(HERE.parent))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import sentinel_tpu as stpu
+    from sentinel_tpu.parallel.cluster import (
+        THRESHOLD_GLOBAL, ClusterEngine, ClusterFlowRule, ClusterSpec,
+    )
+
+    B, STEPS = 4096, 12
+    sph = stpu.Sentinel(stpu.load_config(
+        max_resources=256, max_flow_rules=16, max_degrade_rules=16,
+        max_authority_rules=16, max_param_rules=16,
+        param_table_slots=1 << 12))
+    sph.load_param_flow_rules([stpu.ParamFlowRule(
+        resource="hot", param_idx=0, count=1e9)])
+    rng = np.random.default_rng(0)
+    keys = (rng.zipf(1.2, size=B * STEPS) % 2048).reshape(STEPS, B, 1)
+    resources = ["hot"] * B
+    handles = [sph.entry_batch_nowait(resources, args_list=keys[0])
+               for _ in range(2)]          # warm compile + caches
+    for h in handles:
+        h.result()
+    entry_times = []
+    for s in range(STEPS):
+        t0 = _time.perf_counter()
+        h = sph.entry_batch_nowait(resources, args_list=keys[s])
+        entry_times.append(_time.perf_counter() - t0)
+        h.result()
+
+    eng = ClusterEngine(ClusterSpec(n_shards=1, flows_per_shard=64,
+                                    namespaces=4))
+    eng.load_rules("ns", [ClusterFlowRule(flow_id=i, count=1e9,
+                                          threshold_type=THRESHOLD_GLOBAL)
+                          for i in range(64)])
+    ids = rng.integers(0, 64, B)
+    ones = np.ones(B, np.int64)
+    eng.request_tokens(ids, ones, now_ms=10_000_000)
+    cluster_times = []
+    for s in range(STEPS):
+        t0 = _time.perf_counter()
+        h = eng.request_tokens_nowait(ids, ones, now_ms=10_000_100 + s)
+        cluster_times.append(_time.perf_counter() - t0)
+        h.result()
+    return {"entry_prep_s_per_step": min(entry_times),
+            "cluster_prep_s_per_step": min(cluster_times)}
+
+
 def main() -> int:
     best = max(measure_once() for _ in range(3))
+    cal = calibrate()
+    prep = measure_host_prep()
+    ratios = {k.replace("_s_per_step", "_ratio"): v / cal
+              for k, v in prep.items()}
     if "--update" in sys.argv:
         BASELINE_FILE.write_text(json.dumps(
             {"cpu_decisions_per_sec_floor": best / 2,
              "measured_at_update": best,
-             "machine": fingerprint()}, indent=1))
+             "machine": fingerprint(),
+             "host_prep_ratios": ratios,
+             "calibration_s": cal}, indent=1))
         print(f"baseline updated: floor={best / 2:.0f} (measured {best:.0f}) "
-              f"on {fingerprint()}")
+              f"on {fingerprint()}; host-prep ratios "
+              f"{ {k: round(v, 4) for k, v in ratios.items()} }")
         return 0
     baseline = json.loads(BASELINE_FILE.read_text())
     same_machine = baseline.get("machine") == fingerprint()
     floor = (baseline["cpu_decisions_per_sec_floor"] if same_machine
              else SANITY_FLOOR_DECISIONS_PER_SEC)
-    print(json.dumps({
+    out = {
         "measured": best, "floor": floor,
         "mode": "baseline-machine" if same_machine else "sanity-floor",
-        "ratio_vs_floor": round(best / floor, 2)}))
+        "ratio_vs_floor": round(best / floor, 2),
+        "calibration_s": round(cal, 4),
+        "host_prep": {k: round(v, 4) for k, v in prep.items()},
+        "host_prep_ratios": {k: round(v, 4) for k, v in ratios.items()},
+    }
+    print(json.dumps(out))
+    rc = 0
     if best < floor:
         print(f"PERF REGRESSION: {best:.0f} decisions/s < floor {floor:.0f} "
               f"({'>2x below the rate at baseline time' if same_machine else 'below the absolute sanity floor — the fused step has degenerated'})",
               file=sys.stderr)
-        return 1
-    return 0
+        rc = 1
+    committed = baseline.get("host_prep_ratios")
+    if committed:
+        for k, limit in committed.items():
+            got = ratios.get(k)
+            if got is not None and got > limit * HOST_PREP_MARGIN:
+                print(f"HOST-PREP REGRESSION ({k}): measured ratio "
+                      f"{got:.4f} > committed {limit:.4f} × "
+                      f"{HOST_PREP_MARGIN} — serving-path host prep grew "
+                      f"relative to this machine's CPU calibration "
+                      f"(machine-independent signal)", file=sys.stderr)
+                rc = 1
+    return rc
 
 
 if __name__ == "__main__":

@@ -1,0 +1,77 @@
+package sentinel.tpu.interop;
+
+import com.alibaba.csp.sentinel.cluster.ClusterConstants;
+import com.alibaba.csp.sentinel.cluster.TokenResultStatus;
+import com.alibaba.csp.sentinel.cluster.client.NettyTransportClient;
+import com.alibaba.csp.sentinel.cluster.client.config.ClusterClientConfig;
+import com.alibaba.csp.sentinel.cluster.client.config.ClusterClientConfigManager;
+import com.alibaba.csp.sentinel.cluster.request.ClusterRequest;
+import com.alibaba.csp.sentinel.cluster.request.data.FlowRequestData;
+import com.alibaba.csp.sentinel.cluster.response.ClusterResponse;
+
+/**
+ * Drives the sentinel_tpu Python token server with the REFERENCE client:
+ * real Netty framing, real writer codec, real PING handshake (the client
+ * sends MSG_TYPE_PING on channelActive). Asserts OK/BLOCKED parity for an
+ * 8-request burst against a flow rule with capacity 5.
+ *
+ * Usage: InteropCheck <host> <port>
+ */
+public final class InteropCheck {
+
+    public static void main(String[] args) throws Exception {
+        String host = args.length > 0 ? args[0] : "127.0.0.1";
+        int port = Integer.parseInt(args.length > 1 ? args[1] : "18730");
+
+        // generous timeout: a CI runner's first request may race residual
+        // server-side warmup; correctness, not latency, is under test here
+        ClusterClientConfigManager.applyNewConfig(
+            new ClusterClientConfig().setRequestTimeout(5000));
+
+        NettyTransportClient client = new NettyTransportClient(host, port);
+        client.start();
+        long deadline = System.currentTimeMillis() + 15000;
+        while (!client.isReady() && System.currentTimeMillis() < deadline) {
+            Thread.sleep(50);
+        }
+        if (!client.isReady()) {
+            System.err.println("FAIL: client never became ready (PING handshake)");
+            System.exit(2);
+        }
+        System.out.println("connected; PING handshake done");
+
+        // align the burst to a fresh window second so the 5-token budget
+        // can't straddle a rotation mid-burst
+        long now = System.currentTimeMillis();
+        Thread.sleep(1000 - (now % 1000) + 50);
+
+        int ok = 0, blocked = 0, other = 0;
+        for (int i = 0; i < 8; i++) {
+            ClusterRequest<FlowRequestData> req = new ClusterRequest<>(
+                ClusterConstants.MSG_TYPE_FLOW,
+                new FlowRequestData().setFlowId(101).setCount(1).setPriority(false));
+            ClusterResponse<?> resp = client.sendRequest(req);
+            int status = resp.getStatus();
+            if (status == TokenResultStatus.OK) {
+                ok++;
+            } else if (status == TokenResultStatus.BLOCKED) {
+                blocked++;
+            } else {
+                other++;
+                System.err.println("unexpected status: " + status);
+            }
+        }
+        client.stop();
+        System.out.println("results: OK=" + ok + " BLOCKED=" + blocked
+                + " other=" + other);
+        if (ok == 5 && blocked == 3 && other == 0) {
+            System.out.println("JVM INTEROP OK");
+            System.exit(0);
+        }
+        System.err.println("FAIL: expected OK=5 BLOCKED=3");
+        System.exit(1);
+    }
+
+    private InteropCheck() {
+    }
+}

@@ -1,0 +1,61 @@
+"""JVM-interop harness server: a standalone token server for the CI job
+that drives it with the REFERENCE Java client (Maven artifact
+``com.alibaba.csp:sentinel-cluster-client-default`` — the real
+``NettyTransportClient``/writer codec, not our golden frames).
+
+Prints ``PORT <n>`` on stdout once listening, then serves until stdin
+closes (the CI step runs it with a pipe and closes it when done).
+
+Rule set: flow id 101, capacity 5/window — the Java side expects exactly
+5 OK + 3 BLOCKED for an 8-request burst inside one second.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from sentinel_tpu.cluster.server import ClusterTokenServer
+from sentinel_tpu.parallel.cluster import (
+    THRESHOLD_GLOBAL, ClusterEngine, ClusterFlowRule, ClusterSpec,
+)
+
+
+def main() -> None:
+    eng = ClusterEngine(ClusterSpec(n_shards=1, flows_per_shard=16,
+                                    namespaces=4))
+    eng.load_rules("default", [ClusterFlowRule(
+        flow_id=101, count=5.0, threshold_type=THRESHOLD_GLOBAL)])
+    # warm the engine-step compile so the first RPC fits the reference
+    # client's 20 ms request timeout budget is not blown by XLA compile
+    eng.request_tokens([101], [1], now_ms=0)
+
+    srv = ClusterTokenServer(eng, host="127.0.0.1", port=0)
+    srv.start()
+
+    # warm the REAL serving path (frame decode → batch step → reply) before
+    # announcing the port: first-step XLA compiles would otherwise blow the
+    # reference client's 20 ms request timeout while the server still
+    # counts the grants. Unknown flow id → NO_RULE_EXISTS, no budget spent.
+    from sentinel_tpu.cluster.client import ClusterTokenClient
+    warm = ClusterTokenClient("127.0.0.1", srv.port,
+                              request_timeout_ms=30_000)
+    warm.start()
+    for _ in range(3):
+        warm.request_token(999, 1)
+    warm.stop()
+
+    print(f"PORT {srv.port}", flush=True)
+
+    sys.stdin.read()       # serve until the driving step closes our stdin
+    srv.stop()
+
+
+if __name__ == "__main__":
+    main()

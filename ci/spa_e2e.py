@@ -1,0 +1,136 @@
+"""SPA end-to-end drive (playwright + chromium — the optional CI job).
+
+No JS engine ships in the dev image, so locally the dashboard SPA is only
+verified mechanically (tests/test_ui_contract.py). This script is the CI
+counterpart that EXECUTES it: boot a real agent + dashboard (the
+demos/dashboard_quickstart.py wiring), log in through the login form,
+render every view (each ``viewX`` function runs), and round-trip one flow
+rule through the editor modal. Console errors fail the run.
+
+Usage (CI): ``pip install playwright && playwright install chromium``
+then ``python ci/spa_e2e.py``. Exits non-zero on any failure.
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import sentinel_tpu as stpu
+from sentinel_tpu.dashboard import Dashboard, DashboardServer
+from sentinel_tpu.transport import start_transport
+
+VIEWS = ["metrics", "resources", "machines", "cluster", "flow", "degrade",
+         "paramFlow", "system", "authority", "gatewayFlow", "gatewayApi"]
+
+
+def boot():
+    """Agent + dashboard, ports ephemeral; returns (dash_port, stop)."""
+    sph = stpu.Sentinel(stpu.load_config(
+        app_name="spa-e2e", max_resources=64, max_flow_rules=16,
+        max_degrade_rules=16, max_authority_rules=16))
+    sph.load_flow_rules([stpu.FlowRule(resource="demo-res", count=100.0)])
+    dash = DashboardServer(Dashboard(password="s3cr3t"), host="127.0.0.1",
+                           port=0)
+    dport = dash.start()
+    transport = start_transport(
+        sph, host="0.0.0.0", port=0,
+        dashboard_addr=f"127.0.0.1:{dport}", heartbeat_interval_ms=1000)
+    # traffic so metrics views have data
+    for _ in range(20):
+        try:
+            with sph.entry("demo-res"):
+                pass
+        except stpu.BlockException:
+            pass
+    # authenticated poll (the discovery API requires a session)
+    import http.cookiejar
+    opener = urllib.request.build_opener(
+        urllib.request.HTTPCookieProcessor(http.cookiejar.CookieJar()))
+    login = urllib.request.Request(
+        f"http://127.0.0.1:{dport}/auth/login", method="POST",
+        data=json.dumps({"username": "sentinel",
+                         "password": "s3cr3t"}).encode(),
+        headers={"Content-Type": "application/json"})
+    assert json.loads(opener.open(login, timeout=5).read())["success"]
+    deadline = time.time() + 20
+    while time.time() < deadline:        # wait for heartbeat discovery
+        with opener.open(f"http://127.0.0.1:{dport}/app/names.json",
+                         timeout=5) as r:
+            if "spa-e2e" in (json.loads(r.read()).get("data") or []):
+                break
+        time.sleep(0.3)
+    else:
+        raise RuntimeError("agent never appeared in dashboard discovery")
+    return dport, lambda: (transport.stop(), dash.stop())
+
+
+def drive(dport: int) -> None:
+    from playwright.sync_api import sync_playwright
+
+    errors = []
+    with sync_playwright() as pw:
+        browser = pw.chromium.launch()
+        page = browser.new_page()
+        page.on("console", lambda m: errors.append(m.text)
+                if m.type == "error" else None)
+        page.on("pageerror", lambda e: errors.append(str(e)))
+
+        page.goto(f"http://127.0.0.1:{dport}/", wait_until="networkidle")
+        # ---- login form
+        page.wait_for_selector("#login", state="visible", timeout=10000)
+        page.fill("#u", "sentinel")
+        page.fill("#p", "s3cr3t")
+        page.click("#login button")
+        page.wait_for_selector("#app", state="visible", timeout=10000)
+        print("login OK")
+
+        # ---- render every view (each viewX function executes)
+        for view in VIEWS:
+            page.goto(f"http://127.0.0.1:{dport}/#/spa-e2e/{view}")
+            page.wait_for_timeout(700)
+            assert page.locator("#content .card").count() >= 1, \
+                f"view {view} rendered no card"
+            print(f"view {view} OK")
+
+        # ---- flow-rule editor round-trip: create via the modal, verify
+        page.goto(f"http://127.0.0.1:{dport}/#/spa-e2e/flow")
+        page.wait_for_timeout(700)
+        page.click("text=+ new")
+        page.wait_for_selector("#modal", timeout=5000)
+        # field order follows SCHEMAS.flow: Resource is the first text input
+        page.fill("#modal input >> nth=0", "e2e-res")
+        page.fill("xpath=//div[@id='modal']//label[starts-with(normalize-"
+                  "space(.), 'Threshold')]/following-sibling::input", "42")
+        page.click("#modal button.primary")        # "Create"
+        page.wait_for_selector("#modal", state="detached", timeout=5000)
+        page.wait_for_timeout(700)
+        assert page.locator("td", has_text="e2e-res").count() >= 1, \
+            "saved rule not in table"
+        print("flow rule editor round-trip OK")
+        browser.close()
+    hard = [e for e in errors if "favicon" not in e]
+    if hard:
+        raise AssertionError(f"console errors: {hard}")
+
+
+def main() -> int:
+    dport, stop = boot()
+    try:
+        drive(dport)
+    finally:
+        stop()
+    print("SPA E2E OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
