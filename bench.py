@@ -26,6 +26,61 @@ import time
 import numpy as np
 
 
+def scatter_ab() -> None:
+    """BENCH_SCATTER={xla,pallas}: the counter-table scatter-add microbench
+    (SURVEY §7 phase 1 'Pallas streaming scatter kernel' — A/B'd against
+    XLA's native scatter). Knobs: BENCH_SCATTER_K (table rows),
+    BENCH_SCATTER_N (event-stream length), BENCH_SCATTER_E (event lanes).
+    Prints the standard one-JSON-line; see benchmarks/scatter_ab.py for the
+    full shape sweep + committed results table in BASELINE.md."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from sentinel_tpu.ops.pallas_kernels import (
+        scatter_add_pallas, scatter_add_xla,
+    )
+
+    backend = os.environ["BENCH_SCATTER"]
+    K = int(os.environ.get("BENCH_SCATTER_K", str(1 << 12)))
+    N = int(os.environ.get("BENCH_SCATTER_N", str(1 << 16)))
+    E = int(os.environ.get("BENCH_SCATTER_E", "8"))
+    STEPS = int(os.environ.get("BENCH_STEPS", "50"))
+
+    rng = np.random.default_rng(0)
+    counters = jnp.zeros((K, E), jnp.int32)
+    keys = jnp.asarray(rng.integers(0, K, N).astype(np.int32))
+    events = jnp.asarray(rng.integers(0, E, N).astype(np.int32))
+    amounts = jnp.asarray(rng.integers(1, 3, N).astype(np.int32))
+
+    if backend == "pallas":
+        interp = jax.devices()[0].platform != "tpu"
+        fn = jax.jit(functools.partial(scatter_add_pallas, interpret=interp))
+    elif backend == "xla":
+        fn = jax.jit(scatter_add_xla)
+    else:
+        raise SystemExit(f"BENCH_SCATTER must be xla|pallas, got {backend}")
+
+    for _ in range(3):
+        counters = fn(counters, keys, events, amounts)
+    # honest-mode gate (see main bench): force real execution before timing
+    _ = np.asarray(counters[:1, :1])
+    jax.block_until_ready(counters)
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        counters = fn(counters, keys, events, amounts)
+    jax.block_until_ready(counters)
+    dt = time.perf_counter() - t0
+    rate = N * STEPS / dt
+    print(json.dumps({
+        "metric": f"scatter_add_events_per_sec_{backend}_K{K}_N{N}",
+        "value": round(rate, 1),
+        "unit": "events/s",
+        "vs_baseline": 0.0,      # microbench: no north-star share
+    }))
+
+
 def main() -> None:
     import jax
 
@@ -35,6 +90,9 @@ def main() -> None:
     # mode run on the virtual-device harness
     if os.environ.get("BENCH_PLATFORM"):
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    if os.environ.get("BENCH_SCATTER"):
+        scatter_ab()
+        return
     import jax.numpy as jnp
 
     from sentinel_tpu.core.registry import OriginRegistry, Registry, ResourceRegistry
@@ -149,6 +207,14 @@ def main() -> None:
     for i in range(WARMUP):
         state, verdicts = step(ruleset, state, batches[i % n_batches],
                                scalars(i), sys_scalars)
+    # HONEST-MODE GATE: the tunneled TPU runtime defers execution until the
+    # process's first device→host copy — before it, dispatches complete
+    # instantly and block_until_ready is a no-op lie (measured: a 2048³
+    # matmul loop "runs" at 0.03 ms/step before the first readback, 3.6 ms
+    # after, and the first readback pays for the entire deferred graph).
+    # One tiny copy after warmup flips the process to real execution so the
+    # timed region below measures actual device throughput.
+    _ = np.asarray(verdicts.allow[:1])
     jax.block_until_ready(state)
 
     start = time.perf_counter()

@@ -181,6 +181,9 @@ def bench_all_controllers():
 
     for i in range(3):
         state, v = step(ruleset, state, batch, times(i), sysv)
+    # honest-mode gate (see bench.py): the tunneled runtime defers execution
+    # until the process's first device→host copy; force it before timing
+    np.asarray(v.allow[:1])
     jax.block_until_ready(state)
     t0 = time.perf_counter()
     t_disp = 0.0
@@ -270,8 +273,9 @@ def bench_breakers():
             [spec.second.index_of(now), 0, now, now % 500], np.int32))
 
     # ---- two-dispatch form (the round-1/2 shape: decide, then exit) ----
-    state, _ = step(ruleset, state, ebatch, times(0), sysv)
+    state, v0 = step(ruleset, state, ebatch, times(0), sysv)
     state = exit_step(ruleset, state, xbatch, times(0))
+    np.asarray(v0.allow[:1])     # honest-mode gate (see bench.py)
     jax.block_until_ready(state)
     t0 = time.perf_counter()
     t_disp = 0.0
