@@ -41,8 +41,8 @@ from sentinel_tpu.rules import param_flow as pf_mod
 from sentinel_tpu.rules import system as sys_mod
 from sentinel_tpu.stats import events as ev
 from sentinel_tpu.stats.window import (
-    WindowSpec, WindowState, add_rows, init_window, invalidate_rows,
-    refresh_rows,
+    WindowSpec, WindowState, add_one_row, add_rows, add_rows_multi,
+    add_rows_vec, init_window, invalidate_rows, refresh_all, refresh_rows,
 )
 
 
@@ -297,47 +297,86 @@ def decide_entries(
     pad_r = jnp.int32(R)
     pad_a = jnp.int32(RA)
 
-    main_targets, alt_targets = _stat_targets(
+    _, alt_targets = _stat_targets(
         spec, batch.rows, batch.origin_rows, batch.chain_rows, batch.valid,
         batch.is_in)
-    pass2 = jnp.concatenate([passed, passed])
-    pass_now2 = jnp.concatenate([pass_now, pass_now])
-    acq2 = jnp.concatenate([batch.acquire, batch.acquire])
-    pass_amt = jnp.where(pass_now2, acq2, 0)
     blocked_rec = (blocked & batch.record_block
                    if batch.record_block is not None else blocked)
-    block_amt = jnp.where(jnp.concatenate([blocked_rec, blocked_rec]), acq2, 0)
+    occ1 = occupied if enable_occupy else jnp.zeros_like(pass_now)
 
-    second = refresh_rows(spec.second, state.second, main_targets, now_idx_s)
-    second = add_rows(spec.second, second, main_targets, ev.PASS, pass_amt, now_idx_s)
-    if enable_occupy:   # occupied is all-False in the static no-occupy variant
-        occ2 = jnp.concatenate([occupied, occupied])
-        occ_amt = jnp.where(occ2, acq2, 0)
-        second = add_rows(spec.second, second, main_targets, ev.OCCUPIED_PASS,
-                          occ_amt, now_idx_s)
-    second = add_rows(spec.second, second, main_targets, ev.BLOCK, block_amt, now_idx_s)
+    # Recording strategy (this block was ~70% of the step's device time as
+    # per-event add_rows passes): (1) full-table lazy reset (refresh_all:
+    # dynamic-slice, no index arrays); (2) each event lands in exactly ONE
+    # lane (pass_now / occupied / blocked are mutually exclusive), so the
+    # per-row record is one fused scatter of B indices (add_rows_multi);
+    # (3) the global ENTRY row — formerly a second B-index scatter half —
+    # is a reduction + one single-row update (add_one_row).
+    rec1 = pass_now | occ1 | blocked_rec            # all already ∧ valid
+    ev_ids1 = jnp.where(pass_now, jnp.int32(ev.PASS),
+                        jnp.where(occ1, jnp.int32(ev.OCCUPIED_PASS),
+                                  jnp.int32(ev.BLOCK)))
+    acq = batch.acquire
+    rec_amt1 = jnp.where(rec1, acq, 0)
+    main_rec1 = jnp.where(rec1, batch.rows, pad_r)
 
-    alt_second = refresh_rows(spec.second, state.alt_second, alt_targets, now_idx_s)
-    alt_second = add_rows(spec.second, alt_second, alt_targets, ev.PASS, pass_amt, now_idx_s)
-    alt_second = add_rows(spec.second, alt_second, alt_targets, ev.BLOCK, block_amt, now_idx_s)
+    ein = batch.is_in
+    n_ev = state.second.counters.shape[2]
+    entry_vec = jnp.zeros((n_ev,), jnp.int32)
+    entry_vec = entry_vec.at[ev.PASS].set(
+        jnp.sum(jnp.where(pass_now & ein, acq, 0)))
+    if enable_occupy:
+        entry_vec = entry_vec.at[ev.OCCUPIED_PASS].set(
+            jnp.sum(jnp.where(occ1 & ein, acq, 0)))
+    entry_vec = entry_vec.at[ev.BLOCK].set(
+        jnp.sum(jnp.where(blocked_rec & ein, acq, 0)))
+
+    # alt rows (origin + chain hashes) keep the two-half scatter: both
+    # halves are real hashed rows; no OCCUPIED lane on alt (as before)
+    alt_mask1 = pass_now | blocked_rec
+    alt_mask2 = jnp.concatenate([alt_mask1, alt_mask1])
+    ev_ids2 = jnp.concatenate([ev_ids1, ev_ids1])
+    acq2 = jnp.concatenate([acq, acq])
+    alt_rec = jnp.where(alt_mask2, alt_targets, pad_a)
+    alt_amt = jnp.where(alt_mask2, acq2, 0)
+
+    if spec.second.buckets >= 2:
+        second = refresh_all(spec.second, state.second, now_idx_s)
+        alt_second = refresh_all(spec.second, state.alt_second, now_idx_s)
+    else:   # B=1: full restamp would erase untouched rows' prev window
+        second = refresh_rows(
+            spec.second, state.second,
+            jnp.concatenate([main_rec1,
+                             jnp.full((1,), ENTRY_NODE_ROW, jnp.int32)]),
+            now_idx_s)
+        alt_second = refresh_rows(spec.second, state.alt_second,
+                                  alt_targets, now_idx_s)
+    second = add_rows_multi(spec.second, second, main_rec1, ev_ids1,
+                            rec_amt1, now_idx_s)
+    second = add_one_row(spec.second, second, ENTRY_NODE_ROW, entry_vec,
+                         now_idx_s)
+    alt_second = add_rows_multi(spec.second, alt_second, alt_rec, ev_ids2,
+                                alt_amt, now_idx_s)
 
     minute = state.minute
     if spec.minute:
-        minute = refresh_rows(spec.minute, state.minute, main_targets, now_idx_m)
-        minute = add_rows(spec.minute, minute, main_targets, ev.PASS, pass_amt, now_idx_m)
-        if enable_occupy:
-            minute = add_rows(spec.minute, minute, main_targets,
-                              ev.OCCUPIED_PASS, occ_amt, now_idx_m)
-        minute = add_rows(spec.minute, minute, main_targets, ev.BLOCK, block_amt, now_idx_m)
+        minute = refresh_all(spec.minute, state.minute, now_idx_m)
+        minute = add_rows_multi(spec.minute, minute, main_rec1, ev_ids1,
+                                rec_amt1, now_idx_m)
+        minute = add_one_row(spec.minute, minute, ENTRY_NODE_ROW, entry_vec,
+                             now_idx_m)
 
-    ct = (jnp.concatenate([batch.count_thread, batch.count_thread])
-          if batch.count_thread is not None else None)
-    thr_amt = jnp.where(pass2 if ct is None else pass2 & ct, 1, 0)
+    ct1 = batch.count_thread
+    thr_mask1 = passed if ct1 is None else passed & ct1
+    thr_amt1 = jnp.where(thr_mask1, 1, 0)
     # +1 per entry (reference curThreadNum); leased admissions opt out
-    threads = state.threads.at[jnp.where(pass2, main_targets, pad_r)].add(
-        thr_amt, mode="drop")
-    alt_threads = state.alt_threads.at[jnp.where(pass2, alt_targets, pad_a)].add(
-        thr_amt, mode="drop")
+    threads = state.threads.at[jnp.where(passed, batch.rows, pad_r)].add(
+        thr_amt1, mode="drop")
+    threads = threads.at[ENTRY_NODE_ROW].add(
+        jnp.sum(jnp.where(thr_mask1 & ein, 1, 0)))
+    pass2 = jnp.concatenate([passed, passed])
+    thr_amt2 = jnp.concatenate([thr_amt1, thr_amt1])
+    alt_threads = state.alt_threads.at[
+        jnp.where(pass2, alt_targets, pad_a)].add(thr_amt2, mode="drop")
 
     if spec.param_keys and batch.param_rules is not None:
         param_dyn = pf_mod.param_thread_update(
@@ -371,44 +410,72 @@ def record_exits(
     pad_a = jnp.int32(RA)
 
     main_rows = jnp.where(batch.valid, batch.rows, pad_r)
-    entry_rows = jnp.where(batch.valid & batch.is_in,
-                           jnp.int32(ENTRY_NODE_ROW), pad_r)
     alt_o = jnp.where(batch.valid, batch.origin_rows, pad_a)
     alt_c = jnp.where(batch.valid, batch.chain_rows, pad_a)
-
-    main_targets = jnp.concatenate([main_rows, entry_rows])
     alt_targets = jnp.concatenate([alt_o, alt_c])
+
+    acq1 = jnp.where(batch.valid, batch.acquire, 0)
+    err1 = jnp.where(batch.error, acq1, 0)
+    rt1 = batch.rt_ms
+    ein = batch.valid & batch.is_in
+
+    # An exit can record BOTH SUCCESS and EXCEPTION, so the fused per-row
+    # form is a full event-lane payload (one scatter instead of one per
+    # event type); rt rides the same pass. The ENTRY row is a reduction +
+    # one single-row update, not a second scatter half (see decide).
+    n_ev = state.second.counters.shape[2]
+    payload = jnp.zeros((batch.rows.shape[0], n_ev), jnp.int32)
+    payload = payload.at[:, ev.SUCCESS].set(acq1)
+    payload = payload.at[:, ev.EXCEPTION].set(err1)
+    payload2 = jnp.concatenate([payload, payload])
+
+    entry_vec = jnp.zeros((n_ev,), jnp.int32)
+    entry_vec = entry_vec.at[ev.SUCCESS].set(jnp.sum(jnp.where(ein, acq1, 0)))
+    entry_vec = entry_vec.at[ev.EXCEPTION].set(
+        jnp.sum(jnp.where(ein, err1, 0)))
+    # float32 BEFORE the sum: the ENTRY aggregate overflows int32 within a
+    # single large batch (rt_sum is float32 for exactly this reason)
+    entry_rt_add = jnp.sum(jnp.where(ein, rt1, 0).astype(jnp.float32))
+    entry_rt_min = jnp.min(jnp.where(ein, rt1, jnp.iinfo(jnp.int32).max))
+
+    if spec.second.buckets >= 2:
+        second = refresh_all(spec.second, state.second, now_idx_s)
+        alt_second = refresh_all(spec.second, state.alt_second, now_idx_s)
+    else:
+        second = refresh_rows(
+            spec.second, state.second,
+            jnp.concatenate([main_rows,
+                             jnp.full((1,), ENTRY_NODE_ROW, jnp.int32)]),
+            now_idx_s)
+        alt_second = refresh_rows(spec.second, state.alt_second,
+                                  alt_targets, now_idx_s)
+    second = add_rows_vec(spec.second, second, main_rows, payload,
+                          now_idx_s, rt_ms=rt1, rt_valid=batch.valid)
+    second = add_one_row(spec.second, second, ENTRY_NODE_ROW, entry_vec,
+                         now_idx_s, rt_add=entry_rt_add,
+                         rt_min=entry_rt_min)
+    rt2 = jnp.concatenate([rt1, rt1])
     valid2 = jnp.concatenate([batch.valid, batch.valid])
-    acq2 = jnp.where(valid2, jnp.concatenate([batch.acquire, batch.acquire]), 0)
-    rt2 = jnp.concatenate([batch.rt_ms, batch.rt_ms])
-    err2 = jnp.where(jnp.concatenate([batch.error, batch.error]), acq2, 0)
-    succ_amt = jnp.where(valid2, acq2, 0)
-
-    second = refresh_rows(spec.second, state.second, main_targets, now_idx_s)
-    second = add_rows(spec.second, second, main_targets, ev.SUCCESS, succ_amt,
-                      now_idx_s, rt_ms=rt2)
-    second = add_rows(spec.second, second, main_targets, ev.EXCEPTION, err2, now_idx_s)
-
-    alt_second = refresh_rows(spec.second, state.alt_second, alt_targets, now_idx_s)
-    alt_second = add_rows(spec.second, alt_second, alt_targets, ev.SUCCESS,
-                          succ_amt, now_idx_s, rt_ms=rt2)
-    alt_second = add_rows(spec.second, alt_second, alt_targets, ev.EXCEPTION,
-                          err2, now_idx_s)
+    alt_second = add_rows_vec(spec.second, alt_second, alt_targets, payload2,
+                              now_idx_s, rt_ms=rt2, rt_valid=valid2)
 
     minute = state.minute
     if spec.minute:
-        minute = refresh_rows(spec.minute, state.minute, main_targets, now_idx_m)
-        minute = add_rows(spec.minute, minute, main_targets, ev.SUCCESS,
-                          succ_amt, now_idx_m, rt_ms=rt2)
-        minute = add_rows(spec.minute, minute, main_targets, ev.EXCEPTION,
-                          err2, now_idx_m)
+        minute = refresh_all(spec.minute, state.minute, now_idx_m)
+        minute = add_rows_vec(spec.minute, minute, main_rows, payload,
+                              now_idx_m, rt_ms=rt1, rt_valid=batch.valid)
+        minute = add_one_row(spec.minute, minute, ENTRY_NODE_ROW, entry_vec,
+                             now_idx_m, rt_add=entry_rt_add,
+                             rt_min=entry_rt_min)
 
-    ct2 = (jnp.concatenate([batch.count_thread, batch.count_thread])
-           if batch.count_thread is not None else None)
-    dec = jnp.where(valid2 if ct2 is None else valid2 & ct2, 1, 0)
-    threads = state.threads.at[main_targets].add(-dec, mode="drop")
+    ct1 = batch.count_thread
+    dec1 = jnp.where(batch.valid if ct1 is None else batch.valid & ct1, 1, 0)
+    threads = state.threads.at[main_rows].add(-dec1, mode="drop")
+    threads = threads.at[ENTRY_NODE_ROW].add(
+        -jnp.sum(jnp.where(ein if ct1 is None else ein & ct1, 1, 0)))
     threads = jnp.maximum(threads, 0)
-    alt_threads = state.alt_threads.at[alt_targets].add(-dec, mode="drop")
+    dec2 = jnp.concatenate([dec1, dec1])
+    alt_threads = state.alt_threads.at[alt_targets].add(-dec2, mode="drop")
     alt_threads = jnp.maximum(alt_threads, 0)
 
     breakers = deg_mod.degrade_exit_feed(
@@ -478,17 +545,21 @@ def record_blocks(
         spec, rows, origin_rows, chain_rows, valid, is_in)
     amt = jnp.where(valid, acquire, 0)
     amt2 = jnp.concatenate([amt, amt])
-    second = refresh_rows(spec.second, state.second, main_targets, now_idx_s)
+    if spec.second.buckets >= 2:
+        second = refresh_all(spec.second, state.second, now_idx_s)
+        alt_second = refresh_all(spec.second, state.alt_second, now_idx_s)
+    else:
+        second = refresh_rows(spec.second, state.second, main_targets,
+                              now_idx_s)
+        alt_second = refresh_rows(spec.second, state.alt_second, alt_targets,
+                                  now_idx_s)
     second = add_rows(spec.second, second, main_targets, ev.BLOCK, amt2,
                       now_idx_s)
-    alt_second = refresh_rows(spec.second, state.alt_second, alt_targets,
-                              now_idx_s)
     alt_second = add_rows(spec.second, alt_second, alt_targets, ev.BLOCK,
                           amt2, now_idx_s)
     minute = state.minute
     if spec.minute:
-        minute = refresh_rows(spec.minute, state.minute, main_targets,
-                              now_idx_m)
+        minute = refresh_all(spec.minute, state.minute, now_idx_m)
         minute = add_rows(spec.minute, minute, main_targets, ev.BLOCK, amt2,
                           now_idx_m)
     return state._replace(second=second, alt_second=alt_second, minute=minute)

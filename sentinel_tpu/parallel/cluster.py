@@ -166,7 +166,7 @@ def _shard_step(
     ns_base = jnp.concatenate([ns_global, jnp.zeros((1,), jnp.float32)])
     ns_lim = jnp.concatenate([ns_limit, jnp.full((1,), jnp.inf, jnp.float32)])
 
-    order_ns = seg.sort_by_keys(ns_req, jnp.zeros_like(ns_req))
+    order_ns = seg.sort_by_keys(ns_req)
     ns_s = ns_req[order_ns]
     starts_ns = seg.segment_starts(ns_s, jnp.zeros_like(ns_s))
     leader_ns = seg.segment_leader_index(starts_ns)
@@ -183,7 +183,7 @@ def _shard_step(
     thr_rule = table.count * jnp.where(table.is_global, 1.0, conn) * table.exceed  # [L]
 
     seg_rows = jnp.where(flow_req, rows, L)  # L = never-blocking sentinel segment
-    order = seg.sort_by_keys(seg_rows, jnp.zeros_like(seg_rows))
+    order = seg.sort_by_keys(seg_rows)
     rows_s = seg_rows[order]
     starts = seg.segment_starts(rows_s, jnp.zeros_like(rows_s))
     leader = seg.segment_leader_index(starts)
@@ -248,7 +248,7 @@ def _shard_step(
         # still reserves quota within this batch (bounded under-admission) —
         # but its count is never recorded, so nothing leaks across steps.
         flat_keys = jnp.where(live, prow, PK).reshape(-1)     # [Bl·PV]
-        order_p = seg.sort_by_keys(flat_keys, jnp.zeros_like(flat_keys))
+        order_p = seg.sort_by_keys(flat_keys)
         keys_s = flat_keys[order_p]
         starts_p = seg.segment_starts(keys_s, jnp.zeros_like(keys_s))
         leader_p = seg.segment_leader_index(starts_p)

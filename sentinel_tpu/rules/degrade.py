@@ -174,7 +174,7 @@ def degrade_entry_check(
     valid_bk = jnp.repeat(valid, Kd) & table.active[rj]
     rj_seg = jnp.where(valid_bk, rj, ND)
 
-    order = seg.sort_by_keys(rj_seg, jnp.zeros_like(rj_seg))
+    order = seg.sort_by_keys(rj_seg)
     rj_s = rj_seg[order]
     starts = seg.segment_starts(rj_s, jnp.zeros_like(rj_s))
 
@@ -229,7 +229,7 @@ def degrade_exit_feed(
                        err_bk).astype(jnp.int32)
 
     # --- HALF_OPEN probe resolution (before window bookkeeping) ---
-    order = seg.sort_by_keys(rj_safe, jnp.zeros_like(rj_safe))
+    order = seg.sort_by_keys(rj_safe)
     rj_s = rj_safe[order]
     starts = seg.segment_starts(rj_s, jnp.zeros_like(rj_s))
     probe = starts & (st.state[rj_s] == STATE_HALF_OPEN) & (rj_s != ND)

@@ -583,7 +583,7 @@ def param_check(
     duration = jnp.maximum(table.duration_ms[rj], 1).astype(jnp.float32)
 
     # --- segments: one per key row (key rows are unique per (rule, value)) ---
-    order = seg.sort_by_keys(kj, jnp.zeros_like(kj))
+    order = seg.sort_by_keys(kj)
     rj_s = rj[order]
     kj_s = kj[order]
     acq_s = acq_p[order]

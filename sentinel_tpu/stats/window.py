@@ -170,6 +170,81 @@ def refresh_rows(spec: WindowSpec, state: WindowState, rows: jnp.ndarray,
     return WindowState(counters, stamps, rt_sum, min_rt)
 
 
+def refresh_all(spec: WindowSpec, state: WindowState,
+                now_idx: jnp.ndarray) -> WindowState:
+    """Lazy-reset the current bucket of EVERY row — the hot-path form of
+    :func:`refresh_rows`.
+
+    A full-table pass is a dynamic-slice update (vectorized elementwise, no
+    index arrays), so at 1M rows it costs one linear sweep of
+    ``counters[:, k, :]`` instead of a million-index scatter — on the TPU
+    profile this replaced ~100 ms of scatter with sub-ms work per step.
+
+    Semantics equal ``LeapArray.currentWindow(now)`` applied to all rows: at
+    bucket position ``k = now_idx % B`` the only LIVE stamp is ``now_idx``
+    itself (any other stamp at that position differs by a multiple of B and
+    reads as dead), so zero+restamp changes no window read. Requires
+    ``buckets >= 2``: with B == 1 the previous window shares the current
+    bucket position, and restamping untouched rows would erase their
+    ``prev_window_sum`` (warm-up's previousPassQps) — callers fall back to
+    :func:`refresh_rows` there.
+    """
+    assert spec.buckets >= 2, "refresh_all needs B >= 2 (see docstring)"
+    k = _bucket_of(spec, now_idx)
+    keep = (state.stamps[:, k] == now_idx)                  # [R]
+    counters = state.counters.at[:, k, :].multiply(
+        keep[:, None].astype(jnp.int32))
+    stamps = state.stamps.at[:, k].set(now_idx)
+    rt_sum, min_rt = state.rt_sum, state.min_rt
+    if spec.track_rt:
+        rt_sum = rt_sum.at[:, k].multiply(keep.astype(jnp.float32))
+        min_rt = min_rt.at[:, k].set(
+            jnp.where(keep, state.min_rt[:, k], INT32_MAX))
+    return WindowState(counters, stamps, rt_sum, min_rt)
+
+
+def add_rows_vec(spec: WindowSpec, state: WindowState, rows: jnp.ndarray,
+                 payload: jnp.ndarray, now_idx: jnp.ndarray,
+                 rt_ms: Optional[jnp.ndarray] = None,
+                 rt_valid: Optional[jnp.ndarray] = None) -> WindowState:
+    """Scatter-add a full event-lane vector per row: ``payload[N, E]`` lands
+    in the current bucket of ``rows`` — one scatter pass where per-event
+    ``add_rows`` calls would pay one pass each (an element contributing to
+    several lanes, e.g. SUCCESS+EXCEPTION at exit, still costs one pass).
+    Same refresh discipline and padding rules as :func:`add_rows`."""
+    k = _bucket_of(spec, now_idx)
+    counters = state.counters.at[rows, k, :].add(payload, mode="drop")
+    rt_sum, min_rt = state.rt_sum, state.min_rt
+    if spec.track_rt and rt_ms is not None:
+        amt = (rt_ms if rt_valid is None
+               else jnp.where(rt_valid, rt_ms, 0)).astype(jnp.float32)
+        rt_sum = rt_sum.at[rows, k].add(amt, mode="drop")
+        mn = (rt_ms if rt_valid is None
+              else jnp.where(rt_valid, rt_ms, INT32_MAX))
+        min_rt = min_rt.at[rows, k].min(mn, mode="drop")
+    return WindowState(counters, state.stamps, rt_sum, min_rt)
+
+
+def add_one_row(spec: WindowSpec, state: WindowState, row: int,
+                vec: jnp.ndarray, now_idx: jnp.ndarray,
+                rt_add: Optional[jnp.ndarray] = None,
+                rt_min: Optional[jnp.ndarray] = None) -> WindowState:
+    """Add a pre-reduced event vector to ONE row's current bucket.
+
+    The global ENTRY row receives a contribution from every inbound event;
+    as a scatter that doubles the index count of each recording pass — as a
+    host-side reduction + this single dynamic-slice update it is one cheap
+    elementwise op. Caller must have refreshed the row at ``now_idx``."""
+    k = _bucket_of(spec, now_idx)
+    counters = state.counters.at[row, k, :].add(vec)
+    rt_sum, min_rt = state.rt_sum, state.min_rt
+    if spec.track_rt and rt_add is not None:
+        rt_sum = rt_sum.at[row, k].add(rt_add.astype(jnp.float32))
+        if rt_min is not None:
+            min_rt = min_rt.at[row, k].min(rt_min)
+    return WindowState(counters, state.stamps, rt_sum, min_rt)
+
+
 def _bucket_of(spec: WindowSpec, now_idx: jnp.ndarray) -> jnp.ndarray:
     # Python-style mod keeps the bucket position consistent across the int32
     # wrap for power-of-two-free B too: jnp '%' already yields non-negative

@@ -145,3 +145,62 @@ def test_invalidate_rows_forgets_history():
     # row is immediately usable for a fresh resource
     st = _add(spec, st, 1, ev.PASS, 2, now_ms=1100)
     assert _sum(spec, st, 1, ev.PASS, 1100) == 2
+
+
+def test_entry_rt_sum_no_int32_overflow_in_large_batch():
+    """The ENTRY-row RT reduction must accumulate in float32: a single large
+    exit batch with big rt values would wrap int32 (reproduced at 512k
+    events x ~4.9s rt before the fix)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from sentinel_tpu.core.registry import ENTRY_NODE_ROW
+    from sentinel_tpu.engine.pipeline import (
+        EngineSpec, ExitBatch, RuleSet, init_state, record_exits,
+    )
+    from sentinel_tpu.rules import authority as auth_mod
+    from sentinel_tpu.rules import degrade as deg_mod
+    from sentinel_tpu.rules import flow as flow_mod
+    from sentinel_tpu.rules import param_flow as pf_mod
+    from sentinel_tpu.rules import system as sys_mod
+    from sentinel_tpu.core.registry import (
+        OriginRegistry, Registry, ResourceRegistry,
+    )
+
+    R, B = 64, 4096
+    spec = EngineSpec(rows=R, alt_rows=128, second=WindowSpec(2, 500),
+                      minute=None, statistic_max_rt=5000)
+    res = ResourceRegistry(R)
+    org = OriginRegistry(8)
+    ctxr = Registry(8, reserved=("c",))
+    flow = flow_mod.compile_flow_rules(
+        [], resource_registry=res, context_registry=ctxr, capacity=4,
+        k_per_resource=2, num_rows=R, origin_registry=org)
+    deg = deg_mod.compile_degrade_rules([], resource_registry=res,
+                                        capacity=4, k_per_resource=2,
+                                        num_rows=R)
+    auth = auth_mod.compile_authority_rules(
+        [], resource_registry=res, origin_registry=org, capacity=4,
+        k_per_resource=2, num_rows=R)
+    param = pf_mod.compile_param_rules([], resource_registry=res,
+                                       capacity=1, k_per_resource=2)
+    rules = RuleSet(flow.table, flow.rule_idx, deg.table, deg.rule_idx,
+                    auth.table, auth.rule_idx,
+                    sys_mod.compile_system_rules([]), param.table)
+    state = init_state(spec, 4, 4)
+    rt = 1_000_000           # 4096 * 1e6 = 4.1e9 >> int32 max
+    batch = ExitBatch(
+        rows=jnp.full(B, 2, jnp.int32),
+        origin_rows=jnp.full(B, spec.alt_rows, jnp.int32),
+        chain_rows=jnp.full(B, spec.alt_rows, jnp.int32),
+        acquire=jnp.ones(B, jnp.int32),
+        rt_ms=jnp.full(B, rt, jnp.int32),
+        error=jnp.zeros(B, jnp.bool_),
+        is_in=jnp.ones(B, jnp.bool_), valid=jnp.ones(B, jnp.bool_))
+    times = jnp.asarray(np.array([100, 0, 1000, 0], np.int32))
+    out = jax.jit(functools.partial(record_exits, spec))(rules, state, batch,
+                                                         times)
+    got = float(out.second.rt_sum[ENTRY_NODE_ROW, 100 % 2])
+    assert got == float(B) * rt, got      # would be negative on overflow
