@@ -188,7 +188,10 @@ def main() -> None:
             prioritized=jnp.zeros(B, jnp.bool_),
             valid=jnp.ones(B, jnp.bool_)))
 
-    step = jax.jit(functools.partial(decide_entries, spec, enable_occupy=False),
+    # record_alt=False: the bench batch carries no origin/chain rows, and
+    # the runtime selects this same alt-free variant for such batches
+    step = jax.jit(functools.partial(decide_entries, spec,
+                                     enable_occupy=False, record_alt=False),
                    donate_argnums=(1,),
                    **({"out_shardings": mesh_sh} if mesh_sh else {}))
 

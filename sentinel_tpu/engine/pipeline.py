@@ -181,6 +181,10 @@ def decide_entries(
     sys_scalars: jnp.ndarray,    # float32[2]: load1, cpu_usage
     enable_occupy: bool = True,  # STATIC (see flow_check)
     custom_slots: Tuple = (),    # STATIC: registered DeviceSlots (slots.py)
+    record_alt: bool = True,     # STATIC: False = batch carries no origin/
+    # chain rows (host-verified all-padding) → the alt-table scatters and
+    # the alt thread gauge compile away entirely; origin-less traffic is
+    # the common case and those scatters are pure padding work there
 ) -> Tuple[SentinelState, Verdicts]:
     """One device step: decide a batch, then record post-decision statistics.
 
@@ -330,32 +334,38 @@ def decide_entries(
     entry_vec = entry_vec.at[ev.BLOCK].set(
         jnp.sum(jnp.where(blocked_rec & ein, acq, 0)))
 
-    # alt rows (origin + chain hashes) keep the two-half scatter: both
-    # halves are real hashed rows; no OCCUPIED lane on alt (as before)
-    alt_mask1 = pass_now | blocked_rec
-    alt_mask2 = jnp.concatenate([alt_mask1, alt_mask1])
-    ev_ids2 = jnp.concatenate([ev_ids1, ev_ids1])
-    acq2 = jnp.concatenate([acq, acq])
-    alt_rec = jnp.where(alt_mask2, alt_targets, pad_a)
-    alt_amt = jnp.where(alt_mask2, acq2, 0)
-
     if spec.second.buckets >= 2:
         second = refresh_all(spec.second, state.second, now_idx_s)
-        alt_second = refresh_all(spec.second, state.alt_second, now_idx_s)
     else:   # B=1: full restamp would erase untouched rows' prev window
         second = refresh_rows(
             spec.second, state.second,
             jnp.concatenate([main_rec1,
                              jnp.full((1,), ENTRY_NODE_ROW, jnp.int32)]),
             now_idx_s)
-        alt_second = refresh_rows(spec.second, state.alt_second,
-                                  alt_targets, now_idx_s)
     second = add_rows_multi(spec.second, second, main_rec1, ev_ids1,
                             rec_amt1, now_idx_s)
     second = add_one_row(spec.second, second, ENTRY_NODE_ROW, entry_vec,
                          now_idx_s)
-    alt_second = add_rows_multi(spec.second, alt_second, alt_rec, ev_ids2,
-                                alt_amt, now_idx_s)
+
+    # alt rows (origin + chain hashes) keep the two-half scatter: both
+    # halves are real hashed rows; no OCCUPIED lane on alt (as before)
+    if record_alt:
+        alt_mask1 = pass_now | blocked_rec
+        alt_mask2 = jnp.concatenate([alt_mask1, alt_mask1])
+        ev_ids2 = jnp.concatenate([ev_ids1, ev_ids1])
+        acq2 = jnp.concatenate([acq, acq])
+        alt_rec = jnp.where(alt_mask2, alt_targets, pad_a)
+        alt_amt = jnp.where(alt_mask2, acq2, 0)
+        if spec.second.buckets >= 2:
+            alt_second = refresh_all(spec.second, state.alt_second,
+                                     now_idx_s)
+        else:
+            alt_second = refresh_rows(spec.second, state.alt_second,
+                                      alt_targets, now_idx_s)
+        alt_second = add_rows_multi(spec.second, alt_second, alt_rec,
+                                    ev_ids2, alt_amt, now_idx_s)
+    else:
+        alt_second = state.alt_second
 
     minute = state.minute
     if spec.minute:
@@ -373,10 +383,13 @@ def decide_entries(
         thr_amt1, mode="drop")
     threads = threads.at[ENTRY_NODE_ROW].add(
         jnp.sum(jnp.where(thr_mask1 & ein, 1, 0)))
-    pass2 = jnp.concatenate([passed, passed])
-    thr_amt2 = jnp.concatenate([thr_amt1, thr_amt1])
-    alt_threads = state.alt_threads.at[
-        jnp.where(pass2, alt_targets, pad_a)].add(thr_amt2, mode="drop")
+    if record_alt:
+        pass2 = jnp.concatenate([passed, passed])
+        thr_amt2 = jnp.concatenate([thr_amt1, thr_amt1])
+        alt_threads = state.alt_threads.at[
+            jnp.where(pass2, alt_targets, pad_a)].add(thr_amt2, mode="drop")
+    else:
+        alt_threads = state.alt_threads
 
     if spec.param_keys and batch.param_rules is not None:
         param_dyn = pf_mod.param_thread_update(
@@ -397,6 +410,7 @@ def record_exits(
     state: SentinelState,
     batch: ExitBatch,
     times: jnp.ndarray,          # int32[4] (same packing as decide_entries)
+    record_alt: bool = True,     # STATIC (see decide_entries)
 ) -> SentinelState:
     """Completion step: ``StatisticSlot.exit`` (rt/success/exception, thread
     decrement, for node + origin + chain + ENTRY) then ``DegradeSlot.exit``
@@ -440,24 +454,31 @@ def record_exits(
 
     if spec.second.buckets >= 2:
         second = refresh_all(spec.second, state.second, now_idx_s)
-        alt_second = refresh_all(spec.second, state.alt_second, now_idx_s)
     else:
         second = refresh_rows(
             spec.second, state.second,
             jnp.concatenate([main_rows,
                              jnp.full((1,), ENTRY_NODE_ROW, jnp.int32)]),
             now_idx_s)
-        alt_second = refresh_rows(spec.second, state.alt_second,
-                                  alt_targets, now_idx_s)
     second = add_rows_vec(spec.second, second, main_rows, payload,
                           now_idx_s, rt_ms=rt1, rt_valid=batch.valid)
     second = add_one_row(spec.second, second, ENTRY_NODE_ROW, entry_vec,
                          now_idx_s, rt_add=entry_rt_add,
                          rt_min=entry_rt_min)
-    rt2 = jnp.concatenate([rt1, rt1])
-    valid2 = jnp.concatenate([batch.valid, batch.valid])
-    alt_second = add_rows_vec(spec.second, alt_second, alt_targets, payload2,
-                              now_idx_s, rt_ms=rt2, rt_valid=valid2)
+    if record_alt:
+        if spec.second.buckets >= 2:
+            alt_second = refresh_all(spec.second, state.alt_second,
+                                     now_idx_s)
+        else:
+            alt_second = refresh_rows(spec.second, state.alt_second,
+                                      alt_targets, now_idx_s)
+        rt2 = jnp.concatenate([rt1, rt1])
+        valid2 = jnp.concatenate([batch.valid, batch.valid])
+        alt_second = add_rows_vec(spec.second, alt_second, alt_targets,
+                                  payload2, now_idx_s, rt_ms=rt2,
+                                  rt_valid=valid2)
+    else:
+        alt_second = state.alt_second
 
     minute = state.minute
     if spec.minute:
@@ -474,9 +495,13 @@ def record_exits(
     threads = threads.at[ENTRY_NODE_ROW].add(
         -jnp.sum(jnp.where(ein if ct1 is None else ein & ct1, 1, 0)))
     threads = jnp.maximum(threads, 0)
-    dec2 = jnp.concatenate([dec1, dec1])
-    alt_threads = state.alt_threads.at[alt_targets].add(-dec2, mode="drop")
-    alt_threads = jnp.maximum(alt_threads, 0)
+    if record_alt:
+        dec2 = jnp.concatenate([dec1, dec1])
+        alt_threads = state.alt_threads.at[alt_targets].add(-dec2,
+                                                           mode="drop")
+        alt_threads = jnp.maximum(alt_threads, 0)
+    else:
+        alt_threads = state.alt_threads
 
     breakers = deg_mod.degrade_exit_feed(
         rules.deg_table, state.breakers, rules.deg_idx, batch.rows,

@@ -80,13 +80,20 @@ def _build_steps(spec: EngineSpec, custom_slots: tuple, shardings=None):
         st_out, vd_out = shardings
         kw_sv = {"out_shardings": (st_out, vd_out)}
         kw_s = {"out_shardings": st_out}
-    return (jax.jit(functools.partial(decide_entries, spec,
-                                      enable_occupy=False,
-                                      custom_slots=custom_slots), **kw_sv),
-            jax.jit(functools.partial(decide_entries, spec,
-                                      enable_occupy=True,
-                                      custom_slots=custom_slots), **kw_sv),
+    def dec(occ, alt):
+        return jax.jit(functools.partial(
+            decide_entries, spec, enable_occupy=occ,
+            custom_slots=custom_slots, record_alt=alt), **kw_sv)
+
+    # jit objects are lazy (tracing happens on first call), so building all
+    # variants is free; the *_noalt ones compile away the origin/chain
+    # scatters for batches the host verified carry no alt rows (the common
+    # origin-less case — two fewer million-index scatters per step)
+    return (dec(False, True), dec(True, True),
+            dec(False, False), dec(True, False),
             jax.jit(functools.partial(record_exits, spec), **kw_s),
+            jax.jit(functools.partial(record_exits, spec,
+                                      record_alt=False), **kw_s),
             jax.jit(functools.partial(invalidate_resource_rows, spec), **kw_s),
             jax.jit(functools.partial(record_blocks, spec), **kw_s))
 
@@ -328,7 +335,9 @@ class Sentinel:
         # double-fire observers and lose interleaved transitions
         self._breaker_poll_lock = threading.Lock()
 
-        (self._jit_decide, self._jit_decide_prio, self._jit_exit,
+        (self._jit_decide, self._jit_decide_prio,
+         self._jit_decide_noalt, self._jit_decide_prio_noalt,
+         self._jit_exit, self._jit_exit_noalt,
          self._jit_invalidate, self._jit_record_blocks) = \
             _jitted_steps(self.spec, shardings=self._mesh_shardings)
         self._token_service = None          # cluster TokenService (client or
@@ -584,7 +593,9 @@ class Sentinel:
         self._state = self._state._replace(custom=tuple(
             s.init_state(self.spec) for s in self._device_slots))
         self._refresh_shardings_locked()    # custom states change structure
-        (self._jit_decide, self._jit_decide_prio, self._jit_exit,
+        (self._jit_decide, self._jit_decide_prio,
+         self._jit_decide_noalt, self._jit_decide_prio_noalt,
+         self._jit_exit, self._jit_exit_noalt,
          self._jit_invalidate, self._jit_record_blocks) = \
             _jitted_steps(self.spec, self._device_slots,
                           self._mesh_shardings)
@@ -759,7 +770,9 @@ class Sentinel:
                     self.cfg.max_flow_rules, new_second.buckets,
                     self.spec.rows))
             self._refresh_shardings_locked()
-            (self._jit_decide, self._jit_decide_prio, self._jit_exit,
+            (self._jit_decide, self._jit_decide_prio,
+             self._jit_decide_noalt, self._jit_decide_prio_noalt,
+             self._jit_exit, self._jit_exit_noalt,
              self._jit_invalidate, self._jit_record_blocks) = \
                 _jitted_steps(self.spec, self._device_slots,
                               self._mesh_shardings)
@@ -1655,6 +1668,14 @@ class Sentinel:
             count_thread=count_thread, record_block=record_block,
             at_ms=at_ms).result()
 
+    def _batch_has_no_alt(self, origin_rows, chain_rows) -> bool:
+        """True when every origin/chain row is padding (>= alt_rows) — the
+        single criterion both the entry and exit paths use to pick the
+        *_noalt step variants (the alt-table scatters compile away)."""
+        pad_a = self.spec.alt_rows
+        return bool(np.min(origin_rows, initial=pad_a) >= pad_a
+                    and np.min(chain_rows, initial=pad_a) >= pad_a)
+
     def decide_raw_nowait(self, rows, origin_ids, origin_rows, context_ids,
                           chain_rows, acquire, is_in, prioritized, *,
                           param_rules=None, param_keys=None,
@@ -1670,6 +1691,9 @@ class Sentinel:
         b = self._pad(n)
         pad_r = self.spec.rows
         pad_a = self.spec.alt_rows
+        # batches with no real origin/chain rows (everything padding) take
+        # the *_noalt step variants: the alt-table scatters compile away
+        no_alt = self._batch_has_no_alt(origin_rows, chain_rows)
         batch = EntryBatch(
             rows=_pad_to(rows, b, pad_r, np.int32),
             origin_ids=_pad_to(origin_ids, b, 0, np.int32),
@@ -1712,7 +1736,12 @@ class Sentinel:
                     (self.spec.second.buckets + 1)
                     * self.spec.second.win_ms)
             use_occ = any_prio or now < self._occupy_live_until_ms
-            decide = self._jit_decide_prio if use_occ else self._jit_decide
+            if no_alt:
+                decide = (self._jit_decide_prio_noalt if use_occ
+                          else self._jit_decide_noalt)
+            else:
+                decide = (self._jit_decide_prio if use_occ
+                          else self._jit_decide)
             state, verdicts = decide(
                 self._ruleset, self._state, batch, times, sys_scalars)
             self._state = state
@@ -1760,8 +1789,11 @@ class Sentinel:
                     unpin = (self.param_key_registry,
                              pf_mod.thread_key_rows(self._param, param_rules,
                                                     param_keys))
-            self._state = self._jit_exit(self._ruleset, self._state, batch,
-                                         times)
+            exit_step = (self._jit_exit_noalt
+                         if self._batch_has_no_alt(origin_rows, chain_rows)
+                         else self._jit_exit)
+            self._state = exit_step(self._ruleset, self._state, batch,
+                                    times)
         # unpin only AFTER the device-side decrement is enqueued (entry-side
         # pin discipline: resolve→pin, decide, exit-decrement→unpin)
         if unpin is not None:

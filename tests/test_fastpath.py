@@ -28,9 +28,9 @@ def make(clk, **over):
 
 
 def _count_decides(sph):
-    """Wrap the jitted decide steps to count device dispatches."""
+    """Wrap the jitted decide steps (all four static variants: occupy ×
+    alt-free) to count device dispatches."""
     counter = {"n": 0}
-    orig, orig_prio = sph._jit_decide, sph._jit_decide_prio
 
     def wrap(fn):
         def inner(*a, **k):
@@ -38,8 +38,9 @@ def _count_decides(sph):
             return fn(*a, **k)
         return inner
 
-    sph._jit_decide = wrap(orig)
-    sph._jit_decide_prio = wrap(orig_prio)
+    for attr in ("_jit_decide", "_jit_decide_prio",
+                 "_jit_decide_noalt", "_jit_decide_prio_noalt"):
+        setattr(sph, attr, wrap(getattr(sph, attr)))
     return counter
 
 

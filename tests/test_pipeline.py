@@ -459,3 +459,114 @@ def test_fused_entry_exit_step_matches_two_dispatch(clk):
     assert np.array_equal(v1.wait_ms, v2.wait_ms)
     for a, b in zip(jax.tree.leaves(s1), jax.tree.leaves(s2)):
         assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_alt_free_variant_matches_full_on_originless_batch(clk):
+    """record_alt=False (the runtime's choice for batches with no
+    origin/chain rows) must produce identical verdicts and main-table
+    state; alt tables pass through untouched."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from sentinel_tpu.engine.pipeline import (
+        EntryBatch, ExitBatch, decide_entries, record_exits,
+    )
+
+    sph = make_sentinel(clk)
+    sph.load_flow_rules([stpu.FlowRule(resource="f", count=3.0)])
+    spec, rules, state = sph.spec, sph._ruleset, sph._state
+    row = sph.resources.get_or_create("f")
+    B = 8
+    eb = EntryBatch(
+        rows=jnp.full(B, row, jnp.int32),
+        origin_ids=jnp.zeros(B, jnp.int32),
+        origin_rows=jnp.full(B, spec.alt_rows, jnp.int32),   # all padding
+        context_ids=jnp.zeros(B, jnp.int32),
+        chain_rows=jnp.full(B, spec.alt_rows, jnp.int32),
+        acquire=jnp.ones(B, jnp.int32), is_in=jnp.ones(B, jnp.bool_),
+        prioritized=jnp.zeros(B, jnp.bool_), valid=jnp.ones(B, jnp.bool_))
+    times = sph._time_scalars(clk.now_ms())
+    sysv = jnp.asarray(np.array([0.1, 0.1], np.float32))
+    full = jax.jit(functools.partial(decide_entries, spec,
+                                     enable_occupy=False))
+    noalt = jax.jit(functools.partial(decide_entries, spec,
+                                      enable_occupy=False,
+                                      record_alt=False))
+    s1, v1 = full(rules, state, eb, times, sysv)
+    s2, v2 = noalt(rules, state, eb, times, sysv)
+    assert np.array_equal(v1.allow, v2.allow)
+    assert np.array_equal(np.asarray(s1.second.counters),
+                          np.asarray(s2.second.counters))
+    assert np.array_equal(np.asarray(s1.threads), np.asarray(s2.threads))
+    # alt tables pass through unchanged in the noalt variant; in the full
+    # variant the refresh may restamp but records nothing
+    assert np.asarray(s2.alt_threads).sum() == 0
+
+    xb = ExitBatch(
+        rows=jnp.full(B, row, jnp.int32),
+        origin_rows=jnp.full(B, spec.alt_rows, jnp.int32),
+        chain_rows=jnp.full(B, spec.alt_rows, jnp.int32),
+        acquire=jnp.ones(B, jnp.int32),
+        rt_ms=jnp.full(B, 7, jnp.int32),
+        error=jnp.zeros(B, jnp.bool_),
+        is_in=jnp.ones(B, jnp.bool_), valid=jnp.ones(B, jnp.bool_))
+    xfull = jax.jit(functools.partial(record_exits, spec))
+    xnoalt = jax.jit(functools.partial(record_exits, spec,
+                                       record_alt=False))
+    e1 = xfull(rules, s1, xb, times)
+    e2 = xnoalt(rules, s2, xb, times)
+    assert np.array_equal(np.asarray(e1.second.counters),
+                          np.asarray(e2.second.counters))
+    assert np.array_equal(np.asarray(e1.threads), np.asarray(e2.threads))
+
+
+def test_runtime_selects_alt_free_variant(clk):
+    """decide_raw on an origin-less batch dispatches the *_noalt step; a
+    batch with a real origin row dispatches the full one."""
+    sph = make_sentinel(clk, host_fast_path=False)
+    hits = {"noalt": 0, "full": 0}
+    orig_noalt, orig_full = sph._jit_decide_noalt, sph._jit_decide
+
+    def w(fn, key):
+        def inner(*a, **k):
+            hits[key] += 1
+            return fn(*a, **k)
+        return inner
+    sph._jit_decide_noalt = w(orig_noalt, "noalt")
+    sph._jit_decide = w(orig_full, "full")
+    with sph.entry("plain"):
+        pass
+    assert hits == {"noalt": 1, "full": 0}
+    with sph.entry("plain", origin="up-a"):
+        pass
+    assert hits == {"noalt": 1, "full": 1}
+
+
+def test_sample_count_one_engine_full_arc(clk):
+    """B=1 second window (sampleCount=1, a reference-supported config):
+    exercises the refresh_rows fallback branches in decide/exit/blocks —
+    flow admission, warm-up prev-window pacing, origin stats, and exits all
+    behave across window rotation."""
+    sph = make_sentinel(clk, second_sample_count=1, second_interval_ms=1000)
+    assert sph.spec.second.buckets == 1
+    sph.load_flow_rules([
+        stpu.FlowRule(resource="b1", count=3.0),
+        stpu.FlowRule(resource="wu", count=100.0,
+                      control_behavior=stpu.BEHAVIOR_WARM_UP,
+                      warm_up_period_sec=10),
+    ])
+    for step in range(3):
+        p, b = burst(sph, "b1", 5, origin="up-a")
+        assert (p, b) == (3, 2), (step, p, b)
+        clk.advance_ms(1000)
+    # warm-up ramp needs prev-window pass counts (prev_window_sum_rows):
+    # cold start must throttle well below the full count
+    p, _ = burst(sph, "wu", 60)
+    assert 0 < p < 60
+    tot = sph.node_totals("b1")
+    assert tot["block"] == 0 and tot["pass"] == 0   # rotated out
+    e = sph.entry("b1")
+    e.exit()
+    assert sph.node_totals("b1")["success"] == 1
