@@ -178,8 +178,11 @@ def degrade_entry_check(
     rj_s = rj_seg[order]
     starts = seg.segment_starts(rj_s, jnp.zeros_like(rj_s))
 
-    state_s = st.state[rj_s]
-    retry_due = (rel_now_ms - st.next_retry_ms[rj_s]) >= 0
+    # one packed gather for both breaker-state columns (separate 1M-element
+    # gathers cost ~8x a packed one on TPU — BASELINE.md round 3)
+    gs = jnp.stack([st.state, st.next_retry_ms], axis=1)[rj_s]
+    state_s = gs[:, 0]
+    retry_due = (rel_now_ms - gs[:, 1]) >= 0
     open_probe = (state_s == STATE_OPEN) & retry_due & starts
     pass_s = (state_s == STATE_CLOSED) | open_probe | (rj_s == ND)
 

@@ -323,17 +323,32 @@ def flow_check(
     rules_bk = jnp.where((batch.rows < R)[:, None], rule_idx[safe_rows], NF)  # [B,K]
     rj = rules_bk.reshape(-1)                                                # [BK]
 
-    act = table.active[rj]
+    # ONE packed [NF+1, 9] gather per index set instead of a 1M-element
+    # gather per column — on TPU eight separate gathers cost ~8x one
+    # packed gather (BASELINE.md round 3); the stack itself is a trivial
+    # [NF, 9] op re-done per step
+    pk = jnp.stack([
+        table.active.astype(jnp.int32),        # 0
+        table.limit_origin,                    # 1
+        table.cluster_mode.astype(jnp.int32),  # 2
+        table.sel_kind,                        # 3
+        table.ref_context,                     # 4
+        table.ref_row,                         # 5
+        table.behavior,                        # 6
+        table.grade,                           # 7
+        table.max_queue_ms,                    # 8
+    ], axis=1)
+    g = pk[rj]                                 # [BK, 9]
+    act = g[:, 0] != 0
 
     # --- applicability: limitApp × origin (FlowRuleChecker.checkFlow null-node) ---
-    lim = table.limit_origin[rj]
+    lim = g[:, 1]
     origin_bk = jnp.repeat(batch.origin_ids, K)
     ctx_bk = jnp.repeat(batch.context_ids, K)
     # "other": origin matches no specific-origin rule of this resource
-    own_rules = rules_bk  # [B,K]
     specific_hit = jnp.any(
-        (table.limit_origin[own_rules] == batch.origin_ids[:, None])
-        & table.active[own_rules], axis=1)                                   # [B]
+        (lim.reshape(B, K) == batch.origin_ids[:, None])
+        & act.reshape(B, K), axis=1)                                         # [B]
     specific_hit_bk = jnp.repeat(specific_hit, K)
     app_default = lim == LIMIT_DEFAULT
     app_specific = lim == origin_bk
@@ -345,18 +360,18 @@ def flow_check(
     # (per-rule FlowRuleChecker.passClusterCheck / fallbackToLocalOrPass)
     slot_bk = jnp.tile(jnp.arange(K, dtype=jnp.int32), B)
     fb_bk = (jnp.repeat(batch.cluster_fallback, K) >> slot_bk) & 1
-    applicable = applicable & (~table.cluster_mode[rj] | (fb_bk == 1))
+    applicable = applicable & ((g[:, 2] == 0) | (fb_bk == 1))
     # CHAIN additionally requires the event's context to match refResource
-    kind = table.sel_kind[rj]
+    kind = g[:, 3]
     applicable = applicable & jnp.where(
-        kind == SEL_CHAIN, ctx_bk == table.ref_context[rj], True)
+        kind == SEL_CHAIN, ctx_bk == g[:, 4], True)
 
     # --- stat-row selection ---
     rows_bk = jnp.repeat(batch.rows, K)
     orow_bk = jnp.repeat(batch.origin_rows, K)
     crow_bk = jnp.repeat(batch.chain_rows, K)
     use_alt = (kind == SEL_ORIGIN) | (kind == SEL_CHAIN)
-    sel_main_row = jnp.where(kind == SEL_REF, table.ref_row[rj], rows_bk)
+    sel_main_row = jnp.where(kind == SEL_REF, g[:, 5], rows_bk)
     sel_alt_row = jnp.where(kind == SEL_CHAIN, crow_bk, orow_bk)
     # events whose alt row is absent (no origin / no chain stats): rule passes
     applicable = applicable & jnp.where(use_alt, sel_alt_row < RA, True)
@@ -385,11 +400,14 @@ def flow_check(
     rj_seg = jnp.where(valid_bk, rj, NF)
     # Pacing state is PER RULE (one latestPassedTime per RateLimiterController
     # instance), so rate-limiter pairs collapse to one segment per rule; other
-    # behaviors segment by (rule, selected stat row).
-    behavior_bk = table.behavior[rj_seg]
+    # behaviors segment by (rule, selected stat row). behavior/grade come
+    # from the rj packed gather: invalid pairs (rj_seg == NF) may read a
+    # real rule's values here, but their row_seg is overridden to 0 below
+    # either way, so segmentation is unaffected.
+    behavior_bk = g[:, 6]
     is_rl_bk = ((behavior_bk == BEHAVIOR_RATE_LIMITER)
                 | (behavior_bk == BEHAVIOR_WARM_UP_RATE_LIMITER)) & (
-        table.grade[rj_seg] == GRADE_QPS)
+        g[:, 7] == GRADE_QPS)
     row_seg = jnp.where(use_alt, sel_alt_row + R, sel_main_row)  # disjoint key space
     row_seg = jnp.where(is_rl_bk, 0, row_seg)
     row_seg = jnp.where(valid_bk, row_seg, 0)
@@ -409,7 +427,8 @@ def flow_check(
     # otherwise (the gathers + extra scatter cost ~40% of the hot step).
     occ_cnt = dyn.occupied_count             # [R, S]
     occ_win = dyn.occupied_window            # [R, S]
-    grade_s = table.grade[rj_s]
+    g_s = pk[rj_s]                           # [BK, 9] one sorted-side gather
+    grade_s = g_s[:, 7]
     if enable_occupy:
         safe_main_occ = jnp.minimum(sel_main_row, R - 1)
         occ_age_bk = now_idx_s - occ_win[safe_main_occ]      # [BK, S]
@@ -433,7 +452,7 @@ def flow_check(
         base_s = jnp.where(grade_s == GRADE_QPS, cur_pass[order],
                            cur_thr[order])
     limit_s = eff_limit[order]
-    behavior_s = table.behavior[rj_s]
+    behavior_s = g_s[:, 6]
 
     pass_default_s = seg.greedy_admit(base_s, acq_s, limit_s, starts, leader)
 
@@ -441,7 +460,8 @@ def flow_check(
     # Shaped behaviors apply only to QPS-grade rules (FlowRuleUtil
     # .generateRater falls back to DefaultController for THREAD grade).
     # cost per element in ms: round(acquire / count * 1000)
-    count_s = jnp.maximum(table.count[rj_s], 1e-9)
+    raw_count_s = table.count[rj_s]
+    count_s = jnp.maximum(raw_count_s, 1e-9)
     cost_s = jnp.round(acq_s / count_s * 1000.0).astype(jnp.int32)
     c_first = seg.segment_broadcast_first(cost_s, leader)
     L0 = dyn.latest_passed_ms[rj_s]
@@ -453,7 +473,7 @@ def flow_check(
     # the reference), so its cost must not delay later in-batch requests:
     # fixed-point — exclusive prefix over admitted costs + own cost always
     pass_rl_s = jnp.ones_like(starts)
-    maxq_s = table.max_queue_ms[rj_s]
+    maxq_s = g_s[:, 8]
     for _ in range(3):
         excl_cost, _ = seg.segment_prefix_sum(
             jnp.where(pass_rl_s, cost_s, 0), starts, leader)
@@ -461,7 +481,7 @@ def flow_check(
         wait_s = jnp.maximum(latest_s - rel_now_ms, 0)
         pass_rl_s = wait_s <= maxq_s
         # zero-count rate limiter blocks everything (count<=0 → block)
-        pass_rl_s = pass_rl_s & (table.count[rj_s] > 0)
+        pass_rl_s = pass_rl_s & (raw_count_s > 0)
 
     # --- occupy attempt (tryOccupyNext, DefaultController prioritized path) ---
     # A denied prioritized request may pre-book the NEXT window when the pass
