@@ -255,7 +255,7 @@ class Sentinel:
         (parallel/local_shard.py), the product form of the north-star
         "single sharded counter tensor". Semantics are identical to the
         single-device engine (parity is pinned by tests); max_resources
-        must divide the mesh size."""
+        must be a multiple of the mesh size."""
         self.cfg = config or load_config()
         self.clock = clock or global_clock()
         self.mesh = mesh

@@ -204,3 +204,45 @@ def test_entry_rt_sum_no_int32_overflow_in_large_batch():
                                                          times)
     got = float(out.second.rt_sum[ENTRY_NODE_ROW, 100 % 2])
     assert got == float(B) * rt, got      # would be negative on overflow
+
+
+def test_late_dispatch_within_ring_preserves_newer_buckets():
+    """refresh_all (full-table lazy reset) must not clobber newer-stamped
+    buckets when a LATE batch (historical at_ms within one window ring —
+    the fast-path flush case) dispatches after live traffic: the safe-late
+    guard keeps dispatch indices within one ring of the max, under which a
+    full restamp at the old index can only touch dead buckets."""
+    import sentinel_tpu as stpu
+    from sentinel_tpu.core.clock import ManualClock
+
+    clk = ManualClock(start_ms=1_785_000_000_000)
+    sph = stpu.Sentinel(stpu.load_config(
+        max_resources=64, max_flow_rules=16, max_degrade_rules=16,
+        max_authority_rules=16, host_fast_path=False), clock=clk)
+    t0 = clk.now_ms()
+
+    # live traffic at NOW (window index I)
+    v = sph.decide_raw(np.array([5], np.int32), np.zeros(1, np.int32),
+                       np.array([sph.spec.alt_rows], np.int32),
+                       np.zeros(1, np.int32),
+                       np.array([sph.spec.alt_rows], np.int32),
+                       np.array([3], np.int32), np.ones(1, np.bool_),
+                       np.zeros(1, np.bool_))
+    assert bool(v.allow[0])
+    # LATE batch at I-1 (one 500ms bucket back — within the B=2 ring)
+    sph.decide_raw(np.array([6], np.int32), np.zeros(1, np.int32),
+                   np.array([sph.spec.alt_rows], np.int32),
+                   np.zeros(1, np.int32),
+                   np.array([sph.spec.alt_rows], np.int32),
+                   np.array([2], np.int32), np.ones(1, np.bool_),
+                   np.zeros(1, np.bool_), at_ms=t0 - 500)
+    # the NEWER bucket's stats survive, and the late stats landed in the
+    # previous bucket — both visible in the rolling second
+    tot5 = sph.node_totals_by_row(5)
+    tot6 = sph.node_totals_by_row(6)
+    assert tot5["pass"] == 3, tot5          # not clobbered by the late group
+    assert tot6["pass"] == 2, tot6          # late group recorded
+    # half a window later the late bucket rotates out, the live one stays
+    clk.advance_ms(500)
+    assert sph.node_totals_by_row(6)["pass"] == 0
+    assert sph.node_totals_by_row(5)["pass"] == 3
