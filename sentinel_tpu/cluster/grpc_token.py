@@ -89,7 +89,10 @@ class TokenGrpcService:
                 [r[2] for r in param_req], now_ms=now)
             for i, r in zip(param_idx, res):
                 out[i] = (int(r[0]), int(r[1]), int(r[2]))
-        return out  # type: ignore[return-value]
+        # A misbehaving engine returning fewer rows than requested must
+        # degrade to per-item FAIL (like a transport error), not crash the
+        # proto response construction with an opaque RPC error.
+        return [(STATUS_FAIL, 0, 0) if r is None else r for r in out]
 
 
 class TokenGrpcServer:

@@ -49,7 +49,10 @@ class InitExecutor:
     _done = False                        # claimed
     _complete = threading.Event()        # hooks finished
     _owner: Optional[int] = None         # claiming thread id (re-entrancy)
-    WAIT_TIMEOUT_S = 10.0                # bound on the loser rendezvous
+    # Bound on the loser rendezvous; configurable because "slow" is
+    # deployment-specific (first-process XLA compiles can legitimately take
+    # tens of seconds). Env override: SENTINEL_INIT_WAIT_TIMEOUT_S.
+    WAIT_TIMEOUT_S = 10.0
 
     @classmethod
     def do_init(cls, sentinel) -> bool:
@@ -73,14 +76,19 @@ class InitExecutor:
                 # which itself reaches do_init would otherwise deadlock
                 # (hook waits on helper, helper waits on hook's Event).
                 # After the timeout we log and proceed — weaker ordering
-                # beats a silent process hang.
-                if not complete.wait(timeout=cls.WAIT_TIMEOUT_S):
+                # beats a silent process hang. Re-check is_set() after the
+                # wait so a completion racing the timeout edge isn't
+                # mis-reported as a hang.
+                timeout = cls._wait_timeout_s()
+                if not complete.wait(timeout=timeout) \
+                        and not complete.is_set():
                     from sentinel_tpu.core.logs import record_log
                     record_log().warning(
                         "[InitExecutor] waited %.0fs for init hooks to "
                         "finish; proceeding before completion (is an init "
-                        "hook blocking on a thread that uses the facade?)",
-                        cls.WAIT_TIMEOUT_S)
+                        "hook blocking on a thread that uses the facade? "
+                        "Slow-but-healthy hooks: raise "
+                        "SENTINEL_INIT_WAIT_TIMEOUT_S)", timeout)
             return False
         from sentinel_tpu.core.logs import record_log
         try:
@@ -98,6 +106,21 @@ class InitExecutor:
             cls._owner = None
             complete.set()
         return True
+
+    @classmethod
+    def _wait_timeout_s(cls) -> float:
+        import math
+        import os
+        try:
+            v = float(os.environ.get("SENTINEL_INIT_WAIT_TIMEOUT_S",
+                                     cls.WAIT_TIMEOUT_S))
+        except ValueError:
+            return cls.WAIT_TIMEOUT_S
+        # non-positive/non-finite values would silently disable the
+        # rendezvous bound — fall back rather than obey them
+        if not math.isfinite(v) or v <= 0:
+            return cls.WAIT_TIMEOUT_S
+        return v
 
     @classmethod
     def reset(cls) -> None:

@@ -185,6 +185,17 @@ def decide_entries(
     # chain rows (host-verified all-padding) → the alt-table scatters and
     # the alt thread gauge compile away entirely; origin-less traffic is
     # the common case and those scatters are pure padding work there
+    scalar_flow: bool = False,   # STATIC: HOST-VERIFIED preconditions
+    # (no alt rows, uniform acquire >= 1, no prioritized events, no
+    # cluster_fallback bits) → flow + degrade take the scalar admission
+    # path: per-rule budgets, one rank sort, sort-free breaker probes
+    # (see rules/flow.flow_check_scalar). Implies record_alt=False and
+    # enable_occupy=False.
+    skip_auth: bool = False,     # STATIC: no authority rules loaded —
+    # the whole slot (incl. its [B, Ka] gathers) compiles away
+    skip_sys: bool = False,      # STATIC: no system thresholds set
+    scalar_has_rl: bool = True,  # STATIC: ruleset contains rate-limiter
+    # rules (scalar path only — gates the pacing-clock histogram scatter)
 ) -> Tuple[SentinelState, Verdicts]:
     """One device step: decide a batch, then record post-decision statistics.
 
@@ -200,19 +211,32 @@ def decide_entries(
     load1 = sys_scalars[0]
     cpu_usage = sys_scalars[1]
 
+    if scalar_flow:
+        assert not record_alt and not enable_occupy, \
+            "scalar_flow implies record_alt=False, enable_occupy=False"
+
     # ---- slot cascade (each gate only sees events still alive) ----
     live = batch.valid
 
-    auth_ok = auth_mod.authority_check(
-        rules.auth_table, rules.auth_idx, batch.rows, batch.origin_ids, live)
+    if skip_auth:
+        auth_ok = jnp.ones_like(live)
+    else:
+        auth_ok = auth_mod.authority_check(
+            rules.auth_table, rules.auth_idx, batch.rows, batch.origin_ids,
+            live)
     live1 = live & auth_ok
 
     # unset thresholds fold to a huge sentinel, so the check is a no-op pass
-    # when no system rules are loaded (no branch: avoids retracing)
-    sys_ok = sys_mod.system_check(
-        rules.sys_thresholds, spec.second, state.second, state.threads,
-        batch.is_in, batch.acquire, live1, now_idx_s, load1, cpu_usage,
-        spec.statistic_max_rt)
+    # when no system rules are loaded (no branch: avoids retracing); a host
+    # that KNOWS no system rules exist passes skip_sys and the whole check
+    # (its ENTRY-row window reads included) compiles away
+    if skip_sys:
+        sys_ok = jnp.ones_like(live1)
+    else:
+        sys_ok = sys_mod.system_check(
+            rules.sys_thresholds, spec.second, state.second, state.threads,
+            batch.is_in, batch.acquire, live1, now_idx_s, load1, cpu_usage,
+            spec.statistic_max_rt)
     live2 = live1 & sys_ok
 
     # ParamFlowSlot sits between SystemSlot and FlowSlot (extension SPI slot
@@ -227,32 +251,47 @@ def decide_entries(
         param_ok = jnp.ones_like(live2)
         param_wait = jnp.zeros(live2.shape, jnp.int32)
 
-    cl_fb = (batch.cluster_fallback if batch.cluster_fallback is not None
-             else jnp.zeros(batch.valid.shape, jnp.int32))
-    fview = flow_mod.FlowBatchView(
-        rows=batch.rows, origin_ids=batch.origin_ids,
-        origin_rows=batch.origin_rows, context_ids=batch.context_ids,
-        chain_rows=batch.chain_rows, acquire=batch.acquire, valid=live2,
-        prioritized=batch.prioritized, cluster_fallback=cl_fb)
-    flow_dyn, flow_ok, wait_ms, occupied = flow_mod.flow_check(
-        rules.flow_table, state.flow_dyn, rules.flow_idx, spec.second,
-        state.second, state.alt_second, state.threads, state.alt_threads,
-        fview, now_idx_s, rel_now_ms,
-        minute_spec=spec.minute,
-        main_minute=state.minute if spec.minute else None,
-        now_idx_m=now_idx_m,
-        in_win_ms=in_win_ms,
-        occupy_timeout_ms=spec.occupy_timeout_ms,
-        enable_occupy=enable_occupy)
-    live3 = live2 & flow_ok
+    if scalar_flow:
+        flow_dyn, flow_ok, wait_ms = flow_mod.flow_check_scalar(
+            rules.flow_table, state.flow_dyn, rules.flow_idx, spec.second,
+            state.second, state.threads, batch.rows, batch.acquire, live2,
+            now_idx_s, rel_now_ms,
+            minute_spec=spec.minute,
+            main_minute=state.minute if spec.minute else None,
+            now_idx_m=now_idx_m,
+            has_rate_limiter=scalar_has_rl)
+        occupied = jnp.zeros_like(flow_ok)
+        live3 = live2 & flow_ok
+        breakers, deg_ok = deg_mod.degrade_entry_check_scalar(
+            rules.deg_table, state.breakers, rules.deg_idx, batch.rows,
+            live3, rel_now_ms)
+    else:
+        cl_fb = (batch.cluster_fallback if batch.cluster_fallback is not None
+                 else jnp.zeros(batch.valid.shape, jnp.int32))
+        fview = flow_mod.FlowBatchView(
+            rows=batch.rows, origin_ids=batch.origin_ids,
+            origin_rows=batch.origin_rows, context_ids=batch.context_ids,
+            chain_rows=batch.chain_rows, acquire=batch.acquire, valid=live2,
+            prioritized=batch.prioritized, cluster_fallback=cl_fb)
+        flow_dyn, flow_ok, wait_ms, occupied = flow_mod.flow_check(
+            rules.flow_table, state.flow_dyn, rules.flow_idx, spec.second,
+            state.second, state.alt_second, state.threads, state.alt_threads,
+            fview, now_idx_s, rel_now_ms,
+            minute_spec=spec.minute,
+            main_minute=state.minute if spec.minute else None,
+            now_idx_m=now_idx_m,
+            in_win_ms=in_win_ms,
+            occupy_timeout_ms=spec.occupy_timeout_ms,
+            enable_occupy=enable_occupy)
+        live3 = live2 & flow_ok
 
-    # occupied (PriorityWait) events bypass the degrade slot entirely —
-    # in the reference the PriorityWaitException aborts the slot chain
-    # before DegradeSlot.entry runs, and the booking is already committed
-    breakers, deg_ok = deg_mod.degrade_entry_check(
-        rules.deg_table, state.breakers, rules.deg_idx, batch.rows,
-        live3 & ~occupied, rel_now_ms)
-    deg_ok = deg_ok | occupied
+        # occupied (PriorityWait) events bypass the degrade slot entirely —
+        # in the reference the PriorityWaitException aborts the slot chain
+        # before DegradeSlot.entry runs, and the booking is already committed
+        breakers, deg_ok = deg_mod.degrade_entry_check(
+            rules.deg_table, state.breakers, rules.deg_idx, batch.rows,
+            live3 & ~occupied, rel_now_ms)
+        deg_ok = deg_ok | occupied
 
     # ---- user DeviceSlots (slot-chain SPI analog; STATIC: compiles to
     # nothing when none are registered) ----
@@ -337,10 +376,16 @@ def decide_entries(
     if spec.second.buckets >= 2:
         second = refresh_all(spec.second, state.second, now_idx_s)
     else:   # B=1: full restamp would erase untouched rows' prev window
+        # ENTRY joins the refresh list only when this batch actually lands
+        # something on it — an idle/all-outbound batch restamping ENTRY
+        # would erase its previous-window bucket (previousPassQps for
+        # warm-up rules reading the entry node). add_one_row with an
+        # all-zero vector on the unrefreshed bucket is a no-op.
+        entry_refresh = jnp.where(jnp.any(entry_vec != 0),
+                                  jnp.int32(ENTRY_NODE_ROW), pad_r)
         second = refresh_rows(
             spec.second, state.second,
-            jnp.concatenate([main_rec1,
-                             jnp.full((1,), ENTRY_NODE_ROW, jnp.int32)]),
+            jnp.concatenate([main_rec1, entry_refresh[None]]),
             now_idx_s)
     second = add_rows_multi(spec.second, second, main_rec1, ev_ids1,
                             rec_amt1, now_idx_s)
@@ -455,10 +500,13 @@ def record_exits(
     if spec.second.buckets >= 2:
         second = refresh_all(spec.second, state.second, now_idx_s)
     else:
+        # B=1: same ENTRY gating as decide_entries — only refresh the
+        # entry row when an IN event actually lands on it this batch
+        entry_refresh = jnp.where(jnp.any(ein),
+                                  jnp.int32(ENTRY_NODE_ROW), pad_r)
         second = refresh_rows(
             spec.second, state.second,
-            jnp.concatenate([main_rows,
-                             jnp.full((1,), ENTRY_NODE_ROW, jnp.int32)]),
+            jnp.concatenate([main_rows, entry_refresh[None]]),
             now_idx_s)
     second = add_rows_vec(spec.second, second, main_rows, payload,
                           now_idx_s, rt_ms=rt1, rt_valid=batch.valid)

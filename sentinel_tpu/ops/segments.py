@@ -83,6 +83,42 @@ def unsort(order: jnp.ndarray, values_sorted: jnp.ndarray) -> jnp.ndarray:
     return out.at[order].set(values_sorted)
 
 
+def ranks_by_key(key: jnp.ndarray) -> jnp.ndarray:
+    """Per-element arrival rank within its key group → int32[n], original
+    order.
+
+    ``ranks[i]`` = number of earlier elements (batch order) with the same
+    key. This is the only genuinely cross-element quantity the scalar
+    admission path needs: one stable argsort + one scan + one unsort
+    scatter, vs the general path's two-key sort plus per-pair gathers of
+    every rule attribute. FIFO semantics come from sort stability exactly
+    as in :func:`sort_by_keys`.
+    """
+    n = key.shape[0]
+    order = jnp.argsort(key, stable=True)
+    ks = key[order]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    starts = jnp.zeros((n,), jnp.bool_).at[0].set(True).at[1:].set(
+        ks[1:] != ks[:-1])
+    leader = lax.associative_scan(
+        jnp.maximum, jnp.where(starts, idx, jnp.int32(0)))
+    rank_s = idx - leader
+    return jnp.zeros((n,), jnp.int32).at[order].set(rank_s)
+
+
+def first_index_by_key(key: jnp.ndarray, num_keys: int) -> jnp.ndarray:
+    """Index of each key group's FIRST element (batch order) → int32
+    [num_keys], filled with ``n`` for absent keys.
+
+    The scatter-min winner equals what a stable sort's segment-first would
+    pick — the parity-critical invariant the breaker probe election
+    (entry + exit feed) relies on. Keys must be in [0, num_keys).
+    """
+    n = key.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    return jnp.full((num_keys,), n, jnp.int32).at[key].min(idx, mode="drop")
+
+
 def greedy_admit(base: jnp.ndarray, amounts: jnp.ndarray, limit: jnp.ndarray,
                  starts: jnp.ndarray, leader: jnp.ndarray,
                  iterations: int = 3) -> jnp.ndarray:

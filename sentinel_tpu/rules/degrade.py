@@ -27,6 +27,7 @@ import dataclasses
 from typing import NamedTuple, Sequence, Tuple
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from sentinel_tpu.ops import segments as seg
@@ -204,6 +205,65 @@ def degrade_entry_check(
     return st, allow | ~valid
 
 
+def degrade_entry_check_scalar(
+    table: DegradeRuleTable, st: BreakerState, rule_idx: jnp.ndarray,
+    rows: jnp.ndarray, valid: jnp.ndarray, rel_now_ms: jnp.ndarray,
+) -> Tuple[BreakerState, jnp.ndarray]:
+    """Sort-free :func:`degrade_entry_check` → (state', allow bool[B]).
+
+    Breaker state is per-RULE, so the only cross-event computation is the
+    probe election (one winner per OPEN rule whose retry window elapsed —
+    the CAS-winner analog). The common all-CLOSED case is one packed
+    per-rule lookup gathered per pair; probe election runs under a
+    ``lax.cond`` (a batch only pays the scatter-min when some rule is
+    actually OPEN with its retry due). Bit-exact with the sorted path:
+    the scatter-min winner is the first valid pair in batch order, which
+    is what sort stability picked. Reference:
+    ``AbstractCircuitBreaker.tryPass`` + ``fromOpenToHalfOpen``.
+    """
+    B = rows.shape[0]
+    Kd = rule_idx.shape[1]
+    ND = table.active.shape[0] - 1
+    R = rule_idx.shape[0]
+    BK = B * Kd
+
+    safe_rows = jnp.minimum(rows, R - 1)
+    rules_bk = jnp.where((rows < R)[:, None], rule_idx[safe_rows], ND)
+    rj = rules_bk.reshape(-1)
+    valid_bk = jnp.repeat(valid, Kd) & table.active[rj]
+    key = jnp.where(valid_bk, rj, ND)
+
+    open_due = ((st.state == STATE_OPEN)
+                & ((rel_now_ms - st.next_retry_ms) >= 0)
+                & table.active)
+    pass_rule = (st.state == STATE_CLOSED) | ~table.active
+    pass_rule = pass_rule.at[ND].set(True)       # sentinel never blocks
+
+    def _no_probe(_):
+        pair_pass = pass_rule[key]
+        allow_ev = jnp.all(pair_pass.reshape(B, Kd), axis=1)
+        return st.state, allow_ev
+
+    def _probe(_):
+        idx = jnp.arange(BK, dtype=jnp.int32)
+        win = seg.first_index_by_key(key, ND + 1)
+        winner_pair = (idx == win[key]) & open_due[key]
+        pair_pass = pass_rule[key] | winner_pair
+        allow_ev = jnp.all(pair_pass.reshape(B, Kd), axis=1)
+        # OPEN→HALF_OPEN only when the probe's event is admitted by ALL
+        # breakers of its resource (general-path comment at
+        # degrade_entry_check for why)
+        winner_ev = jnp.minimum(win // Kd, B - 1)
+        ok = open_due & (win < BK) & allow_ev[winner_ev]
+        new_state = jnp.where(ok, STATE_HALF_OPEN, st.state)
+        return new_state, allow_ev
+
+    new_state, allow_ev = jax.lax.cond(
+        jnp.any(open_due), _probe, _no_probe, None)
+    st = st._replace(state=new_state.at[ND].set(STATE_CLOSED))
+    return st, allow_ev | ~valid
+
+
 def degrade_exit_feed(
     table: DegradeRuleTable, st: BreakerState, rule_idx: jnp.ndarray,
     rows: jnp.ndarray, rt_ms: jnp.ndarray, error: jnp.ndarray,
@@ -232,22 +292,36 @@ def degrade_exit_feed(
                        err_bk).astype(jnp.int32)
 
     # --- HALF_OPEN probe resolution (before window bookkeeping) ---
-    order = seg.sort_by_keys(rj_safe)
-    rj_s = rj_safe[order]
-    starts = seg.segment_starts(rj_s, jnp.zeros_like(rj_s))
-    probe = starts & (st.state[rj_s] == STATE_HALF_OPEN) & (rj_s != ND)
-    probe_ok = probe & (bad_bk[order] == 0)
-    probe_fail = probe & (bad_bk[order] != 0)
-    ok_rules = jnp.where(probe_ok, rj_s, ND)
-    fail_rules = jnp.where(probe_fail, rj_s, ND)
-    state = st.state.at[ok_rules].set(STATE_CLOSED, mode="drop")
-    state = state.at[fail_rules].set(STATE_OPEN, mode="drop")
-    next_retry = st.next_retry_ms.at[fail_rules].set(
-        rel_now_ms + table.retry_timeout_ms[fail_rules], mode="drop")
-    # closing resets the stat window (reference resetStat on close)
-    win_stamp = st.win_stamp.at[ok_rules].set(-(2 ** 30), mode="drop")
+    # Sort-free: the probe outcome is per-RULE (the first valid completion
+    # in batch order resolves it), so a scatter-min elects the winner pair
+    # and everything else is [ND]-sized — and the whole election runs under
+    # a lax.cond so batches with no in-flight probe (the common case) pay
+    # nothing. Winner order parity: flattened [B, Kd] index order is batch
+    # order, exactly what the old stable sort's segment-first picked.
+    BK = rj_safe.shape[0]
+
+    def _no_resolve(_):
+        return st.state, st.next_retry_ms, st.win_stamp
+
+    def _resolve(_):
+        win = seg.first_index_by_key(rj_safe, ND + 1)
+        half = (st.state == STATE_HALF_OPEN) & (win < BK)
+        winner_bad = bad_bk[jnp.minimum(win, BK - 1)]
+        ok_r = half & (winner_bad == 0)
+        fail_r = half & (winner_bad != 0)
+        state = jnp.where(ok_r, STATE_CLOSED,
+                          jnp.where(fail_r, STATE_OPEN, st.state))
+        next_retry = jnp.where(fail_r, rel_now_ms + table.retry_timeout_ms,
+                               st.next_retry_ms)
+        # closing resets the stat window (reference resetStat on close)
+        win_stamp = jnp.where(ok_r, -(2 ** 30), st.win_stamp)
+        return state, next_retry, win_stamp
+
+    state, next_retry, win_stamp = jax.lax.cond(
+        jnp.any(st.state == STATE_HALF_OPEN), _resolve, _no_resolve, None)
     state = state.at[ND].set(STATE_CLOSED)
-    st = st._replace(state=state, next_retry_ms=next_retry, win_stamp=win_stamp)
+    st = st._replace(state=state, next_retry_ms=next_retry.astype(jnp.int32),
+                     win_stamp=win_stamp)
 
     # --- single-bucket lazy reset + scatter-add ---
     widx = rel_now_ms // jnp.maximum(table.interval_ms[rj_safe], 1)   # [BK]

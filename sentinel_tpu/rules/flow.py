@@ -586,6 +586,162 @@ def flow_check(
     return dyn, allow, wait_ms.astype(jnp.int32), occupied
 
 
+def flow_check_scalar(
+    table: FlowRuleTable,
+    dyn: FlowDynState,
+    rule_idx: jnp.ndarray,
+    spec: WindowSpec,
+    main_second: WindowState,
+    main_threads: jnp.ndarray,
+    rows: jnp.ndarray,           # int32[B] (>= R padding)
+    acquire: jnp.ndarray,        # int32[B] — HOST-VERIFIED uniform (>= 1)
+    valid: jnp.ndarray,          # bool[B]
+    now_idx_s: jnp.ndarray,
+    rel_now_ms: jnp.ndarray,
+    minute_spec: Optional[WindowSpec] = None,
+    main_minute: Optional[WindowState] = None,
+    now_idx_m: Optional[jnp.ndarray] = None,
+    has_rate_limiter: bool = False,   # STATIC: ruleset has RL/WU-RL rules
+) -> Tuple[FlowDynState, jnp.ndarray, jnp.ndarray]:
+    """Scalar-path flow check → (dyn', allow bool[B], wait_ms int32[B]).
+
+    Bit-exact with :func:`flow_check` under the preconditions the HOST
+    must verify before selecting this variant (``runtime.decide_raw``):
+
+    * the batch carries no origin/chain rows and no origins (every
+      ``use_alt`` selection in the general path resolves to padding →
+      SEL_ORIGIN/SEL_CHAIN rules pass trivially);
+    * no prioritized events and no live occupy bookings (occupy off);
+    * no per-event ``cluster_fallback`` bits (cluster rules are simply
+      inapplicable locally);
+    * ``acquire`` is uniform across valid events with value >= 1.
+
+    Under those conditions every quantity the general path gathers PER
+    PAIR — window base, live threads, effective limit, pacing clock, cost,
+    behavior, grade — is a function of the RULE alone, so this path
+    computes [NF+1]-sized per-rule admission budgets and touches the
+    B*K pair axis only for: the rule gather, the arrival-rank computation
+    (one stable argsort — :func:`ops.segments.ranks_by_key`), one budget
+    gather, and elementwise compares. The general path's greedy fixed
+    point collapses to ``rank`` compares (exact for uniform acquire: the
+    admitted prefix of a segment is its first ``budget`` elements), and
+    the rate limiter collapses to its closed form
+    (``latest_k = base_time + k*cost`` is monotone in k, so the passing
+    set is a rank prefix — RateLimiterController.java:30-90 semantics).
+
+    Reference parity: DefaultController.canPass:50-76 (QPS + THREAD),
+    WarmUpController.java:66-190 (via ``_warmup_sync_and_limits``),
+    RateLimiterController.java:30-90, FlowRuleChecker rule-set semantics.
+    """
+    B = rows.shape[0]
+    K = rule_idx.shape[1]
+    NF = table.active.shape[0] - 1
+    R = rule_idx.shape[0]
+
+    # ---- per-rule admission state ([NF+1]-sized, negligible) ----
+    dyn, eff_limit = _warmup_sync_and_limits(
+        table, dyn, spec, main_second, now_idx_s, rel_now_ms,
+        minute_spec, main_minute, now_idx_m)
+    sel_row = jnp.minimum(table.sync_row, R - 1)
+    base_pass = window_sum_rows(spec, main_second, sel_row, ev.PASS,
+                                now_idx_s).astype(jnp.float32)
+    base_thr = main_threads[sel_row].astype(jnp.float32)
+    base = jnp.where(table.grade == GRADE_QPS, base_pass, base_thr)
+
+    # rules that can apply to an origin-less, fallback-free batch:
+    # default-limitApp, local-mode, MAIN/REF row selection
+    applies = (table.active
+               & (table.limit_origin == LIMIT_DEFAULT)
+               & (~table.cluster_mode)
+               & ((table.sel_kind == SEL_MAIN)
+                  | (table.sel_kind == SEL_REF)))
+    is_rl = (((table.behavior == BEHAVIOR_RATE_LIMITER)
+              | (table.behavior == BEHAVIOR_WARM_UP_RATE_LIMITER))
+             & (table.grade == GRADE_QPS))
+
+    # DEFAULT/WARM_UP: pair with rank r passes iff
+    #   (base + r*a) + a <= eff_limit   — same operand association as the
+    # general path's `base + excl + amounts <= limit` so the float32
+    # rounding is identical (bit-exact while r*a < 2^24, where the general
+    # path's cumsum is itself exact)
+
+    # RATE_LIMITER closed form (cost is per-rule for uniform acquire)
+    acq_of_rule = jnp.float32(0) + jnp.max(
+        jnp.where(valid, acquire, 0)).astype(jnp.float32)    # the uniform a
+    count_safe = jnp.maximum(table.count, 1e-9)
+    cost = jnp.round(acq_of_rule / count_safe * 1000.0).astype(jnp.int32)
+    L0 = dyn.latest_passed_ms
+    due = (L0 + cost - rel_now_ms) <= 0
+    base_time = jnp.where(due, rel_now_ms - cost, L0)
+
+    # ---- per-pair work ----
+    safe_rows = jnp.minimum(rows, R - 1)
+    rules_bk = jnp.where((rows < R)[:, None], rule_idx[safe_rows], NF)
+    rj = rules_bk.reshape(-1)                                # [BK]
+    valid_bk = jnp.repeat(valid, K)
+    # inapplicable/invalid pairs share the sentinel segment (never blocks)
+    # exactly like the general path's rj_seg
+    live_rule = applies[rj] & valid_bk
+    key = jnp.where(live_rule, rj, NF)
+    rank = seg.ranks_by_key(key)                             # int32[BK]
+
+    a_bk = jnp.repeat(acquire, K).astype(jnp.float32)
+    # packed per-rule verdict gathers: one int [NF+1, 4] (RL math must stay
+    # int32 — float32 ms arithmetic drifts after ~4.6 h of uptime) and one
+    # float [NF+1, 2] for the QPS base/limit
+    maxq_eff = jnp.where(table.count > 0, table.max_queue_ms,
+                         jnp.int32(-1))  # count<=0 RL blocks everything
+    vt = jnp.stack([
+        is_rl.astype(jnp.int32),                             # 0
+        base_time,                                           # 1
+        cost,                                                # 2
+        maxq_eff,                                            # 3
+    ], axis=1)
+    g = vt[key]                                              # [BK, 4]
+    vf = jnp.stack([base, eff_limit], axis=1)
+    gf = vf[key]                                             # [BK, 2]
+    rankf = rank.astype(jnp.float32)
+
+    pass_default = (gf[:, 0] + rankf * a_bk) + a_bk <= gf[:, 1]
+    # RL: latest = base_time + (rank+1)*cost; wait = latest - now (int32,
+    # exact — matches the general path's prefix-sum arithmetic bit for bit)
+    latest_pair = g[:, 1] + (rank + 1) * g[:, 2]
+    wait_pair = jnp.maximum(latest_pair - rel_now_ms, 0)
+    pass_rl = wait_pair <= g[:, 3]
+    pair_is_rl = g[:, 0] != 0
+    pair_pass = jnp.where(pair_is_rl, pass_rl, pass_default)
+    pair_pass = pair_pass | (key == NF)
+    pair_wait = jnp.where(pair_is_rl & pair_pass & (key != NF),
+                          wait_pair, 0)
+
+    allow = jnp.all(pair_pass.reshape(B, K), axis=1)
+    wait_ms = jnp.max(pair_wait.reshape(B, K), axis=1)
+
+    # ---- pacing-clock update (only when the ruleset has RL rules) ----
+    if has_rate_limiter:
+        # per-rule pass count = min(#valid pairs, rank budget); the rank
+        # array already encodes group sizes (max rank + 1)
+        npairs = jnp.zeros((NF + 2,), jnp.int32).at[key].max(
+            rank + 1, mode="drop")[:NF + 1]
+        max_k = jnp.where(
+            cost > 0,
+            (rel_now_ms + table.max_queue_ms
+             - base_time) // jnp.maximum(cost, 1),
+            jnp.int32(2 ** 30))
+        max_k = jnp.maximum(max_k, 0)
+        passed = jnp.minimum(npairs, max_k)
+        passed = jnp.where(is_rl & applies & (table.count > 0), passed, 0)
+        new_latest = jnp.where(
+            passed > 0,
+            (base_time + passed * cost).astype(jnp.int32),
+            dyn.latest_passed_ms)
+        dyn = dyn._replace(
+            latest_passed_ms=jnp.maximum(dyn.latest_passed_ms, new_latest))
+
+    allow = allow | ~valid
+    return dyn, allow, wait_ms
+
+
 def _warmup_sync_and_limits(
     table: FlowRuleTable, dyn: FlowDynState, spec: WindowSpec,
     main_second: WindowState, now_idx_s: jnp.ndarray, rel_now_ms: jnp.ndarray,

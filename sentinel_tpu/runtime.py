@@ -83,7 +83,9 @@ def _build_steps(spec: EngineSpec, custom_slots: tuple, shardings=None):
     def dec(occ, alt):
         return jax.jit(functools.partial(
             decide_entries, spec, enable_occupy=occ,
-            custom_slots=custom_slots, record_alt=alt), **kw_sv)
+            custom_slots=custom_slots, record_alt=alt),
+            static_argnames=("scalar_flow", "skip_auth", "skip_sys",
+                            "scalar_has_rl"), **kw_sv)
 
     # jit objects are lazy (tracing happens on first call), so building all
     # variants is free; the *_noalt ones compile away the origin/chain
@@ -406,9 +408,34 @@ class Sentinel:
         self._ruleset = self._build_ruleset()
 
     def _build_ruleset(self) -> RuleSet:
+        # Used-slot slicing: the device steps iterate a [B, K] pair axis
+        # where K is the rule-gather width — slicing it to the MAX RULES ON
+        # ANY ONE RESOURCE (not the configured capacity) halves the hot
+        # path's per-pair work for the dominant one-rule-per-resource
+        # population. A reload that widens K retraces the step (rare, and
+        # amortized by the persistent compilation cache).
+        def used_k(rules, registry):
+            per_row: dict = {}
+            for r in rules:
+                row = registry.get_or_create(r.resource)
+                per_row[row] = per_row.get(row, 0) + 1
+            return max(1, max(per_row.values(), default=1))
+
+        kf = used_k(self._flow.rules, self.resources)
+        kd = used_k(self._deg.rules, self.resources)
+        # Static step flags (jit static args — variants recompile when they
+        # flip, steady-state rulesets keep one trace):
+        self._scalar_has_rl = any(
+            r.control_behavior in (flow_mod.BEHAVIOR_RATE_LIMITER,
+                                   flow_mod.BEHAVIOR_WARM_UP_RATE_LIMITER)
+            and r.grade == flow_mod.GRADE_QPS for r in self._flow.rules)
+        self._skip_auth = self._auth.num_active == 0
+        self._skip_sys = not getattr(self, "_sys_rules", [])
         return RuleSet(
-            flow_table=self._flow.table, flow_idx=self._flow.rule_idx,
-            deg_table=self._deg.table, deg_idx=self._deg.rule_idx,
+            flow_table=self._flow.table,
+            flow_idx=self._flow.rule_idx[:, :kf],
+            deg_table=self._deg.table,
+            deg_idx=self._deg.rule_idx[:, :kd],
             auth_table=self._auth.table, auth_idx=self._auth.rule_idx,
             sys_thresholds=self._sys, param_table=self._param.table)
 
@@ -1742,8 +1769,26 @@ class Sentinel:
             else:
                 decide = (self._jit_decide_prio if use_occ
                           else self._jit_decide)
+            # Scalar admission path (rules/flow.flow_check_scalar): all
+            # preconditions host-verified here — alt-free batch AND no
+            # origin ids (a raw-API caller may pass origin_ids with
+            # padding origin_rows, and origin-limited RELATE rules match
+            # on the ID, not the row), occupy off, no per-event
+            # cluster-fallback bits, uniform acquire. skip_auth/skip_sys
+            # elide empty slots (static flags, tracked by _build_ruleset).
+            acq = np.asarray(acquire)
+            acq_uniform = (acq.size > 0
+                           and int(acq.min()) == int(acq.max()) >= 1)
+            no_origin_ids = int(np.max(origin_ids, initial=0)) == 0
+            flags = {"skip_auth": self._skip_auth,
+                     "skip_sys": self._skip_sys}
+            if (no_alt and no_origin_ids and not use_occ
+                    and cluster_fallback is None and acq_uniform):
+                flags["scalar_flow"] = True
+                flags["scalar_has_rl"] = self._scalar_has_rl
             state, verdicts = decide(
-                self._ruleset, self._state, batch, times, sys_scalars)
+                self._ruleset, self._state, batch, times, sys_scalars,
+                **flags)
             self._state = state
         start_host_copy((verdicts.allow, verdicts.reason, verdicts.wait_ms))
 

@@ -570,3 +570,31 @@ def test_sample_count_one_engine_full_arc(clk):
     e = sph.entry("b1")
     e.exit()
     assert sph.node_totals("b1")["success"] == 1
+
+
+def test_sample_count_one_outbound_batch_keeps_entry_prev_window(clk):
+    """B=1 second window: a batch with no IN events must NOT restamp the
+    ENTRY node's single bucket — with sampleCount=1 the current and
+    previous windows share the bucket position, so an unconditional
+    refresh would erase ENTRY's previousPassQps (warm-up rules reading the
+    entry node). Advisor finding r3-1."""
+    from sentinel_tpu.core.registry import ENTRY_NODE_ROW
+    from sentinel_tpu.stats import events as ev
+    from sentinel_tpu.stats.window import prev_window_sum_rows
+
+    sph = make_sentinel(clk, second_sample_count=1, second_interval_ms=1000,
+                        host_fast_path=False)
+    assert sph.spec.second.buckets == 1
+    # window W: 4 IN passes land on ENTRY
+    for _ in range(4):
+        sph.entry("r_in").exit()
+    clk.advance_ms(1000)
+    # window W+1: outbound-only traffic (no IN events) — entry() and
+    # exit() both dispatch device steps whose batches carry no IN event
+    e = sph.entry("r_out", entry_type=stpu.ENTRY_TYPE_OUT)
+    e.exit()
+    now_idx = sph.spec.second.index_of(clk.now_ms())
+    prev = prev_window_sum_rows(
+        sph.spec.second, sph._state.second,
+        np.array([ENTRY_NODE_ROW], np.int32), ev.PASS, now_idx)
+    assert int(np.asarray(prev)[0]) == 4

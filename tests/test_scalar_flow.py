@@ -1,0 +1,271 @@
+"""Scalar admission path parity: flow_check_scalar / degrade_entry_check_scalar
+must be bit-exact with the general sorted path under their preconditions
+(alt-free batch, uniform acquire >= 1, no prioritized events, no
+cluster_fallback bits — the host-side selection criteria in
+``runtime.decide_raw_nowait``).
+
+Reference semantics under test: DefaultController.canPass:50-76,
+RateLimiterController.java:30-90, WarmUpController.java:66-190,
+AbstractCircuitBreaker.tryPass / fromOpenToHalfOpen / onRequestComplete.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.engine.pipeline import (
+    EntryBatch, ExitBatch, decide_entries, record_exits,
+)
+from sentinel_tpu.rules import degrade as deg_mod
+from sentinel_tpu.rules import flow as flow_mod
+
+
+def make_sentinel(clock, **cfg_over):
+    cfg = stpu.load_config(max_resources=64, max_origins=32,
+                           max_flow_rules=16, max_degrade_rules=16,
+                           max_authority_rules=16, minute_enabled=True,
+                           **cfg_over)
+    return stpu.Sentinel(config=cfg, clock=clock)
+
+
+@pytest.fixture
+def clk():
+    return ManualClock(start_ms=1_785_000_000_000)
+
+
+MIXED_RULES = [
+    stpu.FlowRule(resource="qps", count=5.0),
+    stpu.FlowRule(resource="qps2", count=3.0),
+    stpu.FlowRule(resource="thread", count=4.0, grade=stpu.GRADE_THREAD),
+    stpu.FlowRule(resource="warm", count=50.0,
+                  control_behavior=stpu.BEHAVIOR_WARM_UP,
+                  warm_up_period_sec=10),
+    stpu.FlowRule(resource="paced", count=10.0,
+                  control_behavior=stpu.BEHAVIOR_RATE_LIMITER,
+                  max_queueing_time_ms=400),
+    stpu.FlowRule(resource="wurl", count=8.0,
+                  control_behavior=stpu.BEHAVIOR_WARM_UP_RATE_LIMITER,
+                  max_queueing_time_ms=300, warm_up_period_sec=5),
+    stpu.FlowRule(resource="rel", count=4.0, strategy=stpu.STRATEGY_RELATE,
+                  ref_resource="qps"),
+    # inapplicable-on-this-path rule families: origin-specific, chain,
+    # cluster — the scalar path must pass them exactly like the general
+    # path does for an origin-less batch
+    stpu.FlowRule(resource="qps", count=1.0, limit_app="app-x"),
+    stpu.FlowRule(resource="chain", count=1.0, strategy=stpu.STRATEGY_CHAIN,
+                  ref_resource="some_ctx"),
+    stpu.FlowRule(resource="clus", count=1.0, cluster_mode=True,
+                  cluster_flow_id=77),
+    stpu.FlowRule(resource="zero_rl", count=0.0,
+                  control_behavior=stpu.BEHAVIOR_RATE_LIMITER),
+]
+
+DEG_RULES = [
+    stpu.DegradeRule(resource="qps", grade=stpu.GRADE_EXCEPTION_RATIO,
+                     count=0.5, time_window=2, min_request_amount=3),
+    stpu.DegradeRule(resource="brk", grade=stpu.GRADE_EXCEPTION_COUNT,
+                     count=2, time_window=1, min_request_amount=2),
+    stpu.DegradeRule(resource="slow", grade=stpu.GRADE_RT, count=20,
+                     time_window=1, slow_ratio_threshold=0.5,
+                     min_request_amount=2),
+]
+
+
+def _batch(sph, rng, n, resources, acquire=1):
+    spec = sph.spec
+    names = [resources[i] for i in rng.integers(0, len(resources), n)]
+    rows = np.array([sph.resources.get_or_create(r) for r in names],
+                    np.int32)
+    valid = rng.random(n) > 0.15
+    return EntryBatch(
+        rows=jnp.asarray(rows),
+        origin_ids=jnp.zeros(n, jnp.int32),
+        origin_rows=jnp.full(n, spec.alt_rows, jnp.int32),
+        context_ids=jnp.zeros(n, jnp.int32),
+        chain_rows=jnp.full(n, spec.alt_rows, jnp.int32),
+        acquire=jnp.full(n, acquire, jnp.int32),
+        is_in=jnp.asarray(rng.random(n) > 0.3),
+        prioritized=jnp.zeros(n, jnp.bool_),
+        valid=jnp.asarray(valid))
+
+
+def _steps(sph, scalar_has_rl=True):
+    spec = sph.spec
+    gen = jax.jit(functools.partial(
+        decide_entries, spec, enable_occupy=False, record_alt=False))
+    sca = jax.jit(functools.partial(
+        decide_entries, spec, enable_occupy=False, record_alt=False,
+        scalar_flow=True, scalar_has_rl=scalar_has_rl))
+    return gen, sca
+
+
+def _assert_state_equal(s1, s2):
+    for a, b in zip(jax.tree.leaves(s1), jax.tree.leaves(s2)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            "state leaf diverged"
+
+
+@pytest.mark.parametrize("acquire", [1, 3])
+def test_scalar_flow_parity_mixed_rules(clk, acquire):
+    """Randomized batches over every behavior family × window rotation:
+    verdicts, wait_ms, and ALL device state bit-equal between paths."""
+    sph = make_sentinel(clk)
+    sph.load_flow_rules(MIXED_RULES)
+    sph.load_degrade_rules(DEG_RULES)
+    resources = ["qps", "qps2", "thread", "warm", "paced", "wurl", "rel",
+                 "chain", "clus", "zero_rl", "free1", "free2", "brk",
+                 "slow"]
+    rng = np.random.default_rng(7)
+    gen, sca = _steps(sph)
+    s1 = s2 = sph._state
+    sysv = jnp.asarray(np.array([0.1, 0.1], np.float32))
+    for step in range(14):
+        b = _batch(sph, rng, 64, resources, acquire=acquire)
+        times = sph._time_scalars(clk.now_ms())
+        s1, v1 = gen(sph._ruleset, s1, b, times, sysv)
+        s2, v2 = sca(sph._ruleset, s2, b, times, sysv)
+        assert np.array_equal(np.asarray(v1.allow), np.asarray(v2.allow)), \
+            f"allow diverged at step {step}"
+        assert np.array_equal(np.asarray(v1.wait_ms),
+                              np.asarray(v2.wait_ms)), \
+            f"wait_ms diverged at step {step}"
+        assert np.array_equal(np.asarray(v1.reason),
+                              np.asarray(v2.reason)), \
+            f"reason diverged at step {step}"
+        _assert_state_equal(s1, s2)
+        clk.advance_ms(int(rng.integers(20, 400)))
+
+
+def test_scalar_degrade_probe_arc_parity(clk):
+    """Trip → OPEN → probe (HALF_OPEN) → resolve arcs: scalar and general
+    paths keep identical breaker state through entry+exit sequences."""
+    sph = make_sentinel(clk)
+    sph.load_degrade_rules(DEG_RULES)
+    rng = np.random.default_rng(3)
+    gen, sca = _steps(sph)
+    ex = jax.jit(functools.partial(record_exits, sph.spec,
+                                   record_alt=False))
+    spec = sph.spec
+    resources = ["qps", "brk", "slow", "free1"]
+    s1 = s2 = sph._state
+    sysv = jnp.asarray(np.array([0.1, 0.1], np.float32))
+    for step in range(16):
+        b = _batch(sph, rng, 32, resources)
+        times = sph._time_scalars(clk.now_ms())
+        s1, v1 = gen(sph._ruleset, s1, b, times, sysv)
+        s2, v2 = sca(sph._ruleset, s2, b, times, sysv)
+        assert np.array_equal(np.asarray(v1.allow), np.asarray(v2.allow))
+        # exits: errors + slow RTs to trip/resolve the breakers
+        n = 32
+        xb = ExitBatch(
+            rows=b.rows,
+            origin_rows=jnp.full(n, spec.alt_rows, jnp.int32),
+            chain_rows=jnp.full(n, spec.alt_rows, jnp.int32),
+            acquire=jnp.ones(n, jnp.int32),
+            rt_ms=jnp.asarray(rng.integers(1, 60, n).astype(np.int32)),
+            error=jnp.asarray(rng.random(n) < 0.6),
+            is_in=b.is_in,
+            valid=np.asarray(v1.allow) & np.asarray(b.valid))
+        s1 = ex(sph._ruleset, s1, xb, times)
+        s2 = ex(sph._ruleset, s2, xb, times)
+        _assert_state_equal(s1, s2)
+        clk.advance_ms(int(rng.integers(100, 1500)))
+
+
+def test_scalar_rate_limiter_pacing_ladder(clk):
+    """The closed-form rate limiter reproduces the general path's pacing
+    ladder (wait_ms = k * cost for the k-th admitted event) and its
+    pacing-clock update across steps."""
+    sph = make_sentinel(clk)
+    sph.load_flow_rules([stpu.FlowRule(
+        resource="p", count=10.0,
+        control_behavior=stpu.BEHAVIOR_RATE_LIMITER,
+        max_queueing_time_ms=500)])
+    gen, sca = _steps(sph)
+    row = sph.resources.get_or_create("p")
+    n = 8
+    b = EntryBatch(
+        rows=jnp.full(n, row, jnp.int32),
+        origin_ids=jnp.zeros(n, jnp.int32),
+        origin_rows=jnp.full(n, sph.spec.alt_rows, jnp.int32),
+        context_ids=jnp.zeros(n, jnp.int32),
+        chain_rows=jnp.full(n, sph.spec.alt_rows, jnp.int32),
+        acquire=jnp.ones(n, jnp.int32),
+        is_in=jnp.ones(n, jnp.bool_),
+        prioritized=jnp.zeros(n, jnp.bool_),
+        valid=jnp.ones(n, jnp.bool_))
+    sysv = jnp.asarray(np.array([0.1, 0.1], np.float32))
+    s1 = s2 = sph._state
+    for step in range(4):
+        times = sph._time_scalars(clk.now_ms())
+        s1, v1 = gen(sph._ruleset, s1, b, times, sysv)
+        s2, v2 = sca(sph._ruleset, s2, b, times, sysv)
+        w1 = np.asarray(v1.wait_ms)
+        w2 = np.asarray(v2.wait_ms)
+        assert np.array_equal(w1, w2), (step, w1, w2)
+        assert np.array_equal(np.asarray(v1.allow), np.asarray(v2.allow))
+        _assert_state_equal(s1, s2)
+        clk.advance_ms(137)
+    # ladder shape sanity on the last step: 100ms cost per admitted event
+    assert w1.max() > 0
+
+
+def test_scalar_skip_auth_sys_flags_are_pure_skips(clk):
+    """skip_auth/skip_sys with EMPTY rule tables change nothing (they only
+    elide work that was already a structural no-op)."""
+    sph = make_sentinel(clk)
+    sph.load_flow_rules([stpu.FlowRule(resource="q", count=4.0)])
+    spec = sph.spec
+    base = jax.jit(functools.partial(
+        decide_entries, spec, enable_occupy=False, record_alt=False))
+    skp = jax.jit(functools.partial(
+        decide_entries, spec, enable_occupy=False, record_alt=False,
+        skip_auth=True, skip_sys=True))
+    rng = np.random.default_rng(5)
+    b = _batch(sph, rng, 32, ["q", "free"])
+    times = sph._time_scalars(clk.now_ms())
+    sysv = jnp.asarray(np.array([0.1, 0.1], np.float32))
+    s1, v1 = base(sph._ruleset, sph._state, b, times, sysv)
+    s2, v2 = skp(sph._ruleset, sph._state, b, times, sysv)
+    assert np.array_equal(np.asarray(v1.allow), np.asarray(v2.allow))
+    assert np.array_equal(np.asarray(v1.reason), np.asarray(v2.reason))
+    _assert_state_equal(s1, s2)
+
+
+def test_ranks_by_key():
+    from sentinel_tpu.ops.segments import ranks_by_key
+    key = jnp.asarray(np.array([3, 1, 3, 3, 1, 0, 3], np.int32))
+    got = np.asarray(ranks_by_key(key))
+    assert got.tolist() == [0, 0, 1, 2, 1, 0, 3]
+
+
+def test_raw_api_origin_ids_without_rows_take_general_path(clk):
+    """A raw-API batch carrying origin_ids with PADDING origin_rows must
+    not select the scalar path: origin-limited RELATE rules match on the
+    ID (no alt row needed) and must still block. Review finding r4."""
+    sph = make_sentinel(clk, host_fast_path=False)
+    oid = sph.origins.pin("app-x")
+    sph.load_flow_rules([
+        stpu.FlowRule(resource="guarded", count=0.0, limit_app="app-x",
+                      strategy=stpu.STRATEGY_RELATE, ref_resource="other"),
+    ])
+    row = sph.resources.get_or_create("guarded")
+    n = 4
+    pad_alt = np.full(n, sph.spec.alt_rows, np.int32)
+    v = sph.decide_raw(
+        np.full(n, row, np.int32),
+        origin_ids=np.full(n, oid, np.int32),
+        origin_rows=pad_alt,
+        context_ids=np.zeros(n, np.int32),
+        chain_rows=pad_alt,
+        acquire=np.ones(n, np.int32),
+        is_in=np.ones(n, np.bool_),
+        prioritized=np.zeros(n, np.bool_))
+    # count=0 + matching origin id → the rule applies and blocks everything
+    assert not v.allow.any()
