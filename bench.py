@@ -107,7 +107,12 @@ def main() -> None:
     from sentinel_tpu.stats.window import WindowSpec
 
     R = int(os.environ.get("BENCH_RESOURCES", str(1 << 20)))        # 1M rows
-    B = int(os.environ.get("BENCH_BATCH", str(1 << 19)))            # 512k events
+    # Default batch: 512k, the knee of the committed scaling study
+    # (benchmarks/scaling_study.py; curve table in BASELINE.md) — on the
+    # v5 lite chip throughput is flat within ~5% from 512k up while step
+    # latency grows linearly; B=2M buys +5% if latency is irrelevant.
+    # BENCH_BATCH overrides; re-run the study on new hardware.
+    B = int(os.environ.get("BENCH_BATCH", str(1 << 19)))
     STEPS = int(os.environ.get("BENCH_STEPS", "60"))
     NRULES = int(os.environ.get("BENCH_RULES", "4096"))
     WARMUP = 3
@@ -192,12 +197,9 @@ def main() -> None:
     # chain rows, uniform acquire=1, no priorities — the runtime selects
     # these same static variants for such batches (scalar admission path,
     # empty-slot skips, used-rule-slot slicing; see runtime.decide_raw)
-    def k_used(idx, sentinel):
-        return max(1, int(np.max(np.sum(
-            np.asarray(idx) < sentinel, axis=1))))
     ruleset = ruleset._replace(
-        flow_idx=compiled.rule_idx[:, :k_used(compiled.rule_idx, NRULES)],
-        deg_idx=deg.rule_idx[:, :k_used(deg.rule_idx, len(deg_rules))])
+        flow_idx=compiled.rule_idx[:, :compiled.k_used],
+        deg_idx=deg.rule_idx[:, :deg.k_used])
     step = jax.jit(functools.partial(decide_entries, spec,
                                      enable_occupy=False, record_alt=False,
                                      scalar_flow=True, scalar_has_rl=False,

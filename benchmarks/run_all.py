@@ -154,8 +154,10 @@ def bench_all_controllers():
         k_per_resource=4, num_rows=R)
     param = pf_mod.compile_param_rules([], resource_registry=res,
                                        capacity=16, k_per_resource=4)
-    ruleset = RuleSet(flow_table=flow.table, flow_idx=flow.rule_idx,
-                      deg_table=deg.table, deg_idx=deg.rule_idx,
+    ruleset = RuleSet(flow_table=flow.table,
+                      flow_idx=flow.rule_idx[:, :1],  # 1 rule/resource:
+                      # the runtime's used-slot slicing (_build_ruleset)
+                      deg_table=deg.table, deg_idx=deg.rule_idx[:, :1],
                       auth_table=auth.table, auth_idx=auth.rule_idx,
                       sys_thresholds=sys_mod.compile_system_rules([]),
                       param_table=param.table)
@@ -169,8 +171,13 @@ def bench_all_controllers():
         chain_rows=jnp.full(B, spec.alt_rows, jnp.int32),
         acquire=jnp.ones(B, jnp.int32), is_in=jnp.ones(B, jnp.bool_),
         prioritized=jnp.zeros(B, jnp.bool_), valid=jnp.ones(B, jnp.bool_))
+    # same static variant the runtime selects for this batch shape:
+    # alt-free + uniform acquire + no origins → scalar path (with RL
+    # rules present), empty auth/system slots skipped
     step = jax.jit(functools.partial(decide_entries, spec,
-                                     enable_occupy=False),
+                                     enable_occupy=False, record_alt=False,
+                                     scalar_flow=True, scalar_has_rl=True,
+                                     skip_auth=True, skip_sys=True),
                    donate_argnums=(1,))
     sysv = jnp.asarray(np.array([0.5, 0.1], np.float32))
 
@@ -238,8 +245,8 @@ def bench_breakers():
         k_per_resource=4, num_rows=R)
     param = pf_mod.compile_param_rules([], resource_registry=res,
                                        capacity=16, k_per_resource=4)
-    ruleset = RuleSet(flow_table=flow.table, flow_idx=flow.rule_idx,
-                      deg_table=deg.table, deg_idx=deg.rule_idx,
+    ruleset = RuleSet(flow_table=flow.table, flow_idx=flow.rule_idx[:, :1],
+                      deg_table=deg.table, deg_idx=deg.rule_idx[:, :1],
                       auth_table=auth.table, auth_idx=auth.rule_idx,
                       sys_thresholds=sys_mod.compile_system_rules([]),
                       param_table=param.table)
@@ -261,10 +268,13 @@ def bench_breakers():
         error=jnp.asarray(rng.random(B) < 0.3),
         is_in=jnp.ones(B, jnp.bool_), valid=jnp.ones(B, jnp.bool_))
     from sentinel_tpu.engine.pipeline import decide_and_record_exits
-    step = jax.jit(functools.partial(decide_entries, spec,
-                                     enable_occupy=False))
-    exit_step = jax.jit(functools.partial(record_exits, spec))
-    fused = jax.jit(functools.partial(decide_and_record_exits, spec))
+    # same static variants the runtime selects for alt-free traffic
+    kw = dict(enable_occupy=False, record_alt=False, scalar_flow=True,
+              scalar_has_rl=False, skip_auth=True, skip_sys=True)
+    step = jax.jit(functools.partial(decide_entries, spec, **kw))
+    exit_step = jax.jit(functools.partial(record_exits, spec,
+                                          record_alt=False))
+    fused = jax.jit(functools.partial(decide_and_record_exits, spec, **kw))
     sysv = jnp.asarray(np.array([0.5, 0.1], np.float32))
 
     def times(i):

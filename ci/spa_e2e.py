@@ -41,9 +41,21 @@ def boot():
     dash = DashboardServer(Dashboard(password="s3cr3t"), host="127.0.0.1",
                            port=0)
     dport = dash.start()
+    from sentinel_tpu.gateway import (
+        GatewayApiDefinitionManager, GatewayRuleManager,
+    )
+    gw = GatewayRuleManager(sph)
+    apis = GatewayApiDefinitionManager()
     transport = start_transport(
         sph, host="0.0.0.0", port=0,
-        dashboard_addr=f"127.0.0.1:{dport}", heartbeat_interval_ms=1000)
+        dashboard_addr=f"127.0.0.1:{dport}", heartbeat_interval_ms=1000,
+        gateway_manager=gw, api_definition_manager=apis)
+    # embedded cluster coordinator: the dashboard's assign flow flips the
+    # machine to SERVER mode and expects it to report its token-server
+    # port (cluster/coordinator.py)
+    from sentinel_tpu.cluster.coordinator import ClusterCoordinator
+    coord = ClusterCoordinator(sph)
+    coord.bind(transport.cluster_state)
     # traffic so metrics views have data
     for _ in range(20):
         try:
@@ -116,6 +128,41 @@ def drive(dport: int) -> None:
         assert page.locator("td", has_text="e2e-res").count() >= 1, \
             "saved rule not in table"
         print("flow rule editor round-trip OK")
+
+        # ---- gateway flow editor round-trip
+        page.goto(f"http://127.0.0.1:{dport}/#/spa-e2e/gatewayFlow")
+        page.wait_for_timeout(700)
+        page.click("text=+ new")
+        page.wait_for_selector("#modal", timeout=5000)
+        page.fill("#modal input >> nth=0", "e2e-route")
+        page.click("#modal button.primary")
+        page.wait_for_selector("#modal", state="detached", timeout=5000)
+        page.wait_for_timeout(700)
+        assert page.locator("td", has_text="e2e-route").count() >= 1, \
+            "saved gateway rule not in table"
+        print("gateway flow editor round-trip OK")
+
+        # ---- gateway API definition editor round-trip
+        page.goto(f"http://127.0.0.1:{dport}/#/spa-e2e/gatewayApi")
+        page.wait_for_timeout(700)
+        page.click("text=+ new")
+        page.wait_for_selector("#modal", timeout=5000)
+        page.fill("#modal input >> nth=0", "e2e-api-group")
+        page.click("#modal button.primary")
+        page.wait_for_selector("#modal", state="detached", timeout=5000)
+        page.wait_for_timeout(700)
+        assert page.locator("td", has_text="e2e-api-group").count() >= 1, \
+            "saved API definition not in table"
+        print("gateway API editor round-trip OK")
+
+        # ---- cluster assign flow: promote the machine to token server
+        page.goto(f"http://127.0.0.1:{dport}/#/spa-e2e/cluster")
+        page.wait_for_timeout(700)
+        page.click("text=assign")
+        page.wait_for_timeout(1500)
+        assert page.locator("td", has_text="listening :").count() >= 1, \
+            "assign did not promote the machine to a listening server"
+        print("cluster assign OK")
         browser.close()
     hard = [e for e in errors if "favicon" not in e]
     if hard:

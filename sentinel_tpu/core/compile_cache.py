@@ -1,0 +1,88 @@
+"""Persistent XLA compilation cache — the cold-start story.
+
+The reference agent is usable at the first ``SphU.entry`` (static init,
+``Env.java`` — milliseconds). A JAX engine instead pays an XLA compile of
+the fused decision step per (geometry, variant) per process: ~20-40 s on
+the tunneled TPU, seconds on CPU. This module turns that into a
+once-per-geometry cost machine-wide: every ``Sentinel`` construction
+enables JAX's persistent compilation cache (content-addressed by HLO, so
+identical geometry + jaxlib + flags ⇒ disk hit), making every process
+after the first start in warm time. Measured numbers + ops guidance live
+in ``docs/OPERATIONS.md`` ("Cold start").
+
+Env knobs:
+- ``SENTINEL_COMPILE_CACHE`` — cache directory (default
+  ``~/.cache/sentinel_tpu/xla``); ``0``/``off`` disables.
+
+Default policy: AUTO-ON for accelerator backends (TPU — where a step
+compile costs tens of seconds), OPT-IN on the CPU backend (set the env
+var or config field): this jax/jaxlib's CPU AOT loader logs a
+machine-feature-mismatch warning for every cache entry it loads
+(``cpu_aot_loader.cc`` — the compile records ``+prefer-no-scatter``-style
+pseudo-features host detection lacks), ~44 stderr lines per warm start,
+which is not an acceptable default for a serving process's logs.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_enabled_dir: Optional[str] = None
+
+
+def default_cache_dir() -> str:
+    base = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(base, "sentinel_tpu", "xla")
+
+
+def enable_persistent_cache(path: Optional[str] = None) -> Optional[str]:
+    """Idempotently enable JAX's persistent compilation cache → the active
+    cache dir (None when disabled via env or unavailable).
+
+    Safe to call before or after backend initialization (the cache is
+    consulted per compilation, not at client creation). First caller wins
+    the directory; later calls with a different explicit ``path`` are
+    ignored (one cache per process — JAX has one global config).
+    """
+    global _enabled_dir
+    env = os.environ.get("SENTINEL_COMPILE_CACHE", "")
+    if env.lower() in ("0", "off", "disable", "disabled"):
+        return None
+    with _lock:
+        if _enabled_dir is not None:
+            return _enabled_dir
+        if not path and not env:
+            # default-on only off-CPU (see module docstring)
+            try:
+                import jax
+                if jax.default_backend() == "cpu":
+                    return None
+            except Exception:  # pragma: no cover
+                return None
+        cache_dir = path or env or default_cache_dir()
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+        except OSError:
+            return None
+        try:
+            import jax
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            # cache everything: the engine's step compiles are the cost we
+            # exist to amortize, and even "fast" (>0.1 s) entries add up
+            # across the variant set
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              -1)
+        except Exception:  # pragma: no cover - future-flag drift
+            return None
+        _enabled_dir = cache_dir
+        return cache_dir
+
+
+def active_cache_dir() -> Optional[str]:
+    return _enabled_dir

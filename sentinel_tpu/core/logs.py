@@ -60,13 +60,23 @@ class BlockStatLogger:
     Line format mirrors the EagleEye stat line:
     ``ms|resource,exception,limitApp,origin,ruleId|count`` with at most
     ``max_entries`` distinct keys per interval (overflow keys are dropped,
-    like the StatLogger's maxEntryCount=6000)."""
+    like the StatLogger's maxEntryCount=6000).
+
+    Written LINES are additionally rate-limited by a token bucket
+    (``max_lines_per_sec``, burst = one second's worth) — the EagleEye
+    ``TokenBucket`` analog. The DEFAULT equals ``max_entries`` so the
+    documented per-interval key contract is never silently trimmed; the
+    knob exists for operators with a tighter disk budget (a sustained
+    block storm over high-cardinality resources still rolls up to 6000
+    lines/s otherwise). Trimmed intervals append one ``__dropped__``
+    summary line so the loss is visible, not silent."""
 
     FILE_NAME = "sentinel-block.log"
 
     def __init__(self, clock, base_dir: Optional[str] = None,
                  max_entries: int = 6000, max_bytes: int = 300 * 1024 * 1024,
-                 backups: int = 3, file_name: Optional[str] = None):
+                 backups: int = 3, file_name: Optional[str] = None,
+                 max_lines_per_sec: Optional[int] = None):
         self._clock = clock
         self._dir = base_dir or log_base_dir()
         self.file_name = file_name or self.FILE_NAME
@@ -76,6 +86,11 @@ class BlockStatLogger:
         self._lock = threading.Lock()
         self._bucket_sec = 0
         self._counts: Dict[Tuple[str, str, str, str, str], int] = {}
+        self._line_rate = max(1, max_lines_per_sec
+                              if max_lines_per_sec is not None
+                              else max_entries)
+        self._line_tokens = float(self._line_rate)
+        self._last_refill_sec = 0
 
     def log(self, resource: str, exception_name: str, limit_app: str = "",
             origin: str = "", rule_id: str = "", count: int = 1) -> None:
@@ -99,8 +114,22 @@ class BlockStatLogger:
         if pending[1]:
             self._write(*pending)
 
+    def _take_line_tokens(self, sec: int, want: int) -> int:
+        """Token-bucket refill + take → number of lines allowed now."""
+        with self._lock:
+            elapsed = max(0, sec - self._last_refill_sec)
+            self._last_refill_sec = sec
+            self._line_tokens = min(float(self._line_rate),
+                                    self._line_tokens
+                                    + elapsed * self._line_rate)
+            granted = min(want, int(self._line_tokens))
+            self._line_tokens -= granted
+            return granted
+
     def _write(self, sec: int, counts: Dict) -> None:
         path = os.path.join(self._dir, self.file_name)
+        budget = self._take_line_tokens(sec, len(counts))
+        dropped = len(counts) - budget
         try:
             os.makedirs(self._dir, exist_ok=True)
             if os.path.exists(path) and os.path.getsize(path) > self._max_bytes:
@@ -110,7 +139,9 @@ class BlockStatLogger:
                         os.replace(src, f"{path}.{i + 1}")
                 os.replace(path, f"{path}.1")
             with open(path, "a", encoding="utf-8") as fh:
-                for (res, exc, la, org, rid), n in counts.items():
+                for (res, exc, la, org, rid), n in list(counts.items())[:budget]:
                     fh.write(f"{sec * 1000}|{res},{exc},{la},{org},{rid}|{n}\n")
+                if dropped > 0:
+                    fh.write(f"{sec * 1000}|__dropped__|{dropped}\n")
         except OSError:   # pragma: no cover — never break the hot path on IO
             pass

@@ -578,6 +578,11 @@ def decide_and_record_exits(
     sys_scalars: jnp.ndarray,    # float32[2]
     enable_occupy: bool = False,
     custom_slots: Tuple = (),
+    record_alt: bool = True,     # STATIC (see decide_entries)
+    scalar_flow: bool = False,   # STATIC (see decide_entries)
+    skip_auth: bool = False,     # STATIC
+    skip_sys: bool = False,      # STATIC
+    scalar_has_rl: bool = True,  # STATIC
 ) -> Tuple[SentinelState, Verdicts]:
     """Fused entry+exit step: one dispatch where serving loops would pay two.
 
@@ -593,8 +598,12 @@ def decide_and_record_exits(
     tunneled TPU pays one dispatch RTT instead of two."""
     state, verdicts = decide_entries(
         spec, rules, state, entry_batch, times, sys_scalars,
-        enable_occupy=enable_occupy, custom_slots=custom_slots)
-    state = record_exits(spec, rules, state, exit_batch, times)
+        enable_occupy=enable_occupy, custom_slots=custom_slots,
+        record_alt=record_alt, scalar_flow=scalar_flow,
+        skip_auth=skip_auth, skip_sys=skip_sys,
+        scalar_has_rl=scalar_has_rl)
+    state = record_exits(spec, rules, state, exit_batch, times,
+                         record_alt=record_alt)
     return state, verdicts
 
 

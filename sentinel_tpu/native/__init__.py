@@ -93,6 +93,21 @@ def load_native():
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
             ctypes.c_char_p, ctypes.c_int32]
+        lib.str_get_or_create_batch2.restype = ctypes.c_int32
+        lib.str_get_or_create_batch2.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint8)]
+        lib.i64_get_or_create_batch.restype = ctypes.c_int32
+        lib.i64_get_or_create_batch.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint8)]
+        for fn in (lib.str_pin_rows, lib.str_unpin_rows):
+            fn.restype = None
+            fn.argtypes = [ctypes.c_void_p,
+                           ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
         _lib_handle = lib
         return lib
 
@@ -142,6 +157,12 @@ class NativeRegistry:
         a name is ~30× cheaper than encoding + marshalling it."""
         n = len(names)
         if n > 64:
+            # all-identical batch (per-resource serving loops): ONE intern,
+            # no dict pass — names.count is a C-speed scan
+            first = names[0]
+            if isinstance(names, list) and names.count(first) == n:
+                row = self.get_or_create(first)
+                return np.full(n, row, np.int32)
             pos: dict = {}
             for s in names:
                 if s not in pos:

@@ -36,7 +36,8 @@ struct Entry {
     // intrusive LRU list over *occupied* entries (most recent at tail)
     int32_t  prev = -1;          // index into slots_, -1 = none
     int32_t  next = -1;
-    bool     pinned = false;
+    bool     pinned = false;     // string-API pin (rule resources: sticky)
+    uint32_t pin_count = 0;      // row-API counted pins (in-flight entries)
 };
 
 struct Table {
@@ -118,7 +119,7 @@ struct Table {
     // --- core ops ---------------------------------------------------------
     int32_t evict_locked() {
         for (int32_t i = lru_head; i >= 0; i = slots[i].next) {
-            if (!slots[i].pinned) {
+            if (!slots[i].pinned && slots[i].pin_count == 0) {
                 Entry& e = slots[i];
                 bucket_erase(e.name, e.len);
                 lru_unlink(i);
@@ -166,10 +167,23 @@ struct Table {
         e.len = len;
         e.id = slot;
         e.pinned = pin;
+        // pin_count deliberately NOT reset: counted row pins are
+        // independent of key liveness (a pin taken on a row protects its
+        // next occupant too — exactly the Python registry's _pins dict)
         buckets[b] = slot;
         lru_push_tail(slot);
         ++live;
         return slot;
+    }
+
+    // get_or_create that also reports creation (param-key overrides apply
+    // only when the key is newly interned)
+    int32_t get_or_create2(const char* name, int len, uint8_t* created) {
+        bool found;
+        probe(name, len, &found);
+        *created = found ? 0 : 1;
+        return get_or_create(name, len, /*create=*/true, /*pin=*/false,
+                             /*touch_on_hit=*/true);
     }
 };
 
@@ -259,6 +273,67 @@ int32_t str_get_or_create_batch(void* h, const char* data,
                                   /*touch_on_hit=*/true);
     }
     return n;
+}
+
+// ---- param-key extensions (hot-key table: composite keys, counted row
+// pins, created flags — the ParamKeyRegistry analog; see
+// rules/param_flow.py NativeParamKeyRegistry for the key encodings) ----
+
+// batch get_or_create with created flags (concatenated keys like
+// str_get_or_create_batch).
+int32_t str_get_or_create_batch2(void* h, const char* data,
+                                 const int32_t* offsets, int32_t n,
+                                 int32_t* out, uint8_t* created) {
+    Table* t = static_cast<Table*>(h);
+    std::lock_guard<std::mutex> g(t->mu);
+    for (int32_t i = 0; i < n; ++i) {
+        out[i] = t->get_or_create2(data + offsets[i],
+                                   offsets[i + 1] - offsets[i],
+                                   created + i);
+    }
+    return n;
+}
+
+// int-key fast path: each packed key is slot * 2^32 + (value + 2^31)
+// (the vector resolution path's combine-key). The canonical key bytes
+// [slot le4]['i'][value le8] are produced HERE, so Python never encodes
+// per-key — one FFI call per batch of distinct keys.
+int32_t i64_get_or_create_batch(void* h, const int64_t* packed, int32_t n,
+                                int32_t* out, uint8_t* created) {
+    Table* t = static_cast<Table*>(h);
+    std::lock_guard<std::mutex> g(t->mu);
+    char key[13];
+    for (int32_t i = 0; i < n; ++i) {
+        int64_t p = packed[i];
+        int32_t slot = (int32_t)(p >> 32);
+        int64_t value = (int64_t)(p & 0xffffffffll) - (1ll << 31);
+        std::memcpy(key, &slot, 4);
+        key[4] = 'i';
+        std::memcpy(key + 5, &value, 8);
+        out[i] = t->get_or_create2(key, 13, created + i);
+    }
+    return n;
+}
+
+// counted row pins: one increment/decrement per occurrence in rows[]
+// (duplicates intended — the caller passes raw in-flight pair rows).
+void str_pin_rows(void* h, const int32_t* rows, int32_t n) {
+    Table* t = static_cast<Table*>(h);
+    std::lock_guard<std::mutex> g(t->mu);
+    for (int32_t i = 0; i < n; ++i) {
+        int32_t r = rows[i];
+        if (r >= 0 && r < t->capacity) ++t->slots[r].pin_count;
+    }
+}
+
+void str_unpin_rows(void* h, const int32_t* rows, int32_t n) {
+    Table* t = static_cast<Table*>(h);
+    std::lock_guard<std::mutex> g(t->mu);
+    for (int32_t i = 0; i < n; ++i) {
+        int32_t r = rows[i];
+        if (r >= 0 && r < t->capacity && t->slots[r].pin_count > 0)
+            --t->slots[r].pin_count;
+    }
 }
 
 // iterate live (name, id) pairs: copies ids of live slots into out_ids,

@@ -95,6 +95,7 @@ class CompiledDegradeRules(NamedTuple):
     rule_idx: jnp.ndarray            # int32[R, Kd]
     rules: Tuple[DegradeRule, ...]
     num_active: int
+    k_used: int = 1                  # max rules on any one resource
 
 
 def init_breaker_state(nd: int) -> BreakerState:
@@ -150,7 +151,9 @@ def compile_degrade_rules(rules: Sequence[DegradeRule], *, resource_registry,
         ratio_threshold=jnp.asarray(ratio),
     )
     return CompiledDegradeRules(table=table, rule_idx=jnp.asarray(rule_idx),
-                                rules=tuple(valid), num_active=len(valid))
+                                rules=tuple(valid), num_active=len(valid),
+                                k_used=max(1, max(slots_used.values(),
+                                                  default=0)))
 
 
 def degrade_entry_check(

@@ -149,6 +149,9 @@ class CompiledFlowRules(NamedTuple):
     rule_idx: jnp.ndarray           # int32[R, K] → table row, NF = none
     rules: Tuple[FlowRule, ...]     # original objects, index-aligned with table
     num_active: int
+    k_used: int = 1                 # max rules on any ONE resource (the
+    # rule-gather width the device steps actually need — rule_idx slots
+    # are front-packed, so slicing [:, :k_used] loses nothing)
 
 
 def init_flow_dyn(nf: int, buckets: int = 2, rows: int = 1) -> FlowDynState:
@@ -262,7 +265,9 @@ def compile_flow_rules(rules: Sequence[FlowRule], *, resource_registry,
         cluster_mode=jnp.asarray(cluster_mode),
     )
     return CompiledFlowRules(table=table, rule_idx=jnp.asarray(rule_idx),
-                             rules=tuple(valid), num_active=len(valid))
+                             rules=tuple(valid), num_active=len(valid),
+                             k_used=max(1, max(slots_used.values(),
+                                               default=0)))
 
 
 # ---------------------------------------------------------------------------
@@ -665,7 +670,12 @@ def flow_check_scalar(
     # rounding is identical (bit-exact while r*a < 2^24, where the general
     # path's cumsum is itself exact)
 
-    # RATE_LIMITER closed form (cost is per-rule for uniform acquire)
+    # RATE_LIMITER closed form (cost is per-rule for uniform acquire).
+    # All arithmetic stays per-RULE and BOUNDED: the admitted-rank budget
+    # max_k = (now + maxq - base_time) // cost has numerator in
+    # [0, cost + maxq] (due ⇒ base_time = now - cost; else now - L0 <
+    # cost), so no rank*cost product over the unbounded arrival rank can
+    # overflow int32 — a pair passes iff rank < max_k.
     acq_of_rule = jnp.float32(0) + jnp.max(
         jnp.where(valid, acquire, 0)).astype(jnp.float32)    # the uniform a
     count_safe = jnp.maximum(table.count, 1e-9)
@@ -673,6 +683,16 @@ def flow_check_scalar(
     L0 = dyn.latest_passed_ms
     due = (L0 + cost - rel_now_ms) <= 0
     base_time = jnp.where(due, rel_now_ms - cost, L0)
+    maxq_eff = jnp.where(table.count > 0, table.max_queue_ms,
+                         jnp.int32(-1))  # count<=0 RL blocks everything
+    rl_numer = rel_now_ms + maxq_eff - base_time
+    max_k = jnp.maximum(rl_numer // jnp.maximum(cost, 1), 0)
+    # cost == 0 (huge count): every rank shares one wait = max(base-now,0),
+    # matching the general path's uniform-latest case
+    wait0_ok = jnp.maximum(base_time - rel_now_ms, 0) <= maxq_eff
+    max_k = jnp.where(cost > 0, max_k,
+                      jnp.where(wait0_ok, jnp.int32(2 ** 30), 0))
+    max_k = jnp.where(table.count > 0, max_k, 0)
 
     # ---- per-pair work ----
     safe_rows = jnp.minimum(rows, R - 1)
@@ -686,16 +706,14 @@ def flow_check_scalar(
     rank = seg.ranks_by_key(key)                             # int32[BK]
 
     a_bk = jnp.repeat(acquire, K).astype(jnp.float32)
-    # packed per-rule verdict gathers: one int [NF+1, 4] (RL math must stay
+    # packed per-rule verdict gathers: one int [NF+1, 4] (RL math stays
     # int32 — float32 ms arithmetic drifts after ~4.6 h of uptime) and one
     # float [NF+1, 2] for the QPS base/limit
-    maxq_eff = jnp.where(table.count > 0, table.max_queue_ms,
-                         jnp.int32(-1))  # count<=0 RL blocks everything
     vt = jnp.stack([
         is_rl.astype(jnp.int32),                             # 0
         base_time,                                           # 1
         cost,                                                # 2
-        maxq_eff,                                            # 3
+        max_k,                                               # 3
     ], axis=1)
     g = vt[key]                                              # [BK, 4]
     vf = jnp.stack([base, eff_limit], axis=1)
@@ -703,11 +721,15 @@ def flow_check_scalar(
     rankf = rank.astype(jnp.float32)
 
     pass_default = (gf[:, 0] + rankf * a_bk) + a_bk <= gf[:, 1]
-    # RL: latest = base_time + (rank+1)*cost; wait = latest - now (int32,
-    # exact — matches the general path's prefix-sum arithmetic bit for bit)
-    latest_pair = g[:, 1] + (rank + 1) * g[:, 2]
-    wait_pair = jnp.maximum(latest_pair - rel_now_ms, 0)
-    pass_rl = wait_pair <= g[:, 3]
+    # RL: pass iff rank < max_k (the rank-prefix form of
+    # `base_time + (rank+1)*cost - now <= maxQueueing`, exactly the
+    # general path's fixed point for uniform cost — and overflow-free).
+    # wait for PASSING pairs only: (rank+1)*cost is bounded there.
+    pass_rl = rank < g[:, 3]
+    safe_rank = jnp.minimum(rank, g[:, 3])     # blocked lanes: clamp the
+    # product so dead-lane arithmetic can't overflow int32
+    wait_pair = jnp.maximum(
+        g[:, 1] + (safe_rank + 1) * g[:, 2] - rel_now_ms, 0)
     pair_is_rl = g[:, 0] != 0
     pair_pass = jnp.where(pair_is_rl, pass_rl, pass_default)
     pair_pass = pair_pass | (key == NF)
@@ -723,12 +745,6 @@ def flow_check_scalar(
         # array already encodes group sizes (max rank + 1)
         npairs = jnp.zeros((NF + 2,), jnp.int32).at[key].max(
             rank + 1, mode="drop")[:NF + 1]
-        max_k = jnp.where(
-            cost > 0,
-            (rel_now_ms + table.max_queue_ms
-             - base_time) // jnp.maximum(cost, 1),
-            jnp.int32(2 ** 30))
-        max_k = jnp.maximum(max_k, 0)
         passed = jnp.minimum(npairs, max_k)
         passed = jnp.where(is_rl & applies & (table.count > 0), passed, 0)
         new_latest = jnp.where(

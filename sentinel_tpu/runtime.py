@@ -264,6 +264,12 @@ class Sentinel:
         self._mesh_shardings = None      # (state_sh, verdict_sh) when meshed
         cfg = self.cfg
 
+        # Cold-start: persistent XLA compilation cache — the first process
+        # on a machine pays the step compiles, every later process starts
+        # warm (core/compile_cache.py; measured numbers in OPERATIONS.md)
+        from sentinel_tpu.core.compile_cache import enable_persistent_cache
+        enable_persistent_cache(getattr(cfg, "compile_cache_dir", None))
+
         # factories pick the native C++ interning table when buildable
         self.resources = make_resource_registry(cfg.max_resources)
         self.origins = make_origin_registry(cfg.max_origins)
@@ -281,7 +287,7 @@ class Sentinel:
             param_pairs=cfg.param_pairs_per_event,
             occupy_timeout_ms=cfg.occupy_timeout_ms,
         )
-        self.param_key_registry = pf_mod.ParamKeyRegistry(cfg.param_table_slots)
+        self.param_key_registry = pf_mod.make_param_key_registry(cfg.param_table_slots)
         self._user_param_rules: List[pf_mod.ParamFlowRule] = []
         self._gateway_param_rules: List[pf_mod.ParamFlowRule] = []
         # bumped on every param-rule reload: pairs resolved against a stale
@@ -296,7 +302,12 @@ class Sentinel:
         # main row → alt rows it ever hashed to; consulted on row eviction so
         # the recycled row's origin/context stats are cleared too
         self._alt_rows_by_row: dict = {}
-        self._state = init_state(self.spec, cfg.max_flow_rules, cfg.max_degrade_rules)
+        # Eager init measured FASTER than a single fused jitted init on
+        # the tunneled device (2.8 s vs 4.4 s warm: ~30 tiny cached
+        # executables load quicker than one large one) — see
+        # OPERATIONS.md "Cold start" for the full startup decomposition.
+        self._state = init_state(self.spec, cfg.max_flow_rules,
+                                 cfg.max_degrade_rules)
         if mesh is not None:
             from sentinel_tpu.parallel.local_shard import validate_mesh
             validate_mesh(self.spec, mesh)
@@ -330,12 +341,19 @@ class Sentinel:
         # per-second rolled-up block log (LogSlot → EagleEyeLogUtil analog)
         self.block_log = BlockStatLogger(self.clock)
         self.callbacks = StatisticCallbackRegistry()
-        # circuit-breaker transition observers (EventObserverRegistry)
+        # circuit-breaker transition observers (EventObserverRegistry).
+        # Event-driven: every decide/exit step that can move breaker state
+        # carries the [ND] state vector out with its existing readback and
+        # diffs it against ONE shared baseline on the thread that lands the
+        # batch; the metric-timer poll shares the same baseline, so it is a
+        # pure fallback (unread pending verdicts) and never double-fires.
         self._breaker_observers: list = []
-        self._breaker_prev: Optional[List[Tuple[str, int]]] = None
-        # serializes the poll: concurrent diffs against one baseline would
+        # (seq, rules-tuple identity, states list) of the last landed diff
+        self._breaker_live: Optional[Tuple[int, tuple, List[int]]] = None
+        self._breaker_seq = 0            # dispatch order, under self._lock
+        # serializes diffs: concurrent diffs against one baseline would
         # double-fire observers and lose interleaved transitions
-        self._breaker_poll_lock = threading.Lock()
+        self._breaker_event_lock = threading.Lock()
 
         (self._jit_decide, self._jit_decide_prio,
          self._jit_decide_noalt, self._jit_decide_prio_noalt,
@@ -414,15 +432,8 @@ class Sentinel:
         # path's per-pair work for the dominant one-rule-per-resource
         # population. A reload that widens K retraces the step (rare, and
         # amortized by the persistent compilation cache).
-        def used_k(rules, registry):
-            per_row: dict = {}
-            for r in rules:
-                row = registry.get_or_create(r.resource)
-                per_row[row] = per_row.get(row, 0) + 1
-            return max(1, max(per_row.values(), default=1))
-
-        kf = used_k(self._flow.rules, self.resources)
-        kd = used_k(self._deg.rules, self.resources)
+        kf = self._flow.k_used
+        kd = self._deg.k_used
         # Static step flags (jit static args — variants recompile when they
         # flip, steady-state rulesets keep one trace):
         self._scalar_has_rl = any(
@@ -732,7 +743,7 @@ class Sentinel:
             self._ruleset = self._build_ruleset()
             # rule slots changed meaning: fresh key interning + cold key state
             # (ParameterMetricStorage re-initializes metrics per rule)
-            self.param_key_registry = pf_mod.ParamKeyRegistry(cfg.param_table_slots)
+            self.param_key_registry = pf_mod.make_param_key_registry(cfg.param_table_slots)
             self._param_gen += 1
             self._state = self._state._replace(
                 param_dyn=pf_mod.init_param_dyn(self.spec.param_keys))
@@ -1790,12 +1801,25 @@ class Sentinel:
                 self._ruleset, self._state, batch, times, sys_scalars,
                 **flags)
             self._state = state
-        start_host_copy((verdicts.allow, verdicts.reason, verdicts.wait_ms))
+            # breaker observers: ride the existing readback (seq taken
+            # under the dispatch lock so diffs land in dispatch order)
+            brk = None
+            if self._breaker_observers:
+                self._breaker_seq += 1
+                brk = (self._breaker_seq, self._deg.rules,
+                       state.breakers.state)
+        start_host_copy((verdicts.allow, verdicts.reason, verdicts.wait_ms)
+                        + ((brk[2],) if brk else ()))
 
         def _read() -> Verdicts:
-            return Verdicts(allow=np.asarray(verdicts.allow)[:n],
-                            reason=np.asarray(verdicts.reason)[:n],
-                            wait_ms=np.asarray(verdicts.wait_ms)[:n])
+            out = Verdicts(allow=np.asarray(verdicts.allow)[:n],
+                           reason=np.asarray(verdicts.reason)[:n],
+                           wait_ms=np.asarray(verdicts.wait_ms)[:n])
+            if brk is not None:
+                self._diff_and_fire_breakers(
+                    brk[0], brk[1],
+                    [int(s) for s in np.asarray(brk[2][:-1])])
+            return out
 
         return PendingVerdicts(_read)
 
@@ -1839,10 +1863,21 @@ class Sentinel:
                          else self._jit_exit)
             self._state = exit_step(self._ruleset, self._state, batch,
                                     times)
+            # exit feeds resolve probes / trip breakers: with observers
+            # registered, this call pays one small state read so the
+            # observer fires within the exit call that caused the arc
+            brk = None
+            if self._breaker_observers:
+                self._breaker_seq += 1
+                brk = (self._breaker_seq, self._deg.rules,
+                       self._state.breakers.state)
         # unpin only AFTER the device-side decrement is enqueued (entry-side
         # pin discipline: resolve→pin, decide, exit-decrement→unpin)
         if unpin is not None:
             unpin[0].unpin_rows(unpin[1])
+        if brk is not None:
+            self._diff_and_fire_breakers(
+                brk[0], brk[1], [int(s) for s in np.asarray(brk[2][:-1])])
 
     def _drain_evictions_locked(self) -> None:
         ev_keys, overrides = self.param_key_registry.drain_updates()
@@ -2032,40 +2067,68 @@ class Sentinel:
 
     def add_breaker_observer(self, fn) -> None:
         """Register ``fn(resource, prev_state, new_state)`` for circuit-
-        breaker transitions (reference ``EventObserverRegistry``). Ours is
-        poll-driven: call :meth:`check_breaker_transitions` (the metric
-        timer does, every second), so notifications arrive within a tick
-        of the transition instead of synchronously inside the slot."""
+        breaker transitions (reference ``EventObserverRegistry``).
+
+        Event-driven: the observer fires on the thread that lands the
+        entry/exit batch that caused the arc (the state vector rides the
+        batch's existing device→host readback, so registering observers
+        adds no extra round-trips to the decide path; exit batches — which
+        otherwise need no readback — pay one small read while observers
+        are registered). The metric timer's
+        :meth:`check_breaker_transitions` poll remains as a fallback for
+        verdicts nobody materializes, sharing the same baseline so no
+        transition fires twice."""
         with self._lock:
             self._breaker_observers = self._breaker_observers + [fn]
 
+    def _diff_and_fire_breakers(self, seq: int, rules_snap: tuple,
+                                states: List[int]) -> int:
+        """Diff ``states`` (host ints, rule-slot order) against the shared
+        baseline and notify observers → transitions fired. ``seq`` orders
+        snapshots (dispatch order under the engine lock): a stale snapshot
+        landing after a newer one is skipped — its transitions were already
+        visible to the newer diff."""
+        observers = self._breaker_observers
+        to_fire = []
+        with self._breaker_event_lock:
+            prev = self._breaker_live
+            if prev is not None and seq <= prev[0]:
+                return 0
+            self._breaker_live = (seq, rules_snap, states)
+            # a rules reload re-pairs slots with new rules: new baseline
+            if prev is None or prev[1] is not rules_snap:
+                return 0
+            if observers:
+                for j, r in enumerate(rules_snap):
+                    if j < len(prev[2]) and j < len(states) \
+                            and prev[2][j] != states[j]:
+                        to_fire.append((r.resource, prev[2][j], states[j]))
+            fired = len(to_fire)
+            for res, old, new in to_fire:
+                for fn in observers:
+                    try:
+                        fn(res, old, new)
+                    except Exception as exc:
+                        from sentinel_tpu.core.logs import record_log
+                        record_log().warning(
+                            "breaker observer failed: %r", exc)
+        return fired
+
     def check_breaker_transitions(self) -> int:
-        """Diff breaker states against the previous check and notify
-        observers → number of transitions seen. Rule reloads reset the
-        baseline (slots re-pair with new rules)."""
+        """Poll fallback: snapshot current breaker states and run them
+        through the shared diff → number of transitions seen. With the
+        event path active this only catches arcs whose batch verdicts
+        were never materialized; rule reloads reset the baseline."""
         with self._lock:
             observers = self._breaker_observers
-        if not observers:
-            return 0
-        with self._breaker_poll_lock:
-            current = self.breaker_resources()
-            prev = self._breaker_prev
-            self._breaker_prev = current
-            if (prev is None
-                    or [r for r, _s in prev] != [r for r, _s in current]):
+            if not observers:
                 return 0
-            fired = 0
-            for (res, old), (_res, new) in zip(prev, current):
-                if old != new:
-                    fired += 1
-                    for fn in observers:
-                        try:
-                            fn(res, old, new)
-                        except Exception as exc:
-                            from sentinel_tpu.core.logs import record_log
-                            record_log().warning(
-                                "breaker observer failed: %r", exc)
-            return fired
+            self._breaker_seq += 1
+            seq = self._breaker_seq
+            rules_snap = self._deg.rules
+            states_dev = self._state.breakers.state
+        states = [int(s) for s in np.asarray(states_dev[:-1])]
+        return self._diff_and_fire_breakers(seq, rules_snap, states)
 
     def breaker_resources(self) -> List[Tuple[str, int]]:
         """(resource, state) per loaded degrade rule, rule-slot order

@@ -24,7 +24,7 @@ from sentinel_tpu.transport.http_server import SimpleHttpCommandCenter
 @dataclasses.dataclass
 class TransportRuntime:
     center: CommandCenter
-    http: SimpleHttpCommandCenter
+    http: object            # SimpleHttpCommandCenter | AsyncHttpCommandCenter
     heartbeat: Optional[HeartbeatSender]
     cluster_state: ClusterModeState
     port: int
@@ -44,7 +44,7 @@ def start_transport(sentinel, *, host: str = "0.0.0.0", port: int = 8719,
                     heartbeat_interval_ms: int = 10_000,
                     metric_log: bool = True,
                     gateway_manager=None, api_definition_manager=None,
-                    clock=None) -> TransportRuntime:
+                    clock=None, async_server: bool = False) -> TransportRuntime:
     """Start the HTTP command center (with port auto-increment) and, when a
     dashboard address is given, a heartbeat loop advertising the port that
     was actually bound.
@@ -74,7 +74,15 @@ def start_transport(sentinel, *, host: str = "0.0.0.0", port: int = 8719,
         extra_info=extra, writable_registry=writable_registry,
         gateway_manager=gateway_manager,
         api_definition_manager=api_definition_manager)
-    http = SimpleHttpCommandCenter(center, host=host, port=port)
+    if async_server:
+        # nonblocking variant (NettyHttpCommandCenter analog): one event
+        # loop, slow-loris-bounded — transport/async_http_server.py
+        from sentinel_tpu.transport.async_http_server import (
+            AsyncHttpCommandCenter,
+        )
+        http = AsyncHttpCommandCenter(center, host=host, port=port)
+    else:
+        http = SimpleHttpCommandCenter(center, host=host, port=port)
     bound = http.start()
     extra["apiPort"] = bound          # basicInfo reflects the bound port
 

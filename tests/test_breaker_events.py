@@ -1,0 +1,98 @@
+"""Event-driven breaker observers (reference ``EventObserverRegistry``,
+``AbstractCircuitBreaker`` notifying at the transition): observers fire on
+the thread that lands the entry/exit batch causing the arc, with zero
+missed transitions under rapid OPEN→HALF_OPEN→{CLOSED,OPEN} oscillation —
+the chain of observed (old, new) pairs must be gapless."""
+
+import numpy as np
+import pytest
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.rules.degrade import (
+    STATE_CLOSED, STATE_HALF_OPEN, STATE_OPEN,
+)
+
+
+@pytest.fixture
+def clk():
+    return ManualClock(start_ms=1_785_000_000_000)
+
+
+def make_sentinel(clock, **cfg_over):
+    cfg = stpu.load_config(max_resources=64, max_origins=32,
+                           max_flow_rules=16, max_degrade_rules=16,
+                           max_authority_rules=16, host_fast_path=False,
+                           **cfg_over)
+    return stpu.Sentinel(config=cfg, clock=clock)
+
+
+def test_rapid_oscillation_zero_missed_transitions(clk):
+    sph = make_sentinel(clk)
+    sph.load_degrade_rules([stpu.DegradeRule(
+        resource="osc", grade=stpu.GRADE_EXCEPTION_COUNT, count=1,
+        time_window=1, min_request_amount=1)])
+    seen = []
+    sph.add_breaker_observer(lambda res, old, new: seen.append((old, new)))
+
+    def call(fail):
+        try:
+            e = sph.entry("osc")
+        except stpu.BlockException:
+            return False
+        if fail:
+            e.trace(RuntimeError("x"))
+        e.exit()
+        return True
+
+    # trip: one error >= count=1 → CLOSED->OPEN within this exit call
+    assert call(fail=True)
+    assert seen[-1] == (STATE_CLOSED, STATE_OPEN)
+
+    # rapid probe cycles: OPEN -> HALF_OPEN (entry) -> OPEN or CLOSED
+    # (exit), many times, alternating probe outcomes
+    for i in range(6):
+        clk.advance_ms(1100)            # retry window elapses
+        before = len(seen)
+        ok = call(fail=(i % 2 == 0))    # even cycles: probe fails
+        assert ok, f"probe {i} was not admitted"
+        arcs = seen[before:]
+        # entry fired OPEN->HALF_OPEN, exit fired the resolution — both
+        # within the calls that caused them, none missed
+        if i % 2 == 0:
+            assert arcs == [(STATE_OPEN, STATE_HALF_OPEN),
+                            (STATE_HALF_OPEN, STATE_OPEN)], (i, arcs)
+        else:
+            assert arcs == [(STATE_OPEN, STATE_HALF_OPEN),
+                            (STATE_HALF_OPEN, STATE_CLOSED)], (i, arcs)
+            # closed: trip it again for the next cycle
+            before2 = len(seen)
+            assert call(fail=True)
+            assert seen[before2:] == [(STATE_CLOSED, STATE_OPEN)]
+
+    # the full chain is gapless: each transition starts where the
+    # previous ended
+    for (o1, n1), (o2, n2) in zip(seen, seen[1:]):
+        assert n1 == o2, f"missed transition between {n1} and {o2}"
+    # and the poll fallback has nothing left (shared baseline)
+    assert sph.check_breaker_transitions() == 0
+
+
+def test_observer_errors_do_not_break_the_pipeline(clk):
+    sph = make_sentinel(clk)
+    sph.load_degrade_rules([stpu.DegradeRule(
+        resource="r", grade=stpu.GRADE_EXCEPTION_COUNT, count=1,
+        time_window=1, min_request_amount=1)])
+    calls = []
+    sph.add_breaker_observer(
+        lambda *a: (_ for _ in ()).throw(RuntimeError("observer boom")))
+    sph.add_breaker_observer(lambda res, old, new: calls.append(new))
+    e = sph.entry("r")
+    e.trace(RuntimeError("x"))
+    e.exit()                            # trips; first observer raises
+    assert calls == [STATE_OPEN]        # second observer still notified
+    # pipeline still functional
+    try:
+        sph.entry("r").exit()
+    except stpu.BlockException:
+        pass

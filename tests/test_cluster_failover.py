@@ -1,0 +1,163 @@
+"""Kill-the-server failover e2e (VERDICT r3 #5): a REAL standalone token
+server in a child process, a real socket client installed as the engine's
+token service, live traffic — then SIGKILL the server and assert the
+reference's composite behavior (``NettyTransportClient.java:60-130``
+reconnect loop + ``FlowRuleChecker.java:184-193`` fallbackToLocal):
+
+1. server up → global count enforced by the server;
+2. SIGKILL → per-rule fallback-to-local verdicts continue (local count);
+3. restart on the same port → auto-reconnect within ~2x the 2 s loop,
+   namespace re-registered (the reconnect PING), grants resume;
+4. local counters stay sane throughout.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.cluster.client import ClusterTokenClient
+from sentinel_tpu.parallel.cluster import STATUS_BLOCKED, STATUS_FAIL, STATUS_OK
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+T0 = 1_785_000_000_000
+
+SERVER_CHILD = """
+import sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+from sentinel_tpu.parallel.cluster import (
+    ClusterEngine, ClusterFlowRule, ClusterSpec, THRESHOLD_GLOBAL,
+)
+from sentinel_tpu.cluster.server import ClusterTokenServer
+
+port = int(sys.argv[1])
+spec = ClusterSpec(n_shards=8, flows_per_shard=8, namespaces=4)
+eng = ClusterEngine(spec)
+eng.load_rules("fo-ns", [ClusterFlowRule(
+    flow_id=42, count=4.0, threshold_type=THRESHOLD_GLOBAL)])
+eng.request_tokens([42], [1], now_ms=0)   # warm the jit BEFORE serving
+srv = ClusterTokenServer(eng, host="127.0.0.1", port=port)
+srv.start()
+print("READY", srv.port, flush=True)
+while True:
+    time.sleep(1)
+"""
+
+
+def _spawn_server(port: int) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (env.get("PYTHONPATH", ""), REPO) if p)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", SERVER_CHILD, str(port)],
+        stdout=subprocess.PIPE, text=True, env=env, cwd=REPO)
+    deadline = time.time() + 120
+    line = ""
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("READY"):
+            return proc
+        if proc.poll() is not None:
+            break
+    proc.kill()
+    raise RuntimeError(f"server child did not become ready: {line!r}")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_kill_reconnect_fallback_recover():
+    port = _free_port()
+    proc = _spawn_server(port)
+    client = None
+    try:
+        client = ClusterTokenClient(
+            "127.0.0.1", port, namespace="fo-ns",
+            request_timeout_ms=30_000, auto_reconnect=True)
+        client.start()
+        assert client.connected
+
+        # ---- phase A: server enforces the GLOBAL count (4/window) ----
+        statuses = [client.request_token(42, 1).status for _ in range(12)]
+        assert STATUS_OK in statuses
+        # 12 rapid requests span at most 2 server windows of 4
+        assert statuses.count(STATUS_BLOCKED) >= 4, statuses
+        assert STATUS_FAIL not in statuses
+
+        # engine wiring: cluster rule delegates to this client
+        clk = ManualClock(start_ms=T0)
+        cfg = stpu.load_config(max_resources=64, max_flow_rules=16,
+                               max_degrade_rules=16,
+                               max_authority_rules=16,
+                               host_fast_path=False)
+        sph = stpu.Sentinel(config=cfg, clock=clk)
+        sph.set_token_service(client)
+        sph.load_flow_rules([stpu.FlowRule(
+            resource="csvc", count=2.0, cluster_mode=True,
+            cluster_flow_id=42, cluster_fallback_to_local=True)])
+
+        # ---- phase B: SIGKILL the server mid-traffic ----
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+        # client notices the drop; requests fail fast
+        deadline = time.time() + 10
+        while client.connected and time.time() < deadline:
+            client.request_token(42, 1)
+            time.sleep(0.05)
+        assert not client.connected
+        assert client.request_token(42, 1).status == STATUS_FAIL
+
+        # per-rule fallback-to-local: the LOCAL count=2 now governs, and
+        # verdicts keep flowing (ManualClock pins one local window)
+        res = []
+        for _ in range(5):
+            try:
+                with sph.entry("csvc"):
+                    res.append("pass")
+            except stpu.BlockException:
+                res.append("block")
+        assert res == ["pass", "pass", "block", "block", "block"]
+        tot = sph.node_totals("csvc")
+        assert tot["pass"] == 2 and tot["block"] == 3   # counters sane
+
+        # ---- phase C: restart on the same port → auto-reconnect ----
+        proc = _spawn_server(port)
+        deadline = time.time() + 8      # ~2x the 2 s reconnect loop
+        while not client.connected and time.time() < deadline:
+            time.sleep(0.1)
+        assert client.connected, "client did not auto-reconnect"
+        # namespace was re-registered by the reconnect PING: grants
+        # resume and the GLOBAL count governs again
+        statuses = [client.request_token(42, 1).status for _ in range(12)]
+        assert statuses.count(STATUS_OK) >= 4, statuses
+        assert statuses.count(STATUS_BLOCKED) >= 4, statuses
+        assert STATUS_FAIL not in statuses
+        # end-to-end through the engine too: the 12 probe requests above
+        # exhausted the server's CURRENT real-time window, so let it
+        # rotate — a fresh window grants all 3 (cluster OK overrides the
+        # local count=2, proving tokens come from the server again)
+        time.sleep(1.2)
+        clk.advance_ms(1000)            # fresh local window as well
+        passed = blocked = 0
+        for _ in range(3):
+            try:
+                with sph.entry("csvc"):
+                    passed += 1
+            except stpu.BlockException:
+                blocked += 1
+        assert (passed, blocked) == (3, 0)
+    finally:
+        if client is not None:
+            client.stop()
+        if proc.poll() is None:
+            proc.kill()

@@ -1,0 +1,144 @@
+"""Native param-key table parity: NativeParamKeyRegistry must match the
+Python ParamKeyRegistry row-for-row across intern/LRU-evict/pin/override
+sequences (both assign rows in the same order, so full trace equality is
+assertable, not just behavioral equivalence)."""
+
+import numpy as np
+import pytest
+
+from sentinel_tpu.rules.param_flow import (
+    NativeParamKeyRegistry, ParamKeyRegistry,
+)
+
+try:
+    from sentinel_tpu.native import native_available
+    HAVE_NATIVE = native_available()
+except Exception:
+    HAVE_NATIVE = False
+
+pytestmark = pytest.mark.skipif(not HAVE_NATIVE,
+                                reason="native library unavailable")
+
+
+def pair():
+    return ParamKeyRegistry(8), NativeParamKeyRegistry(8)
+
+
+def test_row_assignment_and_hits_match():
+    py, nt = pair()
+    for reg in (py, nt):
+        assert reg.get_or_create(0, "a") == 0
+        assert reg.get_or_create(0, "b") == 1
+        assert reg.get_or_create(1, "a") == 2     # slot is part of the key
+        assert reg.get_or_create(0, "a") == 0     # hit
+        assert reg.get_or_create(0, 42) == 3
+        assert reg.get_or_create(0, 42) == 3
+        # dict-equality canonicalization: True == 1, 1.0 == 1
+        r1 = reg.get_or_create(2, 1)
+        assert reg.get_or_create(2, True) == r1
+        assert reg.get_or_create(2, 1.0) == r1
+        assert len(reg) == 5
+
+
+def test_lru_eviction_order_and_drain_match():
+    py, nt = pair()
+    traces = []
+    for reg in (py, nt):
+        rows = [reg.get_or_create(0, i) for i in range(8)]   # full
+        reg.get_or_create(0, 3)                  # touch → 3 becomes MRU
+        r_new = reg.get_or_create(0, 100)        # evicts LRU (key 0)
+        ev, ov = reg.drain_updates()
+        traces.append((rows, r_new, ev, ov))
+        # the evicted key re-interns on a fresh row (evicting key 1 next)
+        traces.append(reg.get_or_create(0, 0))
+    assert traces[0] == traces[2]
+    assert traces[1] == traces[3]
+
+
+def test_pins_block_eviction_and_unpin_releases():
+    py, nt = pair()
+    for reg in (py, nt):
+        rows = [reg.get_or_create(0, i) for i in range(8)]
+        reg.pin_rows(np.asarray(rows[:7], np.int32))
+        # only row 7 is evictable: three new keys recycle it round-robin
+        a = reg.get_or_create(0, 100)
+        b = reg.get_or_create(0, 101)
+        assert a == rows[7] and b == a
+        # everything pinned → intern of a new key raises
+        reg.pin_rows(np.asarray([b], np.int32))
+        with pytest.raises(RuntimeError):
+            reg.get_or_create(0, 102)
+        reg.unpin_rows(np.asarray([b], np.int32))
+        assert reg.get_or_create(0, 103) == b    # evictable again
+        # counted pins: double-pin needs double-unpin
+        reg.pin_rows(np.asarray([rows[0], rows[0]], np.int32))
+        reg.unpin_rows(np.asarray([rows[0]], np.int32))
+        # rows[0] still pinned (original pin + one residual count)
+
+
+def test_override_on_create_and_cancel_on_evict():
+    py, nt = pair()
+    traces = []
+    for reg in (py, nt):
+        r = reg.get_or_create(0, "k", override=7)
+        reg.get_or_create(0, "k", override=9)    # hit: no new override
+        ev, ov = reg.drain_updates()
+        traces.append((r, ev, ov))
+        # fill the table so "k" is evicted WITH a queued override pending
+        r2 = reg.get_or_create(0, "k2", override=5)
+        for i in range(8):
+            reg.get_or_create(1, i)
+        ev, ov = reg.drain_updates()
+        # k2's override must have been cancelled when its row recycled
+        traces.append((r2, sorted(ev), ov))
+    assert traces[0] == traces[2]
+    assert traces[1] == traces[3]
+
+
+def test_int_batch_fast_path_matches_scalar_form():
+    py, nt = pair()
+    slots = np.array([0, 0, 1, 0], np.int64)
+    vals = np.array([5, -3, 5, 7], np.int64)
+    packed = slots * (2 ** 32) + (vals + 2 ** 31)
+    nat_rows = nt.get_or_create_int_batch(packed)
+    py_rows = [py.get_or_create(int(s), int(v))
+               for s, v in zip(slots, vals)]
+    assert nat_rows.tolist() == py_rows
+    # and the scalar path agrees with the packed path on the native table
+    assert [nt.get_or_create(int(s), int(v))
+            for s, v in zip(slots, vals)] == nat_rows.tolist()
+
+
+def test_randomized_trace_parity():
+    rng = np.random.default_rng(11)
+    py, nt = ParamKeyRegistry(16), NativeParamKeyRegistry(16)
+    pinned: list = []
+    for step in range(400):
+        op = rng.integers(0, 10)
+        if op < 6:
+            slot = int(rng.integers(0, 3))
+            v = (int(rng.integers(0, 30)) if rng.random() < 0.7
+                 else f"s{int(rng.integers(0, 20))}")
+            ov = int(rng.integers(1, 50)) if rng.random() < 0.1 else None
+            assert (py.get_or_create(slot, v, override=ov)
+                    == nt.get_or_create(slot, v, override=ov)), step
+        elif op < 7:
+            items = [(int(rng.integers(0, 3)), int(rng.integers(0, 30)),
+                      None) for _ in range(int(rng.integers(1, 8)))]
+            assert py.get_or_create_batch(items) \
+                == nt.get_or_create_batch(items), step
+        elif op < 8 and len(py) > 2:
+            rows = np.asarray(
+                rng.integers(0, 16, int(rng.integers(1, 4))), np.int32)
+            py.pin_rows(rows)
+            nt.pin_rows(rows)
+            pinned.append(rows)
+        elif op < 9 and pinned:
+            rows = pinned.pop()
+            py.unpin_rows(rows)
+            nt.unpin_rows(rows)
+        else:
+            ev_p, ov_p = py.drain_updates()
+            ev_n, ov_n = nt.drain_updates()
+            assert ev_p == ev_n and ov_p == ov_n, step
+    assert len(py) == len(nt)

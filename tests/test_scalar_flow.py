@@ -269,3 +269,38 @@ def test_raw_api_origin_ids_without_rows_take_general_path(clk):
         prioritized=np.zeros(n, np.bool_))
     # count=0 + matching origin id → the rule applies and blocks everything
     assert not v.allow.any()
+
+
+def test_scalar_rate_limiter_no_int32_overflow_on_high_ranks(clk):
+    """A low-rate RL rule (cost 100000 ms) in a large batch: arrival ranks
+    push rank*cost far past 2^31 — the closed form must stay bounded and
+    admit exactly the queueable prefix (1 event here), not wrap negative
+    and admit everything. Review finding r4-2."""
+    sph = make_sentinel(clk, host_fast_path=False)
+    sph.load_flow_rules([stpu.FlowRule(
+        resource="slowpace", count=0.01,
+        control_behavior=stpu.BEHAVIOR_RATE_LIMITER,
+        max_queueing_time_ms=500)])
+    spec = sph.spec
+    n = 1 << 15                       # ranks to 32767; *cost = 3.3e9 > 2^31
+    row = sph.resources.get_or_create("slowpace")
+    b = EntryBatch(
+        rows=jnp.full(n, row, jnp.int32),
+        origin_ids=jnp.zeros(n, jnp.int32),
+        origin_rows=jnp.full(n, spec.alt_rows, jnp.int32),
+        context_ids=jnp.zeros(n, jnp.int32),
+        chain_rows=jnp.full(n, spec.alt_rows, jnp.int32),
+        acquire=jnp.ones(n, jnp.int32),
+        is_in=jnp.ones(n, jnp.bool_),
+        prioritized=jnp.zeros(n, jnp.bool_),
+        valid=jnp.ones(n, jnp.bool_))
+    sca = jax.jit(functools.partial(
+        decide_entries, spec, enable_occupy=False, record_alt=False,
+        scalar_flow=True, scalar_has_rl=True))
+    times = sph._time_scalars(clk.now_ms())
+    sysv = jnp.asarray(np.array([0.1, 0.1], np.float32))
+    _s, v = sca(sph._ruleset, sph._state, b, times, sysv)
+    allow = np.asarray(v.allow)
+    # cost=100000 > maxQueueing=500: only the immediate event is admitted
+    assert int(allow.sum()) == 1 and bool(allow[0])
+    assert int(np.asarray(v.wait_ms)[0]) == 0
