@@ -108,6 +108,8 @@ def load_native():
             fn.restype = None
             fn.argtypes = [ctypes.c_void_p,
                            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
+        lib.str_pin_total.restype = ctypes.c_int64
+        lib.str_pin_total.argtypes = [ctypes.c_void_p]
         _lib_handle = lib
         return lib
 

@@ -315,6 +315,15 @@ int32_t i64_get_or_create_batch(void* h, const int64_t* packed, int32_t n,
     return n;
 }
 
+// total live counted row pins (observability / test introspection)
+int64_t str_pin_total(void* h) {
+    Table* t = static_cast<Table*>(h);
+    std::lock_guard<std::mutex> g(t->mu);
+    int64_t n = 0;
+    for (const auto& e : t->slots) n += e.pin_count;
+    return n;
+}
+
 // counted row pins: one increment/decrement per occurrence in rows[]
 // (duplicates intended — the caller passes raw in-flight pair rows).
 void str_pin_rows(void* h, const int32_t* rows, int32_t n) {

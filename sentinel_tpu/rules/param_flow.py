@@ -348,6 +348,11 @@ class ParamKeyRegistry:
             self._evicted, self._pending_override = [], []
             return ev_, ov
 
+    def live_pin_count(self) -> int:
+        """Total counted pins held by in-flight entries (observability)."""
+        with self._lock:
+            return sum(self._pins.values())
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._map)
@@ -550,6 +555,10 @@ class NativeParamKeyRegistry:
             ev_, ov = self._evicted, self._pending_override
             self._evicted, self._pending_override = [], []
             return ev_, ov
+
+    def live_pin_count(self) -> int:
+        """Total counted pins held by in-flight entries (observability)."""
+        return int(self._lib.str_pin_total(self._h))
 
     def __len__(self) -> int:
         return int(self._lib.str_len(self._h))

@@ -63,7 +63,7 @@ def test_nowait_releases_blocked_param_pins(clk):
     # THREAD grade count=1: one admitted, two blocked; blocked pins freed
     assert int(np.sum(v.allow)) == 1
     reg = sph.param_key_registry
-    assert sum(reg._pins.values()) == 1      # only the live entry's pin
+    assert reg.live_pin_count() == 1         # only the live entry's pin
 
 
 @dataclasses.dataclass

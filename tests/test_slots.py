@@ -215,7 +215,7 @@ def test_gate_raising_block_exception_denies_event_in_batch(clk):
     assert list(np.asarray(v.allow)) == [True, False, True]
     # no pins leaked: the registry has no live pin refcounts (QPS-grade
     # rules never pin; a leak would show as stale entries here)
-    assert sph.param_key_registry._pins == {}
+    assert sph.param_key_registry.live_pin_count() == 0
 
 
 def test_slot_registration_caps_are_enforced(clk):
