@@ -82,6 +82,14 @@ def main() -> None:
         auth_table=auth.table, auth_idx=auth.rule_idx,
         sys_thresholds=sys_mod.compile_system_rules([]),
         param_table=param.table)
+    if os.environ.get("SCALAR_DETAIL"):
+        # match the runtime's used-slot slicing AND joint rule gather —
+        # the exact ruleset shape bench.py/runtime ship
+        fi = compiled.rule_idx[:, :compiled.k_used]
+        di = deg.rule_idx[:, :deg.k_used]
+        ruleset = ruleset._replace(
+            flow_idx=fi, deg_idx=di,
+            joint_idx=jnp.concatenate([fi, di], axis=1))
 
     rng = np.random.default_rng(42)
     hot = rng.integers(1, NRULES, B // 4)
@@ -115,7 +123,7 @@ def main() -> None:
         return (dyn, jnp.ones(shape, jnp.bool_),
                 jnp.zeros(shape, jnp.int32), jnp.zeros(shape, jnp.bool_))
 
-    def stub_degrade_entry(table, st, rule_idx, rows, valid, rel_now_ms):
+    def stub_degrade_entry(table, st, rule_idx, rows, valid, rel_now_ms, **kw):
         return st, jnp.ones(rows.shape, jnp.bool_)
 
     def stub_auth(table, rule_idx, rows, origin_ids, valid):
@@ -178,6 +186,11 @@ def main() -> None:
             "entryrow": (pl, "add_one_row", stub_add_one_row),
             "sort": (seg_mod, "sort_by_keys", stub_sort_by_keys),
             "unsort": (seg_mod, "unsort", stub_unsort),
+            "ranks": (seg_mod, "ranks_by_key", stub_ranks),
+            "flowscalar": (pl.flow_mod, "flow_check_scalar",
+                           stub_flow_scalar),
+            "degscalar": (pl.deg_mod, "degrade_entry_check_scalar",
+                          stub_degrade_scalar),
             "winsum": (pl.flow_mod, "window_sum_rows", stub_winsum),
             "warmup": (pl.flow_mod, "_warmup_sync_and_limits",
                        stub_warmup),
@@ -195,14 +208,30 @@ def main() -> None:
                 mod, attr, _ = targets[name]
                 setattr(mod, attr, orig)
 
+    # ---- scalar-path stubs (SCALAR_DETAIL=1) ----
+    def stub_ranks(key):
+        return jnp.zeros_like(key)
+
+    def stub_flow_scalar(table, dyn, rule_idx, wspec, main_second,
+                         main_threads, rows, acquire, valid, now_idx_s,
+                         rel_now_ms, **kw):
+        return (dyn, jnp.ones(rows.shape, jnp.bool_),
+                jnp.zeros(rows.shape, jnp.int32))
+
+    def stub_degrade_scalar(table, st, rule_idx, rows, valid, rel_now_ms, **kw):
+        return st, jnp.ones(rows.shape, jnp.bool_)
+
     results = {}
 
     def run(name, *stub_names, n=STEPS):
         state = init_state(spec, NRULES, max(len(deg_rules), 1))
+        scalar = bool(os.environ.get("SCALAR_DETAIL"))
+        kw = (dict(scalar_flow=True, scalar_has_rl=False, skip_auth=True,
+                   skip_sys=True) if scalar else {})
         with patched(**{s: True for s in stub_names}):
             step = jax.jit(functools.partial(
                 pl.decide_entries, spec, enable_occupy=False,
-                record_alt=False), donate_argnums=(1,))
+                record_alt=False, **kw), donate_argnums=(1,))
             state, v = step(ruleset, state, batch, times_for(0),
                             sys_scalars)   # trace+compile inside the patch
         _ = np.asarray(v.allow[:1])        # honest gate (idempotent)
@@ -218,7 +247,15 @@ def main() -> None:
 
     print(f"ablate: R={R} B={B} NF={NRULES} steps={STEPS} "
           f"on {jax.devices()[0]}")
-    if os.environ.get("FLOW_DETAIL"):
+    if os.environ.get("SCALAR_DETAIL"):
+        run("FULL")
+        run("-ranks", "ranks")
+        run("-flowscalar", "flowscalar")
+        run("-degscalar", "degscalar")
+        run("-recording", "refresh", "scatter", "entryrow")
+        run("-all (floor)", "flowscalar", "degscalar", "refresh",
+            "scatter", "entryrow")
+    elif os.environ.get("FLOW_DETAIL"):
         run("FULL")
         run("-sorts", "sort")
         run("-unsorts", "unsort")

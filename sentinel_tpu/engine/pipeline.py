@@ -88,6 +88,11 @@ class RuleSet(NamedTuple):
     auth_idx: jnp.ndarray
     sys_thresholds: sys_mod.SystemThresholds
     param_table: pf_mod.ParamRuleTable
+    # concat(flow_idx, deg_idx) [R, Kf+Kd] — the scalar path gathers BOTH
+    # slots' rule ids in ONE pass over the big row table (a 512k random
+    # gather from a [1M]-row table costs ~6 ms on the v5 chip; two of
+    # them were ~25% of the scalar step). None = gather separately.
+    joint_idx: Optional[jnp.ndarray] = None
 
 
 class EntryBatch(NamedTuple):
@@ -252,6 +257,18 @@ def decide_entries(
         param_wait = jnp.zeros(live2.shape, jnp.int32)
 
     if scalar_flow:
+        flow_bk = deg_bk = None
+        if rules.joint_idx is not None:
+            # ONE random gather over the [R, Kf+Kd] joint table feeds both
+            # slots (see RuleSet.joint_idx)
+            from sentinel_tpu.ops.segments import padded_table_gather
+            Kf = rules.flow_idx.shape[1]
+            NFs = rules.flow_table.active.shape[0] - 1
+            NDs = rules.deg_table.active.shape[0] - 1
+            joint = padded_table_gather(rules.joint_idx, batch.rows, 0)
+            in_r = (batch.rows < R)[:, None]
+            flow_bk = jnp.where(in_r, joint[:, :Kf], NFs)
+            deg_bk = jnp.where(in_r, joint[:, Kf:], NDs)
         flow_dyn, flow_ok, wait_ms = flow_mod.flow_check_scalar(
             rules.flow_table, state.flow_dyn, rules.flow_idx, spec.second,
             state.second, state.threads, batch.rows, batch.acquire, live2,
@@ -259,12 +276,13 @@ def decide_entries(
             minute_spec=spec.minute,
             main_minute=state.minute if spec.minute else None,
             now_idx_m=now_idx_m,
-            has_rate_limiter=scalar_has_rl)
+            has_rate_limiter=scalar_has_rl,
+            rules_bk=flow_bk)
         occupied = jnp.zeros_like(flow_ok)
         live3 = live2 & flow_ok
         breakers, deg_ok = deg_mod.degrade_entry_check_scalar(
             rules.deg_table, state.breakers, rules.deg_idx, batch.rows,
-            live3, rel_now_ms)
+            live3, rel_now_ms, rules_bk=deg_bk)
     else:
         cl_fb = (batch.cluster_fallback if batch.cluster_fallback is not None
                  else jnp.zeros(batch.valid.shape, jnp.int32))

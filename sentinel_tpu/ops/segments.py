@@ -114,6 +114,17 @@ def ranks_by_key(key: jnp.ndarray) -> jnp.ndarray:
     return jnp.zeros((n,), jnp.int32).at[order].set(rank_s)
 
 
+def padded_table_gather(idx_table: jnp.ndarray, rows: jnp.ndarray,
+                        sentinel) -> jnp.ndarray:
+    """Gather ``idx_table[rows]`` ([R, K] → [B, K]) where out-of-range
+    rows (>= R: batch padding) yield ``sentinel``. The ONE canonical
+    clamp-and-sentinel idiom shared by the pipeline's joint rule gather
+    and the flow/degrade fallback gathers — keep them in lockstep."""
+    R = idx_table.shape[0]
+    safe_rows = jnp.minimum(rows, R - 1)
+    return jnp.where((rows < R)[:, None], idx_table[safe_rows], sentinel)
+
+
 def first_index_by_key(key: jnp.ndarray, num_keys: int) -> jnp.ndarray:
     """Index of each key group's FIRST element (batch order) → int32
     [num_keys], filled with ``n`` for absent keys.

@@ -24,7 +24,7 @@ winner analog).
 from __future__ import annotations
 
 import dataclasses
-from typing import NamedTuple, Sequence, Tuple
+from typing import NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
@@ -211,6 +211,8 @@ def degrade_entry_check(
 def degrade_entry_check_scalar(
     table: DegradeRuleTable, st: BreakerState, rule_idx: jnp.ndarray,
     rows: jnp.ndarray, valid: jnp.ndarray, rel_now_ms: jnp.ndarray,
+    rules_bk: Optional[jnp.ndarray] = None,   # pre-gathered [B, Kd] rule
+    # ids (the pipeline's joint flow+degrade gather); None = gather here
 ) -> Tuple[BreakerState, jnp.ndarray]:
     """Sort-free :func:`degrade_entry_check` → (state', allow bool[B]).
 
@@ -230,10 +232,14 @@ def degrade_entry_check_scalar(
     R = rule_idx.shape[0]
     BK = B * Kd
 
-    safe_rows = jnp.minimum(rows, R - 1)
-    rules_bk = jnp.where((rows < R)[:, None], rule_idx[safe_rows], ND)
+    if rules_bk is None:
+        rules_bk = seg.padded_table_gather(rule_idx, rows, ND)
     rj = rules_bk.reshape(-1)
-    valid_bk = jnp.repeat(valid, Kd) & table.active[rj]
+    # no active[rj] gather: an INACTIVE rule is structurally CLOSED (its
+    # state never leaves CLOSED — trip and probe both require active), so
+    # its pairs pass via pass_rule and can never win a probe; only event
+    # VALIDITY must exclude pairs from probe election
+    valid_bk = jnp.repeat(valid, Kd)
     key = jnp.where(valid_bk, rj, ND)
 
     open_due = ((st.state == STATE_OPEN)
@@ -241,17 +247,20 @@ def degrade_entry_check_scalar(
                 & table.active)
     pass_rule = (st.state == STATE_CLOSED) | ~table.active
     pass_rule = pass_rule.at[ND].set(True)       # sentinel never blocks
+    # the base verdict is needed by BOTH cond branches: hoisting it keeps
+    # the common no-probe branch a pure pass-through. (Measured: running
+    # the election UNCONDITIONALLY costs ~6 ms/step more than this cond —
+    # the [B]→[ND] scatter-min is the expensive part, not the branch.)
+    pair_base = pass_rule[key]
 
     def _no_probe(_):
-        pair_pass = pass_rule[key]
-        allow_ev = jnp.all(pair_pass.reshape(B, Kd), axis=1)
-        return st.state, allow_ev
+        return st.state, jnp.all(pair_base.reshape(B, Kd), axis=1)
 
     def _probe(_):
         idx = jnp.arange(BK, dtype=jnp.int32)
         win = seg.first_index_by_key(key, ND + 1)
         winner_pair = (idx == win[key]) & open_due[key]
-        pair_pass = pass_rule[key] | winner_pair
+        pair_pass = pair_base | winner_pair
         allow_ev = jnp.all(pair_pass.reshape(B, Kd), axis=1)
         # OPEN→HALF_OPEN only when the probe's event is admitted by ALL
         # breakers of its resource (general-path comment at

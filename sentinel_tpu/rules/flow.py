@@ -41,6 +41,7 @@ from typing import List, NamedTuple, Optional, Sequence, Tuple
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from sentinel_tpu.ops import segments as seg
 from sentinel_tpu.stats import events as ev
@@ -607,6 +608,8 @@ def flow_check_scalar(
     main_minute: Optional[WindowState] = None,
     now_idx_m: Optional[jnp.ndarray] = None,
     has_rate_limiter: bool = False,   # STATIC: ruleset has RL/WU-RL rules
+    rules_bk: Optional[jnp.ndarray] = None,   # pre-gathered [B, K] rule
+    # ids (the pipeline's joint flow+degrade gather); None = gather here
 ) -> Tuple[FlowDynState, jnp.ndarray, jnp.ndarray]:
     """Scalar-path flow check → (dyn', allow bool[B], wait_ms int32[B]).
 
@@ -695,32 +698,39 @@ def flow_check_scalar(
     max_k = jnp.where(table.count > 0, max_k, 0)
 
     # ---- per-pair work ----
-    safe_rows = jnp.minimum(rows, R - 1)
-    rules_bk = jnp.where((rows < R)[:, None], rule_idx[safe_rows], NF)
+    if rules_bk is None:
+        rules_bk = seg.padded_table_gather(rule_idx, rows, NF)
     rj = rules_bk.reshape(-1)                                # [BK]
     valid_bk = jnp.repeat(valid, K)
-    # inapplicable/invalid pairs share the sentinel segment (never blocks)
-    # exactly like the general path's rj_seg
-    live_rule = applies[rj] & valid_bk
-    key = jnp.where(live_rule, rj, NF)
+    # INVALID pairs share the sentinel segment (they must not consume
+    # ranks in real groups). INAPPLICABLE RULES need no key remap at all:
+    # applicability is per-rule in this path, so an inapplicable rule's
+    # group holds only inapplicable pairs — encoding "always passes" in
+    # its table row (limit=+inf, is_rl off) is equivalent and saves the
+    # applies[rj] gather.
+    key = jnp.where(valid_bk, rj, NF)
     rank = seg.ranks_by_key(key)                             # int32[BK]
 
     a_bk = jnp.repeat(acquire, K).astype(jnp.float32)
-    # packed per-rule verdict gathers: one int [NF+1, 4] (RL math stays
-    # int32 — float32 ms arithmetic drifts after ~4.6 h of uptime) and one
-    # float [NF+1, 2] for the QPS base/limit
+    is_rl_eff = is_rl & applies
+    limit_eff = jnp.where(applies, eff_limit, jnp.float32(3e38))
+    # ONE packed per-rule verdict gather [NF+1, 6]: int columns plus the
+    # two float columns bitcast to int32 (exact round-trip). RL math stays
+    # int32 — float32 ms arithmetic drifts after ~4.6 h of uptime.
     vt = jnp.stack([
-        is_rl.astype(jnp.int32),                             # 0
+        is_rl_eff.astype(jnp.int32),                         # 0
         base_time,                                           # 1
         cost,                                                # 2
         max_k,                                               # 3
+        lax.bitcast_convert_type(base, jnp.int32),           # 4
+        lax.bitcast_convert_type(limit_eff, jnp.int32),      # 5
     ], axis=1)
-    g = vt[key]                                              # [BK, 4]
-    vf = jnp.stack([base, eff_limit], axis=1)
-    gf = vf[key]                                             # [BK, 2]
+    g = vt[key]                                              # [BK, 6]
+    base_pair = lax.bitcast_convert_type(g[:, 4], jnp.float32)
+    limit_pair = lax.bitcast_convert_type(g[:, 5], jnp.float32)
     rankf = rank.astype(jnp.float32)
 
-    pass_default = (gf[:, 0] + rankf * a_bk) + a_bk <= gf[:, 1]
+    pass_default = (base_pair + rankf * a_bk) + a_bk <= limit_pair
     # RL: pass iff rank < max_k (the rank-prefix form of
     # `base_time + (rank+1)*cost - now <= maxQueueing`, exactly the
     # general path's fixed point for uniform cost — and overflow-free).

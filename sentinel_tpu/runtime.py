@@ -434,6 +434,8 @@ class Sentinel:
         # amortized by the persistent compilation cache).
         kf = self._flow.k_used
         kd = self._deg.k_used
+        flow_idx = self._flow.rule_idx[:, :kf]
+        deg_idx = self._deg.rule_idx[:, :kd]
         # Static step flags (jit static args — variants recompile when they
         # flip, steady-state rulesets keep one trace):
         self._scalar_has_rl = any(
@@ -444,11 +446,12 @@ class Sentinel:
         self._skip_sys = not getattr(self, "_sys_rules", [])
         return RuleSet(
             flow_table=self._flow.table,
-            flow_idx=self._flow.rule_idx[:, :kf],
+            flow_idx=flow_idx,
             deg_table=self._deg.table,
-            deg_idx=self._deg.rule_idx[:, :kd],
+            deg_idx=deg_idx,
             auth_table=self._auth.table, auth_idx=self._auth.rule_idx,
-            sys_thresholds=self._sys, param_table=self._param.table)
+            sys_thresholds=self._sys, param_table=self._param.table,
+            joint_idx=jnp.concatenate([flow_idx, deg_idx], axis=1))
 
     def _rebuild_fastpath(self) -> None:
         """Recompute the host-fast-path classification after any rule load
