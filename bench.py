@@ -199,10 +199,7 @@ def main() -> None:
     # empty-slot skips, used-rule-slot slicing; see runtime.decide_raw)
     ruleset = ruleset._replace(
         flow_idx=compiled.rule_idx[:, :compiled.k_used],
-        deg_idx=deg.rule_idx[:, :deg.k_used],
-        joint_idx=jnp.concatenate(
-            [compiled.rule_idx[:, :compiled.k_used],
-             deg.rule_idx[:, :deg.k_used]], axis=1))
+        deg_idx=deg.rule_idx[:, :deg.k_used]).with_joint()
     step = jax.jit(functools.partial(decide_entries, spec,
                                      enable_occupy=False, record_alt=False,
                                      scalar_flow=True, scalar_has_rl=False,

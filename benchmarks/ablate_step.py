@@ -85,11 +85,9 @@ def main() -> None:
     if os.environ.get("SCALAR_DETAIL"):
         # match the runtime's used-slot slicing AND joint rule gather —
         # the exact ruleset shape bench.py/runtime ship
-        fi = compiled.rule_idx[:, :compiled.k_used]
-        di = deg.rule_idx[:, :deg.k_used]
         ruleset = ruleset._replace(
-            flow_idx=fi, deg_idx=di,
-            joint_idx=jnp.concatenate([fi, di], axis=1))
+            flow_idx=compiled.rule_idx[:, :compiled.k_used],
+            deg_idx=deg.rule_idx[:, :deg.k_used]).with_joint()
 
     rng = np.random.default_rng(42)
     hot = rng.integers(1, NRULES, B // 4)

@@ -160,7 +160,7 @@ def bench_all_controllers():
                       deg_table=deg.table, deg_idx=deg.rule_idx[:, :1],
                       auth_table=auth.table, auth_idx=auth.rule_idx,
                       sys_thresholds=sys_mod.compile_system_rules([]),
-                      param_table=param.table)
+                      param_table=param.table).with_joint()
     state = init_state(spec, NR, 16)
     rng = np.random.default_rng(0)
     batch = EntryBatch(
@@ -249,7 +249,7 @@ def bench_breakers():
                       deg_table=deg.table, deg_idx=deg.rule_idx[:, :1],
                       auth_table=auth.table, auth_idx=auth.rule_idx,
                       sys_thresholds=sys_mod.compile_system_rules([]),
-                      param_table=param.table)
+                      param_table=param.table).with_joint()
     state = init_state(spec, 16, ND)
     rng = np.random.default_rng(0)
     rows = jnp.asarray(rng.integers(1, ND, B).astype(np.int32))

@@ -92,7 +92,15 @@ class RuleSet(NamedTuple):
     # slots' rule ids in ONE pass over the big row table (a 512k random
     # gather from a [1M]-row table costs ~6 ms on the v5 chip; two of
     # them were ~25% of the scalar step). None = gather separately.
+    # ALWAYS build via with_joint() — the consumer splits at
+    # flow_idx.shape[1], so a hand-concatenated copy can silently desync.
     joint_idx: Optional[jnp.ndarray] = None
+
+    def with_joint(self) -> "RuleSet":
+        """→ self with ``joint_idx`` derived from the flow_idx/deg_idx
+        THIS ruleset actually carries (desync-proof by construction)."""
+        return self._replace(joint_idx=jnp.concatenate(
+            [self.flow_idx, self.deg_idx], axis=1))
 
 
 class EntryBatch(NamedTuple):

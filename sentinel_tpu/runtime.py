@@ -450,8 +450,8 @@ class Sentinel:
             deg_table=self._deg.table,
             deg_idx=deg_idx,
             auth_table=self._auth.table, auth_idx=self._auth.rule_idx,
-            sys_thresholds=self._sys, param_table=self._param.table,
-            joint_idx=jnp.concatenate([flow_idx, deg_idx], axis=1))
+            sys_thresholds=self._sys,
+            param_table=self._param.table).with_joint()
 
     def _rebuild_fastpath(self) -> None:
         """Recompute the host-fast-path classification after any rule load
