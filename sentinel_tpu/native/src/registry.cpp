@@ -307,9 +307,15 @@ int32_t i64_get_or_create_batch(void* h, const int64_t* packed, int32_t n,
         int64_t p = packed[i];
         int32_t slot = (int32_t)(p >> 32);
         int64_t value = (int64_t)(p & 0xffffffffll) - (1ll << 31);
-        std::memcpy(key, &slot, 4);
+        // Explicit little-endian byte writes: Python's string-path
+        // encoder pins '<i'/'<q', so a host-endian memcpy on a
+        // big-endian machine would intern the same logical key twice.
+        uint32_t us = (uint32_t)slot;
+        uint64_t uv = (uint64_t)value;
+        for (int b = 0; b < 4; ++b) key[b] = (char)((us >> (8 * b)) & 0xff);
         key[4] = 'i';
-        std::memcpy(key + 5, &value, 8);
+        for (int b = 0; b < 8; ++b)
+            key[5 + b] = (char)((uv >> (8 * b)) & 0xff);
         out[i] = t->get_or_create2(key, 13, created + i);
     }
     return n;

@@ -23,6 +23,7 @@ epoch for wraparound-safe relative time, and the 1 s system-status sampler
 
 from __future__ import annotations
 
+import collections
 import functools
 import threading
 from typing import List, Optional, Sequence, Tuple
@@ -354,6 +355,12 @@ class Sentinel:
         # serializes diffs: concurrent diffs against one baseline would
         # double-fire observers and lose interleaved transitions
         self._breaker_event_lock = threading.Lock()
+        # delivery stays seq-ordered WITHOUT holding the event lock in
+        # user code: transitions are enqueued under the event lock (queue
+        # order == seq order) and drained by a single active drainer;
+        # re-entrant or concurrent callers enqueue and return
+        self._breaker_fire_q: "collections.deque" = collections.deque()
+        self._breaker_firing = False
 
         (self._jit_decide, self._jit_decide_prio,
          self._jit_decide_noalt, self._jit_decide_prio_noalt,
@@ -1790,10 +1797,24 @@ class Sentinel:
             # on the ID, not the row), occupy off, no per-event
             # cluster-fallback bits, uniform acquire. skip_auth/skip_sys
             # elide empty slots (static flags, tracked by _build_ruleset).
+            # Eligibility looks only at lanes the caller marked valid:
+            # arbitrary acquire/origin values on invalid lanes are masked
+            # device-side anyway, so they must not disqualify the scalar
+            # path (performance-only — the math never sees them).
             acq = np.asarray(acquire)
+            oid = np.asarray(origin_ids)
+            if valid is not None:
+                # a shorter `valid` is legal (pad_to fills False: the
+                # tail is invalid) — extend with False before masking
+                vmask = np.zeros(acq.shape[0], bool)
+                vsrc = np.asarray(valid, bool)
+                m = min(vmask.shape[0], vsrc.shape[0])
+                vmask[:m] = vsrc[:m]
+                acq = acq[vmask]
+                oid = oid[vmask[:oid.shape[0]]]
             acq_uniform = (acq.size > 0
                            and int(acq.min()) == int(acq.max()) >= 1)
-            no_origin_ids = int(np.max(origin_ids, initial=0)) == 0
+            no_origin_ids = int(np.max(oid, initial=0)) == 0
             flags = {"skip_auth": self._skip_auth,
                      "skip_sys": self._skip_sys}
             if (no_alt and no_origin_ids and not use_occ
@@ -2105,9 +2126,44 @@ class Sentinel:
                 for j, r in enumerate(rules_snap):
                     if j < len(prev[2]) and j < len(states) \
                             and prev[2][j] != states[j]:
-                        to_fire.append((r.resource, prev[2][j], states[j]))
+                        to_fire.append((r.resource, prev[2][j], states[j],
+                                        observers))
             fired = len(to_fire)
-            for res, old, new in to_fire:
+            # enqueue under the event lock: the seq check above admits
+            # snapshots in order, so queue order == transition order
+            self._breaker_fire_q.extend(to_fire)
+        # every enqueuer drains its own items, so the empty case can skip
+        # the drain's lock round-trips entirely (hot-path materialization)
+        if to_fire:
+            self._drain_breaker_fires()
+        return fired
+
+    def _drain_breaker_fires(self) -> None:
+        """Deliver queued breaker transitions in seq order. Exactly one
+        thread drains at a time (the rest — including an observer that
+        re-enters the engine and lands new transitions — enqueue and
+        return; the active drainer picks their items up). Observers thus
+        run with NO engine lock held: re-entry (entry(),
+        decide_raw().result(), check_breaker_transitions()) cannot
+        self-deadlock, and a slow observer cannot stall concurrent
+        verdict materializations — only delay later deliveries, which
+        must wait anyway to preserve per-observer ordering."""
+        with self._breaker_event_lock:
+            if self._breaker_firing:
+                return
+            self._breaker_firing = True
+        try:
+            while True:
+                with self._breaker_event_lock:
+                    if not self._breaker_fire_q:
+                        # reset ATOMICALLY with the empty check: a
+                        # non-atomic reset would let a concurrent
+                        # enqueuer see firing=True after our check and
+                        # strand its items until the next transition
+                        self._breaker_firing = False
+                        return
+                    res, old, new, observers = \
+                        self._breaker_fire_q.popleft()
                 for fn in observers:
                     try:
                         fn(res, old, new)
@@ -2115,7 +2171,13 @@ class Sentinel:
                         from sentinel_tpu.core.logs import record_log
                         record_log().warning(
                             "breaker observer failed: %r", exc)
-        return fired
+        except BaseException:
+            # Ctrl-C/SystemExit in an observer: a stuck True flag would
+            # silently end all future delivery (queued items, if any,
+            # deliver on the next transition)
+            with self._breaker_event_lock:
+                self._breaker_firing = False
+            raise
 
     def check_breaker_transitions(self) -> int:
         """Poll fallback: snapshot current breaker states and run them

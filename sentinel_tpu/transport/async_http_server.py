@@ -127,10 +127,21 @@ class AsyncHttpCommandCenter:
                         "Command name cannot be empty", 400)
                 else:
                     # handlers may block (engine locks, device steps):
-                    # keep the loop free
-                    resp = await asyncio.get_running_loop().run_in_executor(
-                        self._pool, self.center.handle, name,
-                        CommandRequest(parameters=params, body=body))
+                    # keep the loop free. CommandCenter.handle already
+                    # converts handler exceptions to 500 responses; this
+                    # catch covers only executor-infrastructure failures
+                    # (e.g. pool shutdown during stop()) so the client
+                    # still gets a response instead of a dropped
+                    # connection + unretrieved-exception traceback.
+                    try:
+                        resp = await asyncio.get_running_loop() \
+                            .run_in_executor(
+                                self._pool, self.center.handle, name,
+                                CommandRequest(parameters=params,
+                                               body=body))
+                    except Exception as exc:
+                        resp = CommandResponse.of_failure(
+                            f"command handler error: {exc!r}", 500)
                 payload = resp.result.encode("utf-8")
                 code = resp.code if not resp.success else 200
                 keep = headers.get("connection", "keep-alive") != "close"

@@ -96,3 +96,30 @@ def test_observer_errors_do_not_break_the_pipeline(clk):
         sph.entry("r").exit()
     except stpu.BlockException:
         pass
+
+
+def test_observer_may_reenter_the_engine(clk):
+    """Observers fire OUTSIDE the event lock: one that re-enters the
+    engine (another entry, or the poll fallback) must not self-deadlock
+    (``AbstractCircuitBreaker`` notifies outside its state CAS too)."""
+    sph = make_sentinel(clk)
+    sph.load_degrade_rules([stpu.DegradeRule(
+        resource="re", grade=stpu.GRADE_EXCEPTION_COUNT, count=1,
+        time_window=1, min_request_amount=1)])
+    reentered = []
+
+    def observer(res, old, new):
+        # both of these paths reach _diff_and_fire_breakers /
+        # _breaker_event_lock — deadlock if still held while firing
+        sph.check_breaker_transitions()
+        try:
+            sph.entry("other").exit()
+        except stpu.BlockException:
+            pass
+        reentered.append((old, new))
+
+    sph.add_breaker_observer(observer)
+    e = sph.entry("re")
+    e.trace(RuntimeError("x"))
+    e.exit()                            # trips → observer re-enters
+    assert reentered == [(STATE_CLOSED, STATE_OPEN)]
