@@ -200,10 +200,13 @@ def main() -> None:
     ruleset = ruleset._replace(
         flow_idx=compiled.rule_idx[:, :compiled.k_used],
         deg_idx=deg.rule_idx[:, :deg.k_used]).with_joint()
+    # skip_threads: the bench ruleset has no THREAD-grade/system rules, so
+    # the runtime would elide the gauge scatters for it too (VERDICT r4 #2)
     step = jax.jit(functools.partial(decide_entries, spec,
                                      enable_occupy=False, record_alt=False,
                                      scalar_flow=True, scalar_has_rl=False,
-                                     skip_auth=True, skip_sys=True),
+                                     skip_auth=True, skip_sys=True,
+                                     skip_threads=True),
                    donate_argnums=(1,),
                    **({"out_shardings": mesh_sh} if mesh_sh else {}))
 
@@ -266,7 +269,7 @@ def main() -> None:
     metric = ("decisions_per_sec_1chip_1M_resources" if SHARDS <= 1 else
               f"decisions_per_sec_{SHARDS}shard_1M_resources")
     # north star is per-chip: a sharded run is held to SHARDS× the target
-    print(json.dumps({
+    out = {
         "metric": metric,
         "value": round(rate, 1),
         "unit": "decisions/s",
@@ -278,7 +281,21 @@ def main() -> None:
         "dispatch_floor_ms": round(floor_ms, 2),
         "batch": B,
         "resources": R,
-    }))
+    }
+    # General-path + mixed-batch numbers ride the same artifact (VERDICT
+    # r4 #10: the non-happy path must not regress silently). Skippable via
+    # BENCH_GENERAL=0; a failure never takes the headline down with it.
+    if os.environ.get("BENCH_GENERAL", "1") != "0" and SHARDS <= 1:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        try:
+            from benchmarks.general_bench import measure
+            del state, batches        # free HBM before the second fixture
+            g_steps = int(os.environ.get("BENCH_GENERAL_STEPS", "20"))
+            out["general"] = measure(jax, "fast", R, B, g_steps, NRULES, 3)
+            out["mixed"] = measure(jax, "mixed", R, B, g_steps, NRULES, 3)
+        except Exception as exc:      # noqa: BLE001 — headline must print
+            out["general_error"] = repr(exc)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,2 @@
+"""Benchmark harnesses (importable so bench.py can embed the general-path
+numbers in the driver artifact)."""

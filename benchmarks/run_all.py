@@ -27,6 +27,17 @@ def _env(name, default):
 
 
 SMALL = os.environ.get("BENCH_SMALL") == "1"
+# N timed regions per config (VERDICT r4 #4: the matrix must distinguish a
+# real regression from tunnel weather — every throughput figure below is a
+# median over REPEATS regions with a band)
+REPEATS = int(os.environ.get("BENCH_REPEATS", "1" if SMALL else "3"))
+
+
+def _band(rates):
+    """(median, band_min, band_max, runs) for a list of per-region rates."""
+    s = sorted(rates)
+    return (round(s[len(s) // 2], 0), round(s[0], 0), round(s[-1], 0),
+            len(s))
 
 
 def _run_pipelined(dispatch, steps: int, depth: int):
@@ -173,11 +184,13 @@ def bench_all_controllers():
         prioritized=jnp.zeros(B, jnp.bool_), valid=jnp.ones(B, jnp.bool_))
     # same static variant the runtime selects for this batch shape:
     # alt-free + uniform acquire + no origins → scalar path (with RL
-    # rules present), empty auth/system slots skipped
+    # rules present), empty auth/system slots skipped, thread gauges
+    # elided (no THREAD/system rules)
     step = jax.jit(functools.partial(decide_entries, spec,
                                      enable_occupy=False, record_alt=False,
                                      scalar_flow=True, scalar_has_rl=True,
-                                     skip_auth=True, skip_sys=True),
+                                     skip_auth=True, skip_sys=True,
+                                     skip_threads=True),
                    donate_argnums=(1,))
     sysv = jnp.asarray(np.array([0.5, 0.1], np.float32))
 
@@ -192,20 +205,30 @@ def bench_all_controllers():
     # until the process's first device→host copy; force it before timing
     np.asarray(v.allow[:1])
     jax.block_until_ready(state)
-    t0 = time.perf_counter()
-    t_disp = 0.0
-    for i in range(STEPS):
-        td = time.perf_counter()
-        state, v = step(ruleset, state, batch, times(3 + i), sysv)
-        t_disp += time.perf_counter() - td
-    jax.block_until_ready((state, v))
-    dt = time.perf_counter() - t0
+    rates, disp_ms, dev_ms = [], [], []
+    tick = 3
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        t_disp = 0.0
+        for i in range(STEPS):
+            td = time.perf_counter()
+            state, v = step(ruleset, state, batch, times(tick), sysv)
+            tick += 1
+            t_disp += time.perf_counter() - td
+        jax.block_until_ready((state, v))
+        dt = time.perf_counter() - t0
+        rates.append(B * STEPS / dt)
+        disp_ms.append(t_disp / STEPS * 1000)
+        dev_ms.append((dt - t_disp) / STEPS * 1000)
+    med, lo, hi, n = _band(rates)
     # dispatch returns async: total >> dispatch ⇒ the run is device-bound
     return {"config": "2-all-controllers-10k-resources",
-            "decisions_per_sec": round(B * STEPS / dt, 0),
-            "host_dispatch_ms_per_step": round(t_disp / STEPS * 1000, 3),
+            "decisions_per_sec": med, "band_min": lo, "band_max": hi,
+            "runs": n,
+            "host_dispatch_ms_per_step": round(
+                sorted(disp_ms)[n // 2], 3),
             "device_bound_ms_per_step": round(
-                (dt - t_disp) / STEPS * 1000, 3)}
+                sorted(dev_ms)[n // 2], 3)}
 
 
 def bench_breakers():
@@ -269,11 +292,14 @@ def bench_breakers():
         is_in=jnp.ones(B, jnp.bool_), valid=jnp.ones(B, jnp.bool_))
     from sentinel_tpu.engine.pipeline import decide_and_record_exits
     # same static variants the runtime selects for alt-free traffic
+    # (thread gauges elided: degrade-only ruleset has no gauge readers)
     kw = dict(enable_occupy=False, record_alt=False, scalar_flow=True,
-              scalar_has_rl=False, skip_auth=True, skip_sys=True)
+              scalar_has_rl=False, skip_auth=True, skip_sys=True,
+              skip_threads=True)
     step = jax.jit(functools.partial(decide_entries, spec, **kw))
     exit_step = jax.jit(functools.partial(record_exits, spec,
-                                          record_alt=False))
+                                          record_alt=False,
+                                          skip_threads=True))
     fused = jax.jit(functools.partial(decide_and_record_exits, spec, **kw))
     sysv = jnp.asarray(np.array([0.5, 0.1], np.float32))
 
@@ -287,51 +313,66 @@ def bench_breakers():
     state = exit_step(ruleset, state, xbatch, times(0))
     np.asarray(v0.allow[:1])     # honest-mode gate (see bench.py)
     jax.block_until_ready(state)
-    t0 = time.perf_counter()
-    t_disp = 0.0
-    for i in range(STEPS):
-        td = time.perf_counter()
-        state, v = step(ruleset, state, ebatch, times(i), sysv)
-        state = exit_step(ruleset, state, xbatch, times(i))
-        t_disp += time.perf_counter() - td
-    jax.block_until_ready(state)
-    dt2 = time.perf_counter() - t0
+    tick = 1
+    rates2 = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        for i in range(STEPS):
+            state, v = step(ruleset, state, ebatch, times(tick), sysv)
+            state = exit_step(ruleset, state, xbatch, times(tick))
+            tick += 1
+        jax.block_until_ready(state)
+        rates2.append(B * STEPS / (time.perf_counter() - t0))
 
     # ---- fused single-dispatch form (decide_and_record_exits) ----
-    state, _ = fused(ruleset, state, ebatch, xbatch, times(0), sysv)
+    state, _ = fused(ruleset, state, ebatch, xbatch, times(tick), sysv)
     jax.block_until_ready(state)
-    t0 = time.perf_counter()
-    t_disp_f = 0.0
-    for i in range(STEPS):
-        td = time.perf_counter()
-        state, v = fused(ruleset, state, ebatch, xbatch,
-                         times(STEPS + i), sysv)
-        t_disp_f += time.perf_counter() - td
-    jax.block_until_ready((state, v))
-    dt1 = time.perf_counter() - t0
+    rates1, dispf_ms, devf_ms = [], [], []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        t_disp_f = 0.0
+        for i in range(STEPS):
+            td = time.perf_counter()
+            state, v = fused(ruleset, state, ebatch, xbatch,
+                             times(tick), sysv)
+            tick += 1
+            t_disp_f += time.perf_counter() - td
+        jax.block_until_ready((state, v))
+        dt1 = time.perf_counter() - t0
+        rates1.append(B * STEPS / dt1)
+        dispf_ms.append(t_disp_f / STEPS * 1000)
+        devf_ms.append((dt1 - t_disp_f) / STEPS * 1000)
+    med1, lo1, hi1, n = _band(rates1)
+    med2, lo2, hi2, _ = _band(rates2)
     return {"config": "3-circuit-breakers-entry+exit",
-            "entry_exit_pairs_per_sec": round(B * STEPS / dt1, 0),
-            "two_dispatch_pairs_per_sec": round(B * STEPS / dt2, 0),
+            "entry_exit_pairs_per_sec": med1,
+            "band_min": lo1, "band_max": hi1, "runs": n,
+            "two_dispatch_pairs_per_sec": med2,
+            "two_dispatch_band": [lo2, hi2],
             "host_dispatch_ms_per_step_fused": round(
-                t_disp_f / STEPS * 1000, 3),
-            "host_dispatch_ms_per_step_2disp": round(
-                t_disp / STEPS * 1000, 3),
+                sorted(dispf_ms)[n // 2], 3),
             "device_bound_ms_per_step_fused": round(
-                (dt1 - t_disp_f) / STEPS * 1000, 3)}
+                sorted(devf_ms)[n // 2], 3)}
 
 
-def bench_hot_param_zipf():
+def bench_hot_param_zipf(B_override=None):
     """Config 4 — hot-param throttling over Zipf-skewed keys.
 
     Double-buffered: ``entry_batch_nowait`` dispatches step s+1..s+DEPTH
     while step s's verdicts are still in flight, hiding the device→host
     readback RTT that made the sync loop ~10k checks/s on the tunneled
     chip. The decomposition fields prove what remains on the critical
-    path (host prep+dispatch vs readback stalls)."""
+    path (host prep+dispatch vs readback stalls).
+
+    Serving batch default 65536: picked from the committed round-5
+    scaling curve (BASELINE.md round-5 serving-batch table — throughput
+    rises ~linearly with B while pipelined grant p50 stays far under the
+    reference's 20 ms budget through 64k; 256k exceeds it). Override:
+    BENCH_SERVE_B."""
     import sentinel_tpu as stpu
 
     K = 1 << 12 if SMALL else 1 << 16
-    B = 512 if SMALL else 4096
+    B = B_override or (512 if SMALL else _env("BENCH_SERVE_B", 1 << 16))
     STEPS = 5 if SMALL else 50
     DEPTH = _env("BENCH_PIPE_DEPTH", 8)
     sph = stpu.Sentinel(stpu.load_config(
@@ -341,43 +382,69 @@ def bench_hot_param_zipf():
     sph.load_param_flow_rules([stpu.ParamFlowRule(
         resource="hot", param_idx=0, count=1000)])
     rng = np.random.default_rng(0)
+    sync_steps = min(STEPS, 10)
+    total = 2 + (sync_steps + STEPS) * REPEATS
     # 2D int array form: the fastest args_list shape (vectorized key
     # resolution, one intern per distinct key)
-    keys = (rng.zipf(1.2, size=B * STEPS) % (K // 2)).reshape(STEPS, B, 1)
+    keys = (rng.zipf(1.2, size=B * total) % (K // 2)).reshape(total, B, 1)
     resources = ["hot"] * B
     for s in range(2):
-        sph.entry_batch(resources, args_list=keys[0])
+        sph.entry_batch(resources, args_list=keys[s])
+    tick = 2
     # sync reference point (per-step verdict readback on the critical path);
     # per-call latency here IS the per-grant latency a sync caller sees
-    sync_steps = min(STEPS, 10)
-    sync_lat = np.empty(sync_steps)
-    t0 = time.perf_counter()
-    for s in range(sync_steps):
-        ts = time.perf_counter()
-        sph.entry_batch(resources, args_list=keys[s])
-        sync_lat[s] = time.perf_counter() - ts
-    sync_dt = time.perf_counter() - t0
+    sync_rates, sync_lats = [], []
+    for _ in range(REPEATS):
+        sync_lat = np.empty(sync_steps)
+        t0 = time.perf_counter()
+        for s in range(sync_steps):
+            ts = time.perf_counter()
+            sph.entry_batch(resources, args_list=keys[tick])
+            tick += 1
+            sync_lat[s] = time.perf_counter() - ts
+        sync_rates.append(B * sync_steps / (time.perf_counter() - t0))
+        sync_lats.append(sync_lat)
 
-    def dispatch(s):
-        return sph.entry_batch_nowait(resources, args_list=keys[s])
+    pipe_rates, pipe_lats, disp_ms, read_ms = [], [], [], []
+    for _ in range(REPEATS):
+        base = tick
 
-    dt, t_dispatch, t_read, lat = _run_pipelined(dispatch, STEPS, DEPTH)
-    sp50, sp99 = _pcts(sync_lat)
-    pp50, pp99 = _pcts(lat)
-    return {"config": "4-hot-param-zipf",
-            "param_checks_per_sec": round(B * STEPS / dt, 0),
-            "sync_checks_per_sec": round(B * sync_steps / sync_dt, 0),
+        def dispatch(s):
+            return sph.entry_batch_nowait(resources,
+                                          args_list=keys[base + s])
+
+        dt, t_dispatch, t_read, lat = _run_pipelined(dispatch, STEPS,
+                                                     DEPTH)
+        tick += STEPS
+        pipe_rates.append(B * STEPS / dt)
+        pipe_lats.append(lat)
+        disp_ms.append(t_dispatch / STEPS * 1000)
+        read_ms.append(t_read / STEPS * 1000)
+    sp50, sp99 = _pcts(np.concatenate(sync_lats))
+    pp50, pp99 = _pcts(np.concatenate(pipe_lats))
+    med, lo, hi, n = _band(pipe_rates)
+    smed, slo, shi, _ = _band(sync_rates)
+    return {"config": "4-hot-param-zipf", "batch": B,
+            "param_checks_per_sec": med,
+            "band_min": lo, "band_max": hi, "runs": n,
+            "sync_checks_per_sec": smed, "sync_band": [slo, shi],
             "pipeline_depth": DEPTH,
             "sync_grant_p50_ms": sp50, "sync_grant_p99_ms": sp99,
             "pipelined_grant_p50_ms": pp50, "pipelined_grant_p99_ms": pp99,
             "budget_ms": 20.0,          # ClusterConstants DEFAULT_REQUEST_TIMEOUT
+            # medians over the same regions as the rate band, so the
+            # decomposition explains the number beside it
             "host_prep_dispatch_ms_per_step": round(
-                t_dispatch / STEPS * 1000, 3),
-            "readback_stall_ms_per_step": round(t_read / STEPS * 1000, 3)}
+                sorted(disp_ms)[n // 2], 3),
+            "readback_stall_ms_per_step": round(
+                sorted(read_ms)[n // 2], 3)}
 
 
-def bench_cluster_tokens():
-    """Config 5 — cluster token grants on the sharded engine."""
+def bench_cluster_tokens(B_override=None):
+    """Config 5 — cluster token grants on the sharded engine.
+
+    Serving batch default 65536: from the round-5 scaling curve (same
+    method as config 4 — see BASELINE.md; BENCH_SERVE_B overrides)."""
     from sentinel_tpu.parallel.cluster import (
         THRESHOLD_GLOBAL, ClusterEngine, ClusterFlowRule, ClusterSpec,
     )
@@ -385,7 +452,7 @@ def bench_cluster_tokens():
 
     n_shards = min(8, len(jax.devices()))
     FL = 64 if SMALL else 512
-    B = 256 if SMALL else 4096
+    B = B_override or (256 if SMALL else _env("BENCH_SERVE_B", 1 << 16))
     STEPS = 5 if SMALL else 50
     eng = ClusterEngine(ClusterSpec(n_shards=n_shards,
                                     flows_per_shard=max(FL // n_shards, 16),
@@ -400,37 +467,72 @@ def bench_cluster_tokens():
     ones = np.ones(B, np.int64)
     now = 10_000_000
     eng.request_tokens(ids, ones, now_ms=now)
-    # sync reference point; per-call latency IS the per-grant latency
+    tick = 1
     sync_steps = min(STEPS, 10)
-    sync_lat = np.empty(sync_steps)
-    t0 = time.perf_counter()
-    for s in range(sync_steps):
-        ts = time.perf_counter()
-        eng.request_tokens(ids, ones, now_ms=now + s)
-        sync_lat[s] = time.perf_counter() - ts
-    sync_dt = time.perf_counter() - t0
+    sync_rates, sync_lats = [], []
+    for _ in range(REPEATS):
+        sync_lat = np.empty(sync_steps)
+        t0 = time.perf_counter()
+        for s in range(sync_steps):
+            ts = time.perf_counter()
+            eng.request_tokens(ids, ones, now_ms=now + tick)
+            tick += 1
+            sync_lat[s] = time.perf_counter() - ts
+        sync_rates.append(B * sync_steps / (time.perf_counter() - t0))
+        sync_lats.append(sync_lat)
     # double-buffered grants: dispatch N+1..N+DEPTH while N reads back
     DEPTH = _env("BENCH_PIPE_DEPTH", 8)
-    dt, t_dispatch, t_read, lat = _run_pipelined(
-        lambda s: eng.request_tokens_nowait(
-            ids, ones, now_ms=now + sync_steps + s),
-        STEPS, DEPTH)
-    sp50, sp99 = _pcts(sync_lat)
-    pp50, pp99 = _pcts(lat)
+    pipe_rates, pipe_lats, disp_ms, read_ms = [], [], [], []
+    for _ in range(REPEATS):
+        base = tick
+        dt, t_dispatch, t_read, lat = _run_pipelined(
+            lambda s: eng.request_tokens_nowait(
+                ids, ones, now_ms=now + base + s),
+            STEPS, DEPTH)
+        tick += STEPS
+        pipe_rates.append(B * STEPS / dt)
+        pipe_lats.append(lat)
+        disp_ms.append(t_dispatch / STEPS * 1000)
+        read_ms.append(t_read / STEPS * 1000)
+    sp50, sp99 = _pcts(np.concatenate(sync_lats))
+    pp50, pp99 = _pcts(np.concatenate(pipe_lats))
+    med, lo, hi, n = _band(pipe_rates)
+    smed, slo, shi, _ = _band(sync_rates)
     return {"config": "5-cluster-token-grants",
-            "shards": n_shards,
-            "grants_per_sec": round(B * STEPS / dt, 0),
-            "sync_grants_per_sec": round(B * sync_steps / sync_dt, 0),
+            "shards": n_shards, "batch": B,
+            "grants_per_sec": med,
+            "band_min": lo, "band_max": hi, "runs": n,
+            "sync_grants_per_sec": smed, "sync_band": [slo, shi],
             "pipeline_depth": DEPTH,
             "sync_grant_p50_ms": sp50, "sync_grant_p99_ms": sp99,
             "pipelined_grant_p50_ms": pp50, "pipelined_grant_p99_ms": pp99,
             "budget_ms": 20.0,          # ClusterConstants DEFAULT_REQUEST_TIMEOUT
+            # medians over the same regions as the rate band
             "host_prep_dispatch_ms_per_step": round(
-                t_dispatch / STEPS * 1000, 3),
-            "readback_stall_ms_per_step": round(t_read / STEPS * 1000, 3)}
+                sorted(disp_ms)[n // 2], 3),
+            "readback_stall_ms_per_step": round(
+                sorted(read_ms)[n // 2], 3)}
+
+
+def serve_curve() -> None:
+    """BENCH_SERVE_CURVE=1: configs 4/5 across serving batch sizes
+    (VERDICT r4 #3) — one JSON line per (config, B). The per-config
+    defaults above are picked from this curve: largest B whose pipelined
+    grant p50 stays inside the reference's 20 ms request budget
+    (ClusterConstants.DEFAULT_REQUEST_TIMEOUT)."""
+    for B in (1 << 12, 1 << 14, 1 << 16, 1 << 18):
+        for fn in (bench_hot_param_zipf, bench_cluster_tokens):
+            try:
+                print(json.dumps(fn(B_override=B)), flush=True)
+            except Exception as exc:
+                print(json.dumps({"config": fn.__name__, "batch": B,
+                                  "error": repr(exc)}), flush=True)
 
 
 def main() -> None:
+    if os.environ.get("BENCH_SERVE_CURVE") == "1":
+        serve_curve()
+        return
     for fn in (bench_entry_latency, bench_all_controllers, bench_breakers,
                bench_hot_param_zipf, bench_cluster_tokens):
         try:

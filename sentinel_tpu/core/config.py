@@ -81,6 +81,15 @@ class SentinelConfig:
     # Warm-up cold factor (SentinelConfig default 3)
     cold_factor: int = 3
 
+    # Thread-gauge elision: when nothing loaded READS live concurrency
+    # (no THREAD-grade flow/param rules, no system rules), the gauge-
+    # maintenance scatters are elided from the hot steps and the gauges
+    # read 0 (reference readers: DefaultController THREAD branch,
+    # SystemRuleManager.checkSystem, ParamFlowChecker THREAD mode).
+    # Set True to always maintain the gauges — live-concurrency
+    # observability (dashboard threadNum) at ~20% step-floor cost.
+    thread_gauge_always: bool = False
+
     # Persistent XLA compilation-cache directory (cold-start story,
     # core/compile_cache.py). None/"" = the default
     # ~/.cache/sentinel_tpu/xla; SENTINEL_COMPILE_CACHE=off disables.

@@ -204,11 +204,25 @@ def decide_entries(
     # path: per-rule budgets, one rank sort, sort-free breaker probes
     # (see rules/flow.flow_check_scalar). Implies record_alt=False and
     # enable_occupy=False.
+    fast_flow: bool = False,     # STATIC: HOST-VERIFIED preconditions
+    # (uniform acquire >= 1, no prioritized events, occupy off) → the
+    # fast GENERAL path: origins/alt rows/CHAIN/fallback bits all live,
+    # admission via rank closed forms (rules/flow.flow_check_fast).
+    # Mutually exclusive with scalar_flow; implies enable_occupy=False.
     skip_auth: bool = False,     # STATIC: no authority rules loaded —
     # the whole slot (incl. its [B, Ka] gathers) compiles away
     skip_sys: bool = False,      # STATIC: no system thresholds set
     scalar_has_rl: bool = True,  # STATIC: ruleset contains rate-limiter
     # rules (scalar path only — gates the pacing-clock histogram scatter)
+    skip_threads: bool = False,  # STATIC: nothing loaded READS the live-
+    # concurrency gauges (no THREAD-grade flow rules, no system rules, no
+    # THREAD-grade param rules — the only reference readers:
+    # DefaultController.java:50-76 THREAD branch, SystemRuleManager
+    # .checkSystem, ParamFlowChecker THREAD mode), so their maintenance
+    # scatters are elided entirely. The gauges then read 0 (observability
+    # trade documented in docs/OPERATIONS.md); loading a gauge-reading
+    # rule flips the flag (retrace) and the gauge warms as pre-flip
+    # entries exit (decrements clamp at 0).
 ) -> Tuple[SentinelState, Verdicts]:
     """One device step: decide a batch, then record post-decision statistics.
 
@@ -227,6 +241,10 @@ def decide_entries(
     if scalar_flow:
         assert not record_alt and not enable_occupy, \
             "scalar_flow implies record_alt=False, enable_occupy=False"
+    if fast_flow:
+        assert not scalar_flow and not enable_occupy, \
+            "fast_flow is exclusive with scalar_flow and implies " \
+            "enable_occupy=False"
 
     # ---- slot cascade (each gate only sees events still alive) ----
     live = batch.valid
@@ -264,19 +282,19 @@ def decide_entries(
         param_ok = jnp.ones_like(live2)
         param_wait = jnp.zeros(live2.shape, jnp.int32)
 
+    flow_bk = deg_bk = None
+    if (scalar_flow or fast_flow) and rules.joint_idx is not None:
+        # ONE random gather over the [R, Kf+Kd] joint table feeds both
+        # slots (see RuleSet.joint_idx)
+        from sentinel_tpu.ops.segments import padded_table_gather
+        Kf = rules.flow_idx.shape[1]
+        NFs = rules.flow_table.active.shape[0] - 1
+        NDs = rules.deg_table.active.shape[0] - 1
+        joint = padded_table_gather(rules.joint_idx, batch.rows, 0)
+        in_r = (batch.rows < R)[:, None]
+        flow_bk = jnp.where(in_r, joint[:, :Kf], NFs)
+        deg_bk = jnp.where(in_r, joint[:, Kf:], NDs)
     if scalar_flow:
-        flow_bk = deg_bk = None
-        if rules.joint_idx is not None:
-            # ONE random gather over the [R, Kf+Kd] joint table feeds both
-            # slots (see RuleSet.joint_idx)
-            from sentinel_tpu.ops.segments import padded_table_gather
-            Kf = rules.flow_idx.shape[1]
-            NFs = rules.flow_table.active.shape[0] - 1
-            NDs = rules.deg_table.active.shape[0] - 1
-            joint = padded_table_gather(rules.joint_idx, batch.rows, 0)
-            in_r = (batch.rows < R)[:, None]
-            flow_bk = jnp.where(in_r, joint[:, :Kf], NFs)
-            deg_bk = jnp.where(in_r, joint[:, Kf:], NDs)
         flow_dyn, flow_ok, wait_ms = flow_mod.flow_check_scalar(
             rules.flow_table, state.flow_dyn, rules.flow_idx, spec.second,
             state.second, state.threads, batch.rows, batch.acquire, live2,
@@ -285,6 +303,33 @@ def decide_entries(
             main_minute=state.minute if spec.minute else None,
             now_idx_m=now_idx_m,
             has_rate_limiter=scalar_has_rl,
+            rules_bk=flow_bk)
+        occupied = jnp.zeros_like(flow_ok)
+        live3 = live2 & flow_ok
+        breakers, deg_ok = deg_mod.degrade_entry_check_scalar(
+            rules.deg_table, state.breakers, rules.deg_idx, batch.rows,
+            live3, rel_now_ms, rules_bk=deg_bk)
+    elif fast_flow:
+        # fast general path: per-pair origin/row selection stays live, the
+        # admission machinery collapses to rank closed forms; the degrade
+        # slot is origin-independent, so the scalar variant applies as-is
+        # (occupy is off, so live3 needs no ~occupied mask)
+        cl_fb = (batch.cluster_fallback if batch.cluster_fallback is not None
+                 else jnp.zeros(batch.valid.shape, jnp.int32))
+        fview = flow_mod.FlowBatchView(
+            rows=batch.rows, origin_ids=batch.origin_ids,
+            origin_rows=batch.origin_rows, context_ids=batch.context_ids,
+            chain_rows=batch.chain_rows, acquire=batch.acquire, valid=live2,
+            prioritized=batch.prioritized, cluster_fallback=cl_fb)
+        flow_dyn, flow_ok, wait_ms = flow_mod.flow_check_fast(
+            rules.flow_table, state.flow_dyn, rules.flow_idx, spec.second,
+            state.second, state.alt_second, state.threads,
+            state.alt_threads, fview, now_idx_s, rel_now_ms,
+            minute_spec=spec.minute,
+            main_minute=state.minute if spec.minute else None,
+            now_idx_m=now_idx_m,
+            has_rate_limiter=scalar_has_rl,
+            has_thread_rules=not skip_threads,
             rules_bk=flow_bk)
         occupied = jnp.zeros_like(flow_ok)
         live3 = live2 & flow_ok
@@ -308,7 +353,8 @@ def decide_entries(
             now_idx_m=now_idx_m,
             in_win_ms=in_win_ms,
             occupy_timeout_ms=spec.occupy_timeout_ms,
-            enable_occupy=enable_occupy)
+            enable_occupy=enable_occupy,
+            has_thread_rules=not skip_threads)
         live3 = live2 & flow_ok
 
         # occupied (PriorityWait) events bypass the degrade slot entirely —
@@ -446,23 +492,31 @@ def decide_entries(
         minute = add_one_row(spec.minute, minute, ENTRY_NODE_ROW, entry_vec,
                              now_idx_m)
 
-    ct1 = batch.count_thread
-    thr_mask1 = passed if ct1 is None else passed & ct1
-    thr_amt1 = jnp.where(thr_mask1, 1, 0)
-    # +1 per entry (reference curThreadNum); leased admissions opt out
-    threads = state.threads.at[jnp.where(passed, batch.rows, pad_r)].add(
-        thr_amt1, mode="drop")
-    threads = threads.at[ENTRY_NODE_ROW].add(
-        jnp.sum(jnp.where(thr_mask1 & ein, 1, 0)))
-    if record_alt:
-        pass2 = jnp.concatenate([passed, passed])
-        thr_amt2 = jnp.concatenate([thr_amt1, thr_amt1])
-        alt_threads = state.alt_threads.at[
-            jnp.where(pass2, alt_targets, pad_a)].add(thr_amt2, mode="drop")
-    else:
+    if skip_threads:
+        # nothing loaded reads the gauges: the scatters (+ the alt half)
+        # compile away — ~1/3 of the scalar step's floor
+        threads = state.threads
         alt_threads = state.alt_threads
+    else:
+        ct1 = batch.count_thread
+        thr_mask1 = passed if ct1 is None else passed & ct1
+        thr_amt1 = jnp.where(thr_mask1, 1, 0)
+        # +1 per entry (reference curThreadNum); leased admissions opt out
+        threads = state.threads.at[
+            jnp.where(passed, batch.rows, pad_r)].add(thr_amt1, mode="drop")
+        threads = threads.at[ENTRY_NODE_ROW].add(
+            jnp.sum(jnp.where(thr_mask1 & ein, 1, 0)))
+        if record_alt:
+            pass2 = jnp.concatenate([passed, passed])
+            thr_amt2 = jnp.concatenate([thr_amt1, thr_amt1])
+            alt_threads = state.alt_threads.at[
+                jnp.where(pass2, alt_targets, pad_a)].add(thr_amt2,
+                                                          mode="drop")
+        else:
+            alt_threads = state.alt_threads
 
-    if spec.param_keys and batch.param_rules is not None:
+    if spec.param_keys and batch.param_rules is not None and \
+            not skip_threads:
         param_dyn = pf_mod.param_thread_update(
             rules.param_table, param_dyn, batch.param_rules, batch.param_keys,
             passed, +1)
@@ -482,6 +536,7 @@ def record_exits(
     batch: ExitBatch,
     times: jnp.ndarray,          # int32[4] (same packing as decide_entries)
     record_alt: bool = True,     # STATIC (see decide_entries)
+    skip_threads: bool = False,  # STATIC (see decide_entries)
 ) -> SentinelState:
     """Completion step: ``StatisticSlot.exit`` (rt/success/exception, thread
     decrement, for node + origin + chain + ENTRY) then ``DegradeSlot.exit``
@@ -563,26 +618,32 @@ def record_exits(
                              now_idx_m, rt_add=entry_rt_add,
                              rt_min=entry_rt_min)
 
-    ct1 = batch.count_thread
-    dec1 = jnp.where(batch.valid if ct1 is None else batch.valid & ct1, 1, 0)
-    threads = state.threads.at[main_rows].add(-dec1, mode="drop")
-    threads = threads.at[ENTRY_NODE_ROW].add(
-        -jnp.sum(jnp.where(ein if ct1 is None else ein & ct1, 1, 0)))
-    threads = jnp.maximum(threads, 0)
-    if record_alt:
-        dec2 = jnp.concatenate([dec1, dec1])
-        alt_threads = state.alt_threads.at[alt_targets].add(-dec2,
-                                                           mode="drop")
-        alt_threads = jnp.maximum(alt_threads, 0)
-    else:
+    if skip_threads:
+        threads = state.threads
         alt_threads = state.alt_threads
+    else:
+        ct1 = batch.count_thread
+        dec1 = jnp.where(batch.valid if ct1 is None
+                         else batch.valid & ct1, 1, 0)
+        threads = state.threads.at[main_rows].add(-dec1, mode="drop")
+        threads = threads.at[ENTRY_NODE_ROW].add(
+            -jnp.sum(jnp.where(ein if ct1 is None else ein & ct1, 1, 0)))
+        threads = jnp.maximum(threads, 0)
+        if record_alt:
+            dec2 = jnp.concatenate([dec1, dec1])
+            alt_threads = state.alt_threads.at[alt_targets].add(-dec2,
+                                                               mode="drop")
+            alt_threads = jnp.maximum(alt_threads, 0)
+        else:
+            alt_threads = state.alt_threads
 
     breakers = deg_mod.degrade_exit_feed(
         rules.deg_table, state.breakers, rules.deg_idx, batch.rows,
         batch.rt_ms, batch.error, batch.valid, rel_now_ms)
 
     param_dyn = state.param_dyn
-    if spec.param_keys and batch.param_rules is not None:
+    if spec.param_keys and batch.param_rules is not None and \
+            not skip_threads:
         param_dyn = pf_mod.param_thread_update(
             rules.param_table, param_dyn, batch.param_rules, batch.param_keys,
             batch.valid, -1)
@@ -606,9 +667,11 @@ def decide_and_record_exits(
     custom_slots: Tuple = (),
     record_alt: bool = True,     # STATIC (see decide_entries)
     scalar_flow: bool = False,   # STATIC (see decide_entries)
+    fast_flow: bool = False,     # STATIC (see decide_entries)
     skip_auth: bool = False,     # STATIC
     skip_sys: bool = False,      # STATIC
     scalar_has_rl: bool = True,  # STATIC
+    skip_threads: bool = False,  # STATIC (see decide_entries)
 ) -> Tuple[SentinelState, Verdicts]:
     """Fused entry+exit step: one dispatch where serving loops would pay two.
 
@@ -626,10 +689,10 @@ def decide_and_record_exits(
         spec, rules, state, entry_batch, times, sys_scalars,
         enable_occupy=enable_occupy, custom_slots=custom_slots,
         record_alt=record_alt, scalar_flow=scalar_flow,
-        skip_auth=skip_auth, skip_sys=skip_sys,
-        scalar_has_rl=scalar_has_rl)
+        fast_flow=fast_flow, skip_auth=skip_auth, skip_sys=skip_sys,
+        scalar_has_rl=scalar_has_rl, skip_threads=skip_threads)
     state = record_exits(spec, rules, state, exit_batch, times,
-                         record_alt=record_alt)
+                         record_alt=record_alt, skip_threads=skip_threads)
     return state, verdicts
 
 

@@ -309,6 +309,10 @@ def flow_check(
     occupy_timeout_ms: int = 500,
     enable_occupy: bool = True,                # STATIC: trade a second jit
     # variant for zero occupy cost on batches with no prioritized events
+    has_thread_rules: bool = True,             # STATIC: False = no loaded
+    # rule reads live concurrency → the [BK] thread-gauge gathers compile
+    # away (the gauges themselves may be unmaintained then; see
+    # pipeline.decide_entries skip_threads)
 ) -> Tuple[FlowDynState, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """→ (dyn', allow bool[B], wait_ms int32[B], occupied bool[B]).
 
@@ -388,9 +392,14 @@ def flow_check(
     alt_pass = window_sum_rows(spec, alt_second, jnp.minimum(sel_alt_row, RA - 1),
                                ev.PASS, now_idx_s).astype(jnp.float32)
     cur_pass = jnp.where(use_alt, alt_pass, main_pass)
-    main_thr = main_threads[jnp.minimum(sel_main_row, R - 1)].astype(jnp.float32)
-    alt_thr = alt_threads[jnp.minimum(sel_alt_row, RA - 1)].astype(jnp.float32)
-    cur_thr = jnp.where(use_alt, alt_thr, main_thr)
+    if has_thread_rules:
+        main_thr = main_threads[jnp.minimum(sel_main_row, R - 1)].astype(
+            jnp.float32)
+        alt_thr = alt_threads[jnp.minimum(sel_alt_row, RA - 1)].astype(
+            jnp.float32)
+        cur_thr = jnp.where(use_alt, alt_thr, main_thr)
+    else:
+        cur_thr = jnp.zeros_like(cur_pass)   # no THREAD-grade rule reads it
 
     # --- warm-up token sync (vector over rules, once per step) ---
     dyn, eff_limit_per_rule = _warmup_sync_and_limits(
@@ -766,6 +775,219 @@ def flow_check_scalar(
 
     allow = allow | ~valid
     return dyn, allow, wait_ms
+
+
+def flow_check_fast(
+    table: FlowRuleTable,
+    dyn: FlowDynState,
+    rule_idx: jnp.ndarray,
+    spec: WindowSpec,
+    main_second: WindowState,
+    alt_second: WindowState,
+    main_threads: jnp.ndarray,
+    alt_threads: jnp.ndarray,
+    batch: FlowBatchView,
+    now_idx_s: jnp.ndarray,
+    rel_now_ms: jnp.ndarray,
+    minute_spec: Optional[WindowSpec] = None,
+    main_minute: Optional[WindowState] = None,
+    now_idx_m: Optional[jnp.ndarray] = None,
+    has_rate_limiter: bool = True,    # STATIC: ruleset has RL/WU-RL rules
+    has_thread_rules: bool = True,    # STATIC: see flow_check
+    rules_bk: Optional[jnp.ndarray] = None,   # [B, K] pre-gathered rule ids
+) -> Tuple[FlowDynState, jnp.ndarray, jnp.ndarray]:
+    """Fast GENERAL-path flow check → (dyn', allow bool[B], wait_ms int32[B]).
+
+    The scalar path's rank-prefix admission (:func:`flow_check_scalar`)
+    generalized to origin-bearing traffic: per-pair applicability and
+    stat-row selection (``FlowRuleChecker.selectNodeByRequesterAndStrategy``,
+    FlowRuleChecker.java:129-161) stay fully live, but the sorted
+    greedy/fixed-point machinery of :func:`flow_check` collapses to ONE
+    composite-key rank sort plus closed forms. Host-verified preconditions
+    (``runtime.decide_raw``):
+
+    * ``acquire`` uniform across valid events, value >= 1;
+    * no prioritized events and no live occupy bookings (occupy off).
+
+    Origins, alt rows, CHAIN contexts, and per-event cluster-fallback bits
+    are all allowed — that is the point.
+
+    Why it is bit-exact with :func:`flow_check` under those preconditions:
+
+    * every admission segment of the general path is keyed by
+      (rule, selected stat row); a rule's selected MAIN/REF row is a
+      function of the rule alone (a flow rule names one resource), so the
+      row sub-key matters only for SEL_ORIGIN/SEL_CHAIN pairs, whose alt
+      row is < RA — the composite int32 key
+      ``rule * (RA + 1) + (use_alt ? alt_row + 1 : 0)`` reproduces the
+      exact segmentation (RL pairs pace per RULE — sub-key 0 — matching
+      the general path's ``row_seg = 0`` for rate limiters);
+    * within a segment, base and limit are constant and amounts are the
+      uniform ``a``, so the greedy fixed point's admitted set is the rank
+      prefix ``base + rank*a + a <= limit`` (same operand association as
+      the general path's cumsum form — bit-identical while counts stay
+      under 2^24, where the cumsum itself is exact);
+    * the rate limiter collapses to the same bounded per-rule rank budget
+      ``max_k`` as the scalar path (RateLimiterController.java:30-90).
+    """
+    B = batch.rows.shape[0]
+    K = rule_idx.shape[1]
+    NF = table.active.shape[0] - 1
+    R = rule_idx.shape[0]
+    RA = alt_threads.shape[0]
+    # composite key must fit int32 (static shapes → checked at trace time;
+    # the runtime host gate checks the same product before selecting this
+    # variant and falls back to flow_check otherwise)
+    assert (NF + 1) * (RA + 1) < 2 ** 31, \
+        "rule-capacity x alt-rows too large for the fast general path"
+
+    if rules_bk is None:
+        rules_bk = seg.padded_table_gather(rule_idx, batch.rows, NF)  # [B,K]
+
+    # ---- per-rule step state ----
+    dyn, eff_limit = _warmup_sync_and_limits(
+        table, dyn, spec, main_second, now_idx_s, rel_now_ms,
+        minute_spec, main_minute, now_idx_m)
+    is_rl_rule = (((table.behavior == BEHAVIOR_RATE_LIMITER)
+                   | (table.behavior == BEHAVIOR_WARM_UP_RATE_LIMITER))
+                  & (table.grade == GRADE_QPS))
+
+    # RL closed form, per rule — identical math to flow_check_scalar
+    acq_of_rule = jnp.float32(0) + jnp.max(
+        jnp.where(batch.valid, batch.acquire, 0)).astype(jnp.float32)
+    count_safe = jnp.maximum(table.count, 1e-9)
+    cost = jnp.round(acq_of_rule / count_safe * 1000.0).astype(jnp.int32)
+    L0 = dyn.latest_passed_ms
+    due = (L0 + cost - rel_now_ms) <= 0
+    base_time = jnp.where(due, rel_now_ms - cost, L0)
+    maxq_eff = jnp.where(table.count > 0, table.max_queue_ms, jnp.int32(-1))
+    rl_numer = rel_now_ms + maxq_eff - base_time
+    max_k = jnp.maximum(rl_numer // jnp.maximum(cost, 1), 0)
+    wait0_ok = jnp.maximum(base_time - rel_now_ms, 0) <= maxq_eff
+    max_k = jnp.where(cost > 0, max_k,
+                      jnp.where(wait0_ok, jnp.int32(2 ** 30), 0))
+    max_k = jnp.where(table.count > 0, max_k, 0)
+
+    # ---- per-EVENT stat reads ([B]-sized; the general path gathered all
+    # of these per PAIR from the 1M-row table) ----
+    safe_rows = jnp.minimum(batch.rows, R - 1)
+    ev_pass = window_sum_rows(spec, main_second, safe_rows, ev.PASS,
+                              now_idx_s).astype(jnp.float32)
+    safe_orow = jnp.minimum(batch.origin_rows, RA - 1)
+    safe_crow = jnp.minimum(batch.chain_rows, RA - 1)
+    or_pass = window_sum_rows(spec, alt_second, safe_orow, ev.PASS,
+                              now_idx_s).astype(jnp.float32)
+    cr_pass = window_sum_rows(spec, alt_second, safe_crow, ev.PASS,
+                              now_idx_s).astype(jnp.float32)
+    if has_thread_rules:
+        ev_thr = main_threads[safe_rows].astype(jnp.float32)
+        or_thr = alt_threads[safe_orow].astype(jnp.float32)
+        cr_thr = alt_threads[safe_crow].astype(jnp.float32)
+
+    # per-rule REF-row reads (ref_row is a rule attribute, [NF+1]-sized)
+    srow_ref = jnp.minimum(table.ref_row, R - 1)
+    ref_pass = window_sum_rows(spec, main_second, srow_ref, ev.PASS,
+                               now_idx_s).astype(jnp.float32)
+
+    # ---- ONE packed per-rule gather [NF+1, C] → [B, K, C] (columns
+    # 11/12 exist only when a THREAD-grade rule is loaded) ----
+    cols = [
+        table.active.astype(jnp.int32),                      # 0
+        table.limit_origin,                                  # 1
+        table.cluster_mode.astype(jnp.int32),                # 2
+        table.sel_kind,                                      # 3
+        table.ref_context,                                   # 4
+        is_rl_rule.astype(jnp.int32),                        # 5
+        base_time,                                           # 6
+        cost,                                                # 7
+        max_k,                                               # 8
+        lax.bitcast_convert_type(eff_limit, jnp.int32),      # 9
+        lax.bitcast_convert_type(ref_pass, jnp.int32),       # 10
+    ]
+    if has_thread_rules:
+        ref_thr = main_threads[srow_ref].astype(jnp.float32)
+        cols += [
+            lax.bitcast_convert_type(ref_thr, jnp.int32),    # 11
+            table.grade,                                     # 12
+        ]
+    vt = jnp.stack(cols, axis=1)
+    g = vt[rules_bk]                                         # [B, K, C]
+
+    # ---- applicability (FlowRuleChecker.checkFlow null-node selection) ----
+    act = g[..., 0] != 0
+    lim = g[..., 1]
+    oid = batch.origin_ids[:, None]
+    specific_hit = jnp.any((lim == oid) & act, axis=1)[:, None]
+    app = act & ((lim == LIMIT_DEFAULT) | (lim == oid)
+                 | ((lim == LIMIT_OTHER) & ~specific_hit & (oid != 0)))
+    slot_k = jnp.arange(K, dtype=jnp.int32)[None, :]
+    fb = (batch.cluster_fallback[:, None] >> slot_k) & 1
+    app = app & ((g[..., 2] == 0) | (fb == 1))
+    kind = g[..., 3]
+    app = app & jnp.where(kind == SEL_CHAIN,
+                          batch.context_ids[:, None] == g[..., 4], True)
+    use_alt = (kind == SEL_ORIGIN) | (kind == SEL_CHAIN)
+    alt_row = jnp.where(kind == SEL_CHAIN, batch.chain_rows[:, None],
+                        batch.origin_rows[:, None])
+    app = app & jnp.where(use_alt, alt_row < RA, True)
+    valid_pair = batch.valid[:, None] & app
+
+    # ---- per-pair base (selected stat row's count) ----
+    ref_pass_p = lax.bitcast_convert_type(g[..., 10], jnp.float32)
+    main_pass_p = jnp.where(kind == SEL_REF, ref_pass_p, ev_pass[:, None])
+    alt_pass_p = jnp.where(kind == SEL_CHAIN, cr_pass[:, None],
+                           or_pass[:, None])
+    cur_pass = jnp.where(use_alt, alt_pass_p, main_pass_p)
+    if has_thread_rules:
+        ref_thr_p = lax.bitcast_convert_type(g[..., 11], jnp.float32)
+        main_thr_p = jnp.where(kind == SEL_REF, ref_thr_p,
+                               ev_thr[:, None])
+        alt_thr_p = jnp.where(kind == SEL_CHAIN, cr_thr[:, None],
+                              or_thr[:, None])
+        cur_thr = jnp.where(use_alt, alt_thr_p, main_thr_p)
+        base = jnp.where(g[..., 12] == GRADE_QPS, cur_pass, cur_thr)
+    else:
+        base = cur_pass              # no THREAD-grade rule reads the gauge
+
+    # ---- composite-key arrival ranks (the only cross-event pass) ----
+    rl_p = g[..., 5] != 0
+    subrow = jnp.where(use_alt & ~rl_p, alt_row + 1, 0)
+    key = rules_bk * (RA + 1) + subrow
+    key = jnp.where(valid_pair, key, NF * (RA + 1))
+    rank = seg.ranks_by_key(key.reshape(-1)).reshape(B, K)
+
+    # ---- admission (closed forms) ----
+    a_f = acq_of_rule                       # the uniform acquire, float32
+    rankf = rank.astype(jnp.float32)
+    limit_pair = lax.bitcast_convert_type(g[..., 9], jnp.float32)
+    pass_default = (base + rankf * a_f) + a_f <= limit_pair
+    pass_rl = rank < g[..., 8]
+    safe_rank = jnp.minimum(rank, g[..., 8])
+    wait_pair = jnp.maximum(
+        g[..., 6] + (safe_rank + 1) * g[..., 7] - rel_now_ms, 0)
+    pair_pass = jnp.where(rl_p, pass_rl, pass_default) | ~valid_pair
+    pair_wait = jnp.where(rl_p & pair_pass & valid_pair, wait_pair, 0)
+
+    allow = jnp.all(pair_pass, axis=1)
+    wait_ms = jnp.max(pair_wait, axis=1)
+
+    # ---- pacing-clock update (per rule; RL segments are per-rule) ----
+    if has_rate_limiter:
+        rl_valid = rl_p & valid_pair
+        npairs = jnp.zeros((NF + 2,), jnp.int32).at[
+            jnp.where(rl_valid, rules_bk, NF + 1)].max(
+            rank + 1, mode="drop")[:NF + 1]
+        passed = jnp.minimum(npairs, max_k)
+        passed = jnp.where(is_rl_rule & (table.count > 0), passed, 0)
+        new_latest = jnp.where(
+            passed > 0,
+            (base_time + passed * cost).astype(jnp.int32),
+            dyn.latest_passed_ms)
+        dyn = dyn._replace(
+            latest_passed_ms=jnp.maximum(dyn.latest_passed_ms, new_latest))
+
+    allow = allow | ~batch.valid
+    return dyn, allow, wait_ms.astype(jnp.int32)
 
 
 def _warmup_sync_and_limits(

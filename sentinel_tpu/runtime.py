@@ -85,8 +85,9 @@ def _build_steps(spec: EngineSpec, custom_slots: tuple, shardings=None):
         return jax.jit(functools.partial(
             decide_entries, spec, enable_occupy=occ,
             custom_slots=custom_slots, record_alt=alt),
-            static_argnames=("scalar_flow", "skip_auth", "skip_sys",
-                            "scalar_has_rl"), **kw_sv)
+            static_argnames=("scalar_flow", "fast_flow", "skip_auth",
+                             "skip_sys", "scalar_has_rl",
+                             "skip_threads"), **kw_sv)
 
     # jit objects are lazy (tracing happens on first call), so building all
     # variants is free; the *_noalt ones compile away the origin/chain
@@ -94,9 +95,11 @@ def _build_steps(spec: EngineSpec, custom_slots: tuple, shardings=None):
     # origin-less case — two fewer million-index scatters per step)
     return (dec(False, True), dec(True, True),
             dec(False, False), dec(True, False),
-            jax.jit(functools.partial(record_exits, spec), **kw_s),
+            jax.jit(functools.partial(record_exits, spec),
+                    static_argnames=("skip_threads",), **kw_s),
             jax.jit(functools.partial(record_exits, spec,
-                                      record_alt=False), **kw_s),
+                                      record_alt=False),
+                    static_argnames=("skip_threads",), **kw_s),
             jax.jit(functools.partial(invalidate_resource_rows, spec), **kw_s),
             jax.jit(functools.partial(record_blocks, spec), **kw_s))
 
@@ -451,6 +454,36 @@ class Sentinel:
             and r.grade == flow_mod.GRADE_QPS for r in self._flow.rules)
         self._skip_auth = self._auth.num_active == 0
         self._skip_sys = not getattr(self, "_sys_rules", [])
+        # Thread-gauge elision: nothing loaded READS live concurrency →
+        # the gauge-maintenance scatters compile away (the only readers:
+        # THREAD-grade flow rules — DefaultController.java:50-76, system
+        # rules — SystemRuleManager.checkSystem, THREAD-grade param rules
+        # — ParamFlowChecker). Gauges read 0 while elided; loading a
+        # reader flips the flag (retrace) and the gauge warms as pre-flip
+        # entries exit (decrements clamp at 0). See docs/OPERATIONS.md.
+        prev_skip = getattr(self, "_skip_threads", None)
+        self._skip_threads = (
+            not self.cfg.thread_gauge_always
+            and self._skip_sys
+            and not any(r.grade == flow_mod.GRADE_THREAD
+                        for r in self._flow.rules)
+            and not any(r.grade == pf_mod.GRADE_THREAD
+                        for r in self._param.rules))
+        if prev_skip is not None and prev_skip != self._skip_threads \
+                and hasattr(self, "_state"):
+            # Flag flip invalidates the gauges: entries counted while
+            # maintenance was ON would otherwise leak a permanent
+            # OVER-count when their elided exits never decrement (e.g.
+            # unload the THREAD rule, exits happen elided, reload one).
+            # Zeroing restores the documented contract — transient
+            # under-count only, gauges warm as live entries exit
+            # (decrements clamp at 0). `x * 0` keeps mesh sharding.
+            st = self._state
+            self._state = st._replace(
+                threads=st.threads * 0,
+                alt_threads=st.alt_threads * 0,
+                param_dyn=st.param_dyn._replace(
+                    threads=st.param_dyn.threads * 0))
         return RuleSet(
             flow_table=self._flow.table,
             flow_idx=flow_idx,
@@ -1734,35 +1767,79 @@ class Sentinel:
         """:meth:`decide_raw` with the verdict readback deferred: the step
         is dispatched (state already advanced in order under the lock) and
         the device→host verdict copy started async; ``.result()``
-        materializes. The double-buffering primitive for serving paths."""
+        materializes. The double-buffering primitive for serving paths.
+
+        Path selection (host-verified; see rules/flow.py for the variants):
+
+        * all events scalar-eligible → scalar admission path;
+        * origin-bearing events present, uniform acquire, occupy off →
+          the fast general path (whole batch), or a PER-EVENT SPLIT when
+          the batch mixes both kinds — one origin event no longer demotes
+          the entire batch to the sorted path;
+        * otherwise (non-uniform acquire, occupy live) → general path.
+        """
         n = rows.shape[0]
-        b = self._pad(n)
-        pad_r = self.spec.rows
         pad_a = self.spec.alt_rows
-        # batches with no real origin/chain rows (everything padding) take
-        # the *_noalt step variants: the alt-table scatters compile away
-        no_alt = self._batch_has_no_alt(origin_rows, chain_rows)
-        batch = EntryBatch(
-            rows=_pad_to(rows, b, pad_r, np.int32),
-            origin_ids=_pad_to(origin_ids, b, 0, np.int32),
-            origin_rows=_pad_to(origin_rows, b, pad_a, np.int32),
-            context_ids=_pad_to(context_ids, b, 0, np.int32),
-            chain_rows=_pad_to(chain_rows, b, pad_a, np.int32),
-            acquire=_pad_to(acquire, b, 0, np.int32),
-            is_in=_pad_to(is_in, b, False, np.bool_),
-            prioritized=_pad_to(prioritized, b, False, np.bool_),
-            valid=_pad_to(valid if valid is not None
-                          else np.ones(n, np.bool_), b, False, np.bool_),
-            param_rules=self._pad_pairs(param_rules, b, self.cfg.max_param_rules),
-            param_keys=self._pad_pairs(param_keys, b, self.spec.param_keys),
-            cluster_fallback=(_pad_to(cluster_fallback, b, 0, np.int32)
-                              if cluster_fallback is not None else None),
-            count_thread=(_pad_to(count_thread, b, False, np.bool_)
-                          if count_thread is not None else None),
-            record_block=(_pad_to(record_block, b, False, np.bool_)
-                          if record_block is not None else None),
-        )
+        # ---- host-side eligibility (numpy, before any padding) ----
+        # Only lanes the caller marked valid count: arbitrary values on
+        # invalid lanes are masked device-side and must not disqualify a
+        # fast path. A shorter `valid` is legal (pad_to fills False).
+        vfull = np.ones(n, np.bool_)
+        if valid is not None:
+            vsrc = np.asarray(valid, bool)
+            m = min(n, vsrc.shape[0])
+            vfull[:] = False
+            vfull[:m] = vsrc[:m]
+        acq_np = np.asarray(acquire)
+        oid_np = np.asarray(origin_ids)
+        acq_v = acq_np if valid is None else acq_np[vfull]
+        acq_uniform = (acq_v.size > 0
+                       and int(acq_v.min()) == int(acq_v.max()) >= 1)
+        oid_v = oid_np if valid is None else oid_np[vfull]
+        no_origin_ids = int(np.max(oid_v, initial=0)) == 0
+        no_alt_rows = self._batch_has_no_alt(origin_rows, chain_rows)
+        # the fast general path's composite rank key must fit int32
+        key_fits = (self._ruleset.flow_table.active.shape[0]
+                    * (pad_a + 1)) < 2 ** 31
+        any_prio = bool(np.asarray(prioritized).any())
         now = self.clock.now_ms() if at_ms is None else at_ms
+
+        # ---- per-event split (optimistic occupy check; re-verified
+        # under the lock by _decide_split_nowait). The dominant pure-
+        # scalar batch short-circuits on the aggregate checks above and
+        # never materializes the per-event mask (hot dispatch path).
+        pure_scalar = (no_origin_ids and no_alt_rows
+                       and cluster_fallback is None)
+        if (not pure_scalar and acq_uniform and key_fits and not any_prio
+                and now >= self._occupy_live_until_ms):
+            # per-event scalar eligibility: no origin id (origin-limited
+            # RELATE rules match on the ID, not the row), no real alt
+            # rows, no cluster-fallback bits; invalid lanes scalar-safe
+            ev_scalar = ((oid_np == 0)
+                         & (np.asarray(origin_rows) >= pad_a)
+                         & (np.asarray(chain_rows) >= pad_a))
+            if cluster_fallback is not None:
+                ev_scalar = ev_scalar & (np.asarray(cluster_fallback) == 0)
+            ev_scalar = ev_scalar | ~vfull
+            n_general_v = int(np.count_nonzero(~ev_scalar & vfull))
+            n_scalar_v = int(np.count_nonzero(ev_scalar & vfull))
+            if n_general_v > 0 and n_scalar_v >= 4096:
+                return self._decide_split_nowait(
+                    rows, origin_ids, origin_rows, context_ids, chain_rows,
+                    acquire, is_in, ev_scalar, vfull,
+                    param_rules=param_rules, param_keys=param_keys,
+                    param_gen=param_gen, cluster_fallback=cluster_fallback,
+                    count_thread=count_thread, record_block=record_block,
+                    now=now)
+
+        batch = self._build_entry_batch(
+            rows, origin_ids, origin_rows, context_ids, chain_rows,
+            acquire, is_in, prioritized, vfull, param_rules, param_keys,
+            cluster_fallback, count_thread, record_block)
+        # no_alt_rows (computed above) is about ROWS only: batches with no
+        # real origin/chain rows take the *_noalt step variants (the
+        # alt-table scatters compile away; origin ids without rows are
+        # fine for the elision — the fast path matches them by ID)
         times = self._time_scalars(now)
         load1, cpu = self._cpu.sample()
         sys_scalars = jnp.asarray(np.array([load1, cpu], np.float32))
@@ -1778,48 +1855,31 @@ class Sentinel:
             # when this batch is prioritized OR a previous booking can
             # still be live (bookings last ≤ B+1 windows); everything else
             # compiles to a pipeline with zero occupy code
-            any_prio = bool(prioritized.any())
             if any_prio:
                 self._occupy_live_until_ms = now + (
                     (self.spec.second.buckets + 1)
                     * self.spec.second.win_ms)
             use_occ = any_prio or now < self._occupy_live_until_ms
-            if no_alt:
+            if no_alt_rows:
                 decide = (self._jit_decide_prio_noalt if use_occ
                           else self._jit_decide_noalt)
             else:
                 decide = (self._jit_decide_prio if use_occ
                           else self._jit_decide)
-            # Scalar admission path (rules/flow.flow_check_scalar): all
-            # preconditions host-verified here — alt-free batch AND no
-            # origin ids (a raw-API caller may pass origin_ids with
-            # padding origin_rows, and origin-limited RELATE rules match
-            # on the ID, not the row), occupy off, no per-event
-            # cluster-fallback bits, uniform acquire. skip_auth/skip_sys
-            # elide empty slots (static flags, tracked by _build_ruleset).
-            # Eligibility looks only at lanes the caller marked valid:
-            # arbitrary acquire/origin values on invalid lanes are masked
-            # device-side anyway, so they must not disqualify the scalar
-            # path (performance-only — the math never sees them).
-            acq = np.asarray(acquire)
-            oid = np.asarray(origin_ids)
-            if valid is not None:
-                # a shorter `valid` is legal (pad_to fills False: the
-                # tail is invalid) — extend with False before masking
-                vmask = np.zeros(acq.shape[0], bool)
-                vsrc = np.asarray(valid, bool)
-                m = min(vmask.shape[0], vsrc.shape[0])
-                vmask[:m] = vsrc[:m]
-                acq = acq[vmask]
-                oid = oid[vmask[:oid.shape[0]]]
-            acq_uniform = (acq.size > 0
-                           and int(acq.min()) == int(acq.max()) >= 1)
-            no_origin_ids = int(np.max(oid, initial=0)) == 0
             flags = {"skip_auth": self._skip_auth,
-                     "skip_sys": self._skip_sys}
-            if (no_alt and no_origin_ids and not use_occ
+                     "skip_sys": self._skip_sys,
+                     "skip_threads": self._skip_threads}
+            if (no_alt_rows and no_origin_ids and not use_occ
                     and cluster_fallback is None and acq_uniform):
+                # scalar admission path (rules/flow.flow_check_scalar);
+                # requires the row-based no_alt (the step variant must be
+                # record_alt=False for the scalar assertion)
                 flags["scalar_flow"] = True
+                flags["scalar_has_rl"] = self._scalar_has_rl
+            elif acq_uniform and key_fits and not use_occ:
+                # fast general path: origins/alt rows/fallback bits live,
+                # rank closed-form admission (rules/flow.flow_check_fast)
+                flags["fast_flow"] = True
                 flags["scalar_has_rl"] = self._scalar_has_rl
             state, verdicts = decide(
                 self._ruleset, self._state, batch, times, sys_scalars,
@@ -1844,6 +1904,144 @@ class Sentinel:
                     brk[0], brk[1],
                     [int(s) for s in np.asarray(brk[2][:-1])])
             return out
+
+        return PendingVerdicts(_read)
+
+    def _build_entry_batch(self, rows, origin_ids, origin_rows, context_ids,
+                           chain_rows, acquire, is_in, prioritized, vfull,
+                           param_rules, param_keys, cluster_fallback,
+                           count_thread, record_block) -> EntryBatch:
+        """Pad raw numpy event arrays into a device EntryBatch (shared by
+        the whole-batch and split dispatch paths)."""
+        n = rows.shape[0]
+        b = self._pad(n)
+        pad_r = self.spec.rows
+        pad_a = self.spec.alt_rows
+        return EntryBatch(
+            rows=_pad_to(rows, b, pad_r, np.int32),
+            origin_ids=_pad_to(origin_ids, b, 0, np.int32),
+            origin_rows=_pad_to(origin_rows, b, pad_a, np.int32),
+            context_ids=_pad_to(context_ids, b, 0, np.int32),
+            chain_rows=_pad_to(chain_rows, b, pad_a, np.int32),
+            acquire=_pad_to(acquire, b, 0, np.int32),
+            is_in=_pad_to(is_in, b, False, np.bool_),
+            prioritized=_pad_to(prioritized, b, False, np.bool_),
+            valid=_pad_to(vfull, b, False, np.bool_),
+            param_rules=self._pad_pairs(param_rules, b,
+                                        self.cfg.max_param_rules),
+            param_keys=self._pad_pairs(param_keys, b, self.spec.param_keys),
+            cluster_fallback=(_pad_to(cluster_fallback, b, 0, np.int32)
+                              if cluster_fallback is not None else None),
+            count_thread=(_pad_to(count_thread, b, False, np.bool_)
+                          if count_thread is not None else None),
+            record_block=(_pad_to(record_block, b, False, np.bool_)
+                          if record_block is not None else None),
+        )
+
+    def _decide_split_nowait(self, rows, origin_ids, origin_rows,
+                             context_ids, chain_rows, acquire, is_in,
+                             ev_scalar, vfull, *, param_rules, param_keys,
+                             param_gen, cluster_fallback, count_thread,
+                             record_block, now) -> "PendingVerdicts":
+        """Mixed-batch dispatch: scalar-eligible events take the scalar
+        step, origin-bearing ones the fast general step — one origin
+        event no longer demotes the whole batch off the fast paths.
+
+        The two sub-steps run scalar-first under one dispatch-lock hold.
+        That is a legitimate serialization of the batch: intra-batch
+        ordering is already a batching artifact (the reference's
+        concurrent callers race the same way), and each sub-step is
+        bit-exact with the general path over its own events
+        (tests/test_split_dispatch.py pins split == sequential).
+        Callers never pass `prioritized` here (any_prio disables the
+        split), so both sub-batches are occupy-free by construction."""
+        n = rows.shape[0]
+        idx_s = np.nonzero(ev_scalar)[0]
+        idx_g = np.nonzero(~ev_scalar)[0]
+
+        def take(arr, idx):
+            return None if arr is None else np.asarray(arr)[idx]
+
+        zeros_s = np.zeros(idx_s.shape[0], np.bool_)
+        zeros_g = np.zeros(idx_g.shape[0], np.bool_)
+        bs = self._build_entry_batch(
+            take(rows, idx_s), take(origin_ids, idx_s),
+            take(origin_rows, idx_s), take(context_ids, idx_s),
+            take(chain_rows, idx_s), take(acquire, idx_s),
+            take(is_in, idx_s), zeros_s, vfull[idx_s],
+            take(param_rules, idx_s), take(param_keys, idx_s),
+            None, take(count_thread, idx_s), take(record_block, idx_s))
+        orow_g = take(origin_rows, idx_g)
+        crow_g = take(chain_rows, idx_g)
+        bg = self._build_entry_batch(
+            take(rows, idx_g), take(origin_ids, idx_g), orow_g,
+            take(context_ids, idx_g), crow_g, take(acquire, idx_g),
+            take(is_in, idx_g), zeros_g, vfull[idx_g],
+            take(param_rules, idx_g), take(param_keys, idx_g),
+            take(cluster_fallback, idx_g), take(count_thread, idx_g),
+            take(record_block, idx_g))
+        no_alt_g = self._batch_has_no_alt(orow_g, crow_g)
+        times = self._time_scalars(now)
+        load1, cpu = self._cpu.sample()
+        sys_scalars = jnp.asarray(np.array([load1, cpu], np.float32))
+        with self._lock:
+            if bs.param_rules is not None and param_gen != self._param_gen:
+                bs = bs._replace(param_rules=None, param_keys=None)
+                bg = bg._replace(param_rules=None, param_keys=None)
+            self._drain_evictions_locked()
+            self._seen_idx = max(self._seen_idx,
+                                 self.spec.second.index_of(now))
+            flags = {"skip_auth": self._skip_auth,
+                     "skip_sys": self._skip_sys,
+                     "skip_threads": self._skip_threads}
+            # re-verify the optimistic occupy check: a concurrent
+            # prioritized batch may have gone live since — then both
+            # sides must take the occupy-aware general step (bookings
+            # count toward admission sums for every event)
+            if now < self._occupy_live_until_ms:
+                dec_s, fl_s = self._jit_decide_prio_noalt, flags
+                dec_g = (self._jit_decide_prio_noalt if no_alt_g
+                         else self._jit_decide_prio)
+                fl_g = flags
+            else:
+                dec_s = self._jit_decide_noalt
+                fl_s = dict(flags, scalar_flow=True,
+                            scalar_has_rl=self._scalar_has_rl)
+                dec_g = (self._jit_decide_noalt if no_alt_g
+                         else self._jit_decide)
+                fl_g = dict(flags, fast_flow=True,
+                            scalar_has_rl=self._scalar_has_rl)
+            state, v1 = dec_s(self._ruleset, self._state, bs, times,
+                              sys_scalars, **fl_s)
+            state, v2 = dec_g(self._ruleset, state, bg, times,
+                              sys_scalars, **fl_g)
+            self._state = state
+            brk = None
+            if self._breaker_observers:
+                self._breaker_seq += 1
+                brk = (self._breaker_seq, self._deg.rules,
+                       state.breakers.state)
+        start_host_copy((v1.allow, v1.reason, v1.wait_ms,
+                         v2.allow, v2.reason, v2.wait_ms)
+                        + ((brk[2],) if brk else ()))
+        n_s = idx_s.shape[0]
+        n_g = idx_g.shape[0]
+
+        def _read() -> Verdicts:
+            allow = np.empty(n, np.bool_)
+            reason = np.empty(n, np.int8)
+            wait = np.empty(n, np.int32)
+            allow[idx_s] = np.asarray(v1.allow)[:n_s]
+            reason[idx_s] = np.asarray(v1.reason)[:n_s]
+            wait[idx_s] = np.asarray(v1.wait_ms)[:n_s]
+            allow[idx_g] = np.asarray(v2.allow)[:n_g]
+            reason[idx_g] = np.asarray(v2.reason)[:n_g]
+            wait[idx_g] = np.asarray(v2.wait_ms)[:n_g]
+            if brk is not None:
+                self._diff_and_fire_breakers(
+                    brk[0], brk[1],
+                    [int(s) for s in np.asarray(brk[2][:-1])])
+            return Verdicts(allow=allow, reason=reason, wait_ms=wait)
 
         return PendingVerdicts(_read)
 
@@ -1886,7 +2084,8 @@ class Sentinel:
                          if self._batch_has_no_alt(origin_rows, chain_rows)
                          else self._jit_exit)
             self._state = exit_step(self._ruleset, self._state, batch,
-                                    times)
+                                    times,
+                                    skip_threads=self._skip_threads)
             # exit feeds resolve probes / trip breakers: with observers
             # registered, this call pays one small state read so the
             # observer fires within the exit call that caused the arc

@@ -1,0 +1,245 @@
+"""Fast general-path parity: flow_check_fast must be bit-exact with the
+sorted general path (flow_check) on ORIGIN-BEARING traffic under its
+preconditions (uniform acquire >= 1, no prioritized events, occupy off) —
+origins, alt rows, CHAIN contexts, RELATE refs, limitApp-specific/other
+rules, and per-event cluster-fallback bits all live.
+
+Reference semantics under test: FlowRuleChecker.checkFlow:44-80 (every-rule
+gate + null-node trivial pass), FlowRuleChecker
+.selectNodeByRequesterAndStrategy:129-161 (limitApp x strategy row
+selection), DefaultController.canPass:50-76, RateLimiterController:30-90,
+WarmUpController:66-190.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.engine.pipeline import (
+    EntryBatch, ExitBatch, decide_entries, record_exits,
+)
+
+
+def make_sentinel(clock, **cfg_over):
+    cfg = stpu.load_config(max_resources=64, max_origins=32,
+                           max_flow_rules=32, max_degrade_rules=16,
+                           max_authority_rules=16, minute_enabled=True,
+                           **cfg_over)
+    return stpu.Sentinel(config=cfg, clock=clock)
+
+
+@pytest.fixture
+def clk():
+    return ManualClock(start_ms=1_785_000_000_000)
+
+
+def _rules():
+    return [
+        stpu.FlowRule(resource="qps", count=5.0),
+        stpu.FlowRule(resource="qps", count=3.0, limit_app="app-a"),
+        stpu.FlowRule(resource="qps2", count=2.0, limit_app="other"),
+        stpu.FlowRule(resource="thread", count=4.0,
+                      grade=stpu.GRADE_THREAD),
+        stpu.FlowRule(resource="thread", count=2.0, limit_app="app-b",
+                      grade=stpu.GRADE_THREAD),
+        stpu.FlowRule(resource="warm", count=50.0,
+                      control_behavior=stpu.BEHAVIOR_WARM_UP,
+                      warm_up_period_sec=10),
+        stpu.FlowRule(resource="paced", count=10.0,
+                      control_behavior=stpu.BEHAVIOR_RATE_LIMITER,
+                      max_queueing_time_ms=400),
+        stpu.FlowRule(resource="paced", count=6.0, limit_app="app-a",
+                      control_behavior=stpu.BEHAVIOR_RATE_LIMITER,
+                      max_queueing_time_ms=300),
+        stpu.FlowRule(resource="wurl", count=8.0,
+                      control_behavior=stpu.BEHAVIOR_WARM_UP_RATE_LIMITER,
+                      max_queueing_time_ms=300, warm_up_period_sec=5),
+        stpu.FlowRule(resource="rel", count=4.0,
+                      strategy=stpu.STRATEGY_RELATE, ref_resource="qps"),
+        stpu.FlowRule(resource="rel", count=2.0, limit_app="app-a",
+                      strategy=stpu.STRATEGY_RELATE, ref_resource="qps2"),
+        stpu.FlowRule(resource="chain", count=1.0,
+                      strategy=stpu.STRATEGY_CHAIN,
+                      ref_resource="some_ctx"),
+        stpu.FlowRule(resource="clus", count=1.0, cluster_mode=True,
+                      cluster_flow_id=77),
+        stpu.FlowRule(resource="zero_rl", count=0.0,
+                      control_behavior=stpu.BEHAVIOR_RATE_LIMITER),
+    ]
+
+
+DEG_RULES = [
+    stpu.DegradeRule(resource="qps", grade=stpu.GRADE_EXCEPTION_RATIO,
+                     count=0.5, time_window=2, min_request_amount=3),
+    stpu.DegradeRule(resource="brk", grade=stpu.GRADE_EXCEPTION_COUNT,
+                     count=2, time_window=1, min_request_amount=2),
+]
+
+RESOURCES = ["qps", "qps2", "thread", "warm", "paced", "wurl", "rel",
+             "chain", "clus", "zero_rl", "free1", "brk"]
+
+
+def _origin_batch(sph, rng, n, resources, origin_ids, ctx_ids, acquire=1,
+                  fallback=False):
+    """Random batch where ~2/3 of events carry an origin (real hashed alt
+    row), some carry chain rows / matching contexts, and (optionally)
+    random cluster-fallback bits."""
+    spec = sph.spec
+    names = [resources[i] for i in rng.integers(0, len(resources), n)]
+    rows = np.array([sph.resources.get_or_create(r) for r in names],
+                    np.int32)
+    valid = rng.random(n) > 0.15
+    has_o = rng.random(n) > 0.33
+    oid = np.where(has_o, origin_ids[rng.integers(0, len(origin_ids), n)],
+                   0).astype(np.int32)
+    orow = np.full(n, spec.alt_rows, np.int32)
+    for i in np.nonzero(has_o)[0]:
+        orow[i] = sph._alt_row(int(rows[i]), 0, int(oid[i]))
+    has_c = rng.random(n) > 0.5
+    cid = np.where(has_c, ctx_ids[rng.integers(0, len(ctx_ids), n)],
+                   0).astype(np.int32)
+    crow = np.full(n, spec.alt_rows, np.int32)
+    for i in np.nonzero(has_c)[0]:
+        crow[i] = sph._alt_row(int(rows[i]), 1, int(cid[i]))
+    fb = (rng.integers(0, 4, n).astype(np.int32) if fallback
+          else np.zeros(n, np.int32))
+    return EntryBatch(
+        rows=jnp.asarray(rows),
+        origin_ids=jnp.asarray(oid),
+        origin_rows=jnp.asarray(orow),
+        context_ids=jnp.asarray(cid),
+        chain_rows=jnp.asarray(crow),
+        acquire=jnp.full(n, acquire, jnp.int32),
+        is_in=jnp.asarray(rng.random(n) > 0.3),
+        prioritized=jnp.zeros(n, jnp.bool_),
+        valid=jnp.asarray(valid),
+        cluster_fallback=jnp.asarray(fb))
+
+
+def _steps(sph):
+    spec = sph.spec
+    gen = jax.jit(functools.partial(
+        decide_entries, spec, enable_occupy=False, record_alt=True))
+    fast = jax.jit(functools.partial(
+        decide_entries, spec, enable_occupy=False, record_alt=True,
+        fast_flow=True))
+    return gen, fast
+
+
+def _assert_state_equal(s1, s2):
+    for a, b in zip(jax.tree.leaves(s1), jax.tree.leaves(s2)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            "state leaf diverged"
+
+
+@pytest.mark.parametrize("acquire", [1, 3])
+def test_fast_flow_parity_origin_mix(clk, acquire):
+    """Randomized origin-bearing batches over every rule family x window
+    rotation: verdicts, wait_ms, reasons, and ALL device state bit-equal
+    between the fast and general paths."""
+    sph = make_sentinel(clk)
+    sph.load_flow_rules(_rules())
+    sph.load_degrade_rules(DEG_RULES)
+    origin_ids = np.array([sph.origins.pin("app-a"), sph.origins.pin("app-b"),
+                           sph.origins.pin("app-c")], np.int32)
+    ctx_ids = np.array([sph.contexts.pin("some_ctx"),
+                        sph.contexts.pin("other_ctx")], np.int32)
+    rng = np.random.default_rng(11)
+    gen, fast = _steps(sph)
+    s1 = s2 = sph._state
+    sysv = jnp.asarray(np.array([0.1, 0.1], np.float32))
+    for step in range(14):
+        b = _origin_batch(sph, rng, 64, RESOURCES, origin_ids, ctx_ids,
+                          acquire=acquire, fallback=(step % 3 == 0))
+        times = sph._time_scalars(clk.now_ms())
+        s1, v1 = gen(sph._ruleset, s1, b, times, sysv)
+        s2, v2 = fast(sph._ruleset, s2, b, times, sysv)
+        assert np.array_equal(np.asarray(v1.allow), np.asarray(v2.allow)), \
+            f"allow diverged at step {step}"
+        assert np.array_equal(np.asarray(v1.wait_ms),
+                              np.asarray(v2.wait_ms)), \
+            f"wait_ms diverged at step {step}"
+        assert np.array_equal(np.asarray(v1.reason),
+                              np.asarray(v2.reason)), \
+            f"reason diverged at step {step}"
+        _assert_state_equal(s1, s2)
+        clk.advance_ms(int(rng.integers(20, 400)))
+
+
+def test_fast_flow_parity_with_exits_and_breakers(clk):
+    """Entry+exit sequences (thread gauges move, breakers trip/probe):
+    state stays bit-equal — the alt thread gauges feed the THREAD-grade
+    origin rules, so this pins the per-pair row selection too."""
+    sph = make_sentinel(clk)
+    sph.load_flow_rules(_rules())
+    sph.load_degrade_rules(DEG_RULES)
+    origin_ids = np.array([sph.origins.pin("app-a"),
+                           sph.origins.pin("app-b")], np.int32)
+    ctx_ids = np.array([sph.contexts.pin("some_ctx")], np.int32)
+    rng = np.random.default_rng(12)
+    gen, fast = _steps(sph)
+    ex = jax.jit(functools.partial(record_exits, sph.spec, record_alt=True))
+    spec = sph.spec
+    s1 = s2 = sph._state
+    sysv = jnp.asarray(np.array([0.1, 0.1], np.float32))
+    for step in range(12):
+        b = _origin_batch(sph, rng, 48, RESOURCES, origin_ids, ctx_ids)
+        times = sph._time_scalars(clk.now_ms())
+        s1, v1 = gen(sph._ruleset, s1, b, times, sysv)
+        s2, v2 = fast(sph._ruleset, s2, b, times, sysv)
+        assert np.array_equal(np.asarray(v1.allow), np.asarray(v2.allow))
+        n = 48
+        xb = ExitBatch(
+            rows=b.rows, origin_rows=b.origin_rows, chain_rows=b.chain_rows,
+            acquire=b.acquire,
+            rt_ms=jnp.asarray(rng.integers(1, 60, n).astype(np.int32)),
+            error=jnp.asarray(rng.random(n) < 0.4),
+            is_in=b.is_in,
+            valid=np.asarray(v1.allow) & np.asarray(b.valid))
+        s1 = ex(sph._ruleset, s1, xb, times)
+        s2 = ex(sph._ruleset, s2, xb, times)
+        _assert_state_equal(s1, s2)
+        clk.advance_ms(int(rng.integers(50, 900)))
+
+
+def test_fast_flow_matches_scalar_on_origin_free(clk):
+    """On an origin-FREE batch all three paths agree (the fast path is a
+    strict generalization of the scalar one)."""
+    sph = make_sentinel(clk)
+    sph.load_flow_rules(_rules())
+    sph.load_degrade_rules(DEG_RULES)
+    rng = np.random.default_rng(13)
+    spec = sph.spec
+    gen, fast = _steps(sph)
+    sca = jax.jit(functools.partial(
+        decide_entries, spec, enable_occupy=False, record_alt=False,
+        scalar_flow=True))
+    n = 64
+    names = [RESOURCES[i] for i in rng.integers(0, len(RESOURCES), n)]
+    rows = np.array([sph.resources.get_or_create(r) for r in names],
+                    np.int32)
+    b = EntryBatch(
+        rows=jnp.asarray(rows),
+        origin_ids=jnp.zeros(n, jnp.int32),
+        origin_rows=jnp.full(n, spec.alt_rows, jnp.int32),
+        context_ids=jnp.zeros(n, jnp.int32),
+        chain_rows=jnp.full(n, spec.alt_rows, jnp.int32),
+        acquire=jnp.ones(n, jnp.int32),
+        is_in=jnp.ones(n, jnp.bool_),
+        prioritized=jnp.zeros(n, jnp.bool_),
+        valid=jnp.asarray(rng.random(n) > 0.1))
+    times = sph._time_scalars(clk.now_ms())
+    sysv = jnp.asarray(np.array([0.1, 0.1], np.float32))
+    s1, v1 = gen(sph._ruleset, sph._state, b, times, sysv)
+    s2, v2 = fast(sph._ruleset, sph._state, b, times, sysv)
+    s3, v3 = sca(sph._ruleset, sph._state, b, times, sysv)
+    for v in (v2, v3):
+        assert np.array_equal(np.asarray(v1.allow), np.asarray(v.allow))
+        assert np.array_equal(np.asarray(v1.wait_ms), np.asarray(v.wait_ms))
+    _assert_state_equal(s1, s2)

@@ -86,13 +86,68 @@ def test_free_resource_no_per_call_device_dispatch(clk):
 
 
 def test_free_thread_gauge_tracks_inflight(clk):
-    sph = make(clk)
+    # gauge maintenance is elided when nothing reads it (thread-gauge
+    # elision, VERDICT r4 #2); thread_gauge_always restores the
+    # reference's always-on curThreadNum observability
+    sph = make(clk, thread_gauge_always=True)
     entries = [sph.entry("free") for _ in range(5)]
     t = sph.node_totals("free")       # forces flush of buffered passes
     assert t["threads"] == 5
     for e in entries:
         e.exit()
     assert sph.node_totals("free")["threads"] == 0
+
+
+def test_thread_gauge_live_when_a_reader_rule_is_loaded(clk):
+    """A THREAD-grade rule anywhere flips gauge maintenance on for every
+    resource (the gauge is global state; the rule must read true
+    concurrency)."""
+    sph = make(clk)
+    sph.load_flow_rules([stpu.FlowRule(resource="guarded", count=50.0,
+                                       grade=stpu.GRADE_THREAD)])
+    entries = [sph.entry("free") for _ in range(3)]
+    t = sph.node_totals("free")
+    assert t["threads"] == 3
+    for e in entries:
+        e.exit()
+    assert sph.node_totals("free")["threads"] == 0
+
+
+def test_thread_gauge_elided_reads_zero_without_readers(clk):
+    """Contract pin: with no gauge readers loaded, the gauge is NOT
+    maintained (reads 0) — the documented observability trade."""
+    sph = make(clk)
+    entries = [sph.entry("free") for _ in range(4)]
+    assert sph.node_totals("free")["threads"] == 0
+    for e in entries:
+        e.exit()
+
+
+def test_thread_gauge_no_leak_across_elision_flips(clk):
+    """Entries counted while maintenance was ON must not leak a permanent
+    over-count when their exits happen elided (review finding r5): unload
+    the THREAD rule mid-flight, exit, reload — gauge must read 0, and a
+    tight THREAD rule must not block on phantom concurrency."""
+    sph = make(clk)
+    sph.load_flow_rules([stpu.FlowRule(resource="thr", count=50.0,
+                                       grade=stpu.GRADE_THREAD)])
+    entries = [sph.entry("free") for _ in range(5)]
+    assert sph.node_totals("free")["threads"] == 5
+    # unload the reader → elision flips on; the 5 exits are elided
+    sph.load_flow_rules([stpu.FlowRule(resource="other", count=5.0)])
+    for e in entries:
+        e.exit()
+    # reload a tight THREAD rule on the same row: no phantom concurrency
+    sph.load_flow_rules([stpu.FlowRule(resource="free", count=3.0,
+                                       grade=stpu.GRADE_THREAD)])
+    assert sph.node_totals("free")["threads"] == 0
+    fresh = [sph.entry("free") for _ in range(3)]
+    with pytest.raises(stpu.BlockException):
+        sph.entry("free")                 # 4th concurrent blocked (count=3)
+    for e in fresh:
+        e.exit()
+    assert sph.node_totals("free")["threads"] == 0
+    sph.entry("free").exit()              # admits again
 
 
 def test_free_with_origin_records_origin_stats(clk):

@@ -28,10 +28,10 @@ def _mesh():
     return Mesh(np.array(jax.devices()[:N_DEV]), (MESH_AXIS,))
 
 
-def _cfg():
+def _cfg(**over):
     return stpu.load_config(max_resources=64, max_flow_rules=16,
                             max_degrade_rules=16, max_authority_rules=16,
-                            host_fast_path=False)
+                            host_fast_path=False, **over)
 
 
 def _pair():
@@ -116,7 +116,16 @@ def test_sharding_survives_rule_reload_and_geometry_change():
 
 
 def test_thread_gauge_parity_on_exit():
-    ref, sh = _pair()
+    # gauge maintenance is elided without a reader rule (thread-gauge
+    # elision, round 5); force it on — this test is about SHARDED gauge
+    # parity, not the elision contract (tests/test_fastpath.py pins that)
+    ref = stpu.Sentinel(_cfg(thread_gauge_always=True),
+                        clock=ManualClock(start_ms=T0))
+    sh = stpu.Sentinel(_cfg(thread_gauge_always=True),
+                       clock=ManualClock(start_ms=T0), mesh=_mesh())
+    for s in (ref, sh):
+        s.load_flow_rules([FlowRule(resource=f"svc-{i}", count=5.0)
+                           for i in range(8)])
     entries_ref = [ref.entry("svc-2"), ref.entry("svc-2")]
     entries_sh = [sh.entry("svc-2"), sh.entry("svc-2")]
     assert (ref.node_totals("svc-2")["threads"]
