@@ -365,10 +365,13 @@ def bench_hot_param_zipf(B_override=None):
     path (host prep+dispatch vs readback stalls).
 
     Serving batch default 65536: picked from the committed round-5
-    scaling curve (BASELINE.md round-5 serving-batch table — throughput
-    rises ~linearly with B while pipelined grant p50 stays far under the
-    reference's 20 ms budget through 64k; 256k exceeds it). Override:
-    BENCH_SERVE_B."""
+    scaling curve (BASELINE.md round-5 serving-batch table). Throughput
+    rises monotonically through 256k, but grant latency rises with it and
+    NO batch size meets the reference's 20 ms budget through the tunnel —
+    the tunnel RTT floor alone is ~100 ms (sync p50 at B=4k). 64k takes
+    ~1.6-2.4x the 4k throughput while keeping sync grant p50 ~0.3 s; on
+    host-attached hardware rerun the curve (BENCH_SERVE_CURVE=1) — the
+    budget picture changes entirely. Override: BENCH_SERVE_B."""
     import sentinel_tpu as stpu
 
     K = 1 << 12 if SMALL else 1 << 16
@@ -444,7 +447,8 @@ def bench_cluster_tokens(B_override=None):
     """Config 5 — cluster token grants on the sharded engine.
 
     Serving batch default 65536: from the round-5 scaling curve (same
-    method as config 4 — see BASELINE.md; BENCH_SERVE_B overrides)."""
+    method and rationale as config 4 — see BASELINE.md; BENCH_SERVE_B
+    overrides)."""
     from sentinel_tpu.parallel.cluster import (
         THRESHOLD_GLOBAL, ClusterEngine, ClusterFlowRule, ClusterSpec,
     )
@@ -517,9 +521,11 @@ def bench_cluster_tokens(B_override=None):
 def serve_curve() -> None:
     """BENCH_SERVE_CURVE=1: configs 4/5 across serving batch sizes
     (VERDICT r4 #3) — one JSON line per (config, B). The per-config
-    defaults above are picked from this curve: largest B whose pipelined
-    grant p50 stays inside the reference's 20 ms request budget
-    (ClusterConstants.DEFAULT_REQUEST_TIMEOUT)."""
+    defaults above are picked from this curve against the reference's
+    20 ms request budget (ClusterConstants.DEFAULT_REQUEST_TIMEOUT);
+    through the tunnel the RTT floor exceeds the budget at every B, so
+    the default optimizes throughput-per-latency instead (see the
+    config-4 docstring and BASELINE.md)."""
     for B in (1 << 12, 1 << 14, 1 << 16, 1 << 18):
         for fn in (bench_hot_param_zipf, bench_cluster_tokens):
             try:

@@ -28,8 +28,9 @@ import sentinel_tpu as stpu
 from sentinel_tpu.dashboard import Dashboard, DashboardServer
 from sentinel_tpu.transport import start_transport
 
-VIEWS = ["metrics", "resources", "machines", "cluster", "flow", "degrade",
-         "paramFlow", "system", "authority", "gatewayFlow", "gatewayApi"]
+VIEWS = ["metrics", "resources", "tree", "machines", "cluster", "flow",
+         "degrade", "paramFlow", "system", "authority", "gatewayFlow",
+         "gatewayApi"]
 
 
 def boot():
@@ -82,10 +83,20 @@ def boot():
         time.sleep(0.3)
     else:
         raise RuntimeError("agent never appeared in dashboard discovery")
-    return dport, lambda: (transport.stop(), dash.stop())
+    def traffic():
+        """Fresh demo-res entries (the 1 s rolling window forgets the
+        boot traffic long before later drive steps run)."""
+        for _ in range(20):
+            try:
+                with sph.entry("demo-res"):
+                    pass
+            except stpu.BlockException:
+                pass
+
+    return dport, traffic, lambda: (transport.stop(), dash.stop())
 
 
-def drive(dport: int) -> None:
+def drive(dport: int, traffic) -> None:
     from playwright.sync_api import sync_playwright
 
     errors = []
@@ -155,6 +166,25 @@ def drive(dport: int) -> None:
             "saved API definition not in table"
         print("gateway API editor round-trip OK")
 
+        # ---- node-tree view: root aggregate + resource rows + origin
+        # drill-down (the reference webapp's identity page). Fresh
+        # traffic first: jsonTree hides nodes idle over the rolling
+        # second, and the boot traffic has long decayed by now.
+        traffic()
+        page.goto(f"http://127.0.0.1:{dport}/#/spa-e2e/tree")
+        page.wait_for_timeout(700)
+        assert page.locator("td", has_text="machine-root").count() >= 1, \
+            "tree view missing the EntranceNode root row"
+        assert page.locator("td", has_text="demo-res").count() >= 1, \
+            "tree view missing the resource node"
+        page.locator("tr", has_text="demo-res").locator(
+            "text=origins").first.click()
+        page.wait_for_timeout(700)
+        assert page.locator(
+            "text=no per-origin traffic").count() >= 1, \
+            "origin drill-down did not open"
+        print("node tree view OK")
+
         # ---- cluster assign flow: promote the machine to token server
         page.goto(f"http://127.0.0.1:{dport}/#/spa-e2e/cluster")
         page.wait_for_timeout(700)
@@ -170,9 +200,9 @@ def drive(dport: int) -> None:
 
 
 def main() -> int:
-    dport, stop = boot()
+    dport, traffic, stop = boot()
     try:
-        drive(dport)
+        drive(dport, traffic)
     finally:
         stop()
     print("SPA E2E OK")

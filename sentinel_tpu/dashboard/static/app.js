@@ -83,6 +83,7 @@ const RULE_VIEWS = ["flow", "degrade", "paramFlow", "system", "authority",
 const VIEW_TITLES = {
   metrics: "Realtime Metrics", resources: "Resource View",
   machines: "Machine List", cluster: "Cluster Management",
+  tree: "Node Tree",
   flow: "Flow Rules", degrade: "Degrade Rules", paramFlow: "Param Flow Rules",
   system: "System Rules", authority: "Authority Rules",
   gatewayFlow: "Gateway Flow Rules", gatewayApi: "API Definitions",
@@ -126,6 +127,7 @@ function renderSidebar() {
     return;
   }
   const menu = [["metrics", "Realtime Metrics"], ["resources", "Resource View"],
+                ["tree", "Node Tree"],
                 ["machines", "Machine List"], ["cluster", "Cluster"]];
   navEl.appendChild(h("h4", {}, "Monitor"));
   for (const [v, label] of menu) {
@@ -152,6 +154,7 @@ function render() {
   if (S.view === "resources") return viewResources(c);
   if (S.view === "machines") return viewMachines(c);
   if (S.view === "cluster") return viewCluster(c);
+  if (S.view === "tree") return viewTree(c);
   return viewRules(c, S.view);
 }
 
@@ -388,18 +391,45 @@ async function viewMachines(c) {
 }
 
 // ------------------------------------------------------------------ resources
-async function viewResources(c) {
-  await loadMachines();
+// shared by the resource + tree views: healthy-machine <select> wired to
+// S.machineSel (call after loadMachines())
+function machineSelector(refresh) {
   const healthy = S.machines.filter(m => m.healthy);
   if (!S.machineSel || !healthy.some(m => `${m.ip}:${m.port}` === S.machineSel)) {
     S.machineSel = healthy.length ? `${healthy[0].ip}:${healthy[0].port}` : "";
   }
-  const sel = h("select", { onchange: (e) => { S.machineSel = e.target.value; refresh(); } },
+  return h("select", { onchange: (e) => { S.machineSel = e.target.value; refresh(); } },
     healthy.map(m => {
       const v = `${m.ip}:${m.port}`;
       return h("option", v === S.machineSel ? { value: v, selected: "" }
                                             : { value: v }, v);
     }));
+}
+
+// shared per-origin drill-down subtable row (agent `origin` command)
+async function originsSubtable(ip, port, resource, colspan) {
+  const o = await api(`/resource/origin.json?ip=${ip}&port=${port}&id=${encodeURIComponent(resource)}`);
+  const origins = (o && o.data) || [];
+  return h("tr", {}, h("td", { colspan },
+    origins.length
+      ? h("table", {}, [
+          h("thead", {}, h("tr", {}, ["origin", "pass", "block",
+            "success", "exception", "threads"].map(t => h("th", {}, t)))),
+          h("tbody", {}, origins.map(g => h("tr", {}, [
+            h("td", {}, g.origin),
+            h("td", { class: "num ok" }, String(g.passQps)),
+            h("td", { class: "num" }, String(g.blockQps)),
+            h("td", { class: "num" }, String(g.successQps)),
+            h("td", { class: "num" }, String(g.exceptionQps)),
+            h("td", { class: "num" }, String(g.threadNum)),
+          ])))])
+      : h("span", { class: "dim" },
+          "no per-origin traffic on this resource")));
+}
+
+async function viewResources(c) {
+  await loadMachines();
+  const sel = machineSelector(() => refresh());
   const tbody = h("tbody", {});
   c.appendChild(h("div", { class: "card" }, [
     h("h3", {}, [h("span", {}, `Resource View — ${S.app}`),
@@ -447,29 +477,93 @@ async function viewResources(c) {
       ]);
       tbody.appendChild(row);
       if (S.openOrigins.has(n.resource)) {
-        const o = await api(`/resource/origin.json?ip=${ip}&port=${port}&id=${encodeURIComponent(n.resource)}`);
-        const origins = (o && o.data) || [];
-        tbody.appendChild(h("tr", {}, h("td", { colspan: 9 },
-          origins.length
-            ? h("table", {}, [
-                h("thead", {}, h("tr", {}, ["origin", "pass", "block",
-                  "success", "exception", "threads"].map(t =>
-                    h("th", {}, t)))),
-                h("tbody", {}, origins.map(g => h("tr", {}, [
-                  h("td", {}, g.origin),
-                  h("td", { class: "num ok" }, String(g.passQps)),
-                  h("td", { class: "num" }, String(g.blockQps)),
-                  h("td", { class: "num" }, String(g.successQps)),
-                  h("td", { class: "num" }, String(g.exceptionQps)),
-                  h("td", { class: "num" }, String(g.threadNum)),
-                ])))])
-            : h("span", { class: "dim" },
-                "no per-origin traffic on this resource"))));
+        tbody.appendChild(await originsSubtable(ip, port, n.resource, 9));
       }
     }
     if (!(j.data || []).length) {
       tbody.appendChild(h("tr", {}, h("td", { colspan: 9, class: "dim" },
         "no live resources on this machine")));
+    }
+  }
+  await refresh();
+  setRefresh(refresh, 3000);
+}
+
+// ------------------------------------------------------------------ tree
+// The reference webapp's identity/resource-tree page (identity.js): the
+// machine's invocation tree — EntranceNode root (__total_inbound_traffic__,
+// the ENTRY row aggregate) with its resource DefaultNodes indented under
+// it, per-origin drill-down per node, and rule creation from a row.
+async function viewTree(c) {
+  await loadMachines();
+  const sel = machineSelector(() => refresh());
+  const tbody = h("tbody", {});
+  c.appendChild(h("div", { class: "card" }, [
+    h("h3", {}, [h("span", {}, `Node Tree — ${S.app}`),
+                 h("span", { class: "toolbar" }, [
+                   h("span", { class: "sub" }, "machine"), sel])]),
+    h("table", {}, [h("thead", {}, h("tr", {}, [
+      ["resource", ""], ["threads", "num"], ["total", "num"],
+      ["pass", "num"], ["block", "num"], ["success", "num"],
+      ["exception", "num"], ["rt ms", "num"], ["", ""],
+    ].map(([t, cl]) => h("th", { class: cl }, t)))), tbody]),
+  ]));
+  async function refresh() {
+    if (!S.machineSel) { tbody.innerHTML = ""; tbody.appendChild(h("tr", {}, h("td", { colspan: 9, class: "dim" }, "no healthy machine"))); return; }
+    const [ip, port] = S.machineSel.split(":");
+    const j = await api(`/resource/jsonTree.json?ip=${ip}&port=${port}`);
+    tbody.innerHTML = "";
+    if (!j || !j.success) {
+      tbody.appendChild(h("tr", {}, h("td", { colspan: 9, class: "bad" },
+        j ? j.msg : "error")));
+      return;
+    }
+    const nodes = j.data || [];
+    const root = nodes.find(n => n.resource === "__total_inbound_traffic__");
+    const children = nodes.filter(n => n !== root);
+    const rootCells = root
+      ? [String(root.threadNum), String(root.totalQps), String(root.passQps),
+         String(root.blockQps), String(root.successQps),
+         String(root.exceptionQps), String(root.averageRt)]
+      : ["0", "0", "0", "0", "0", "0", "0"];
+    tbody.appendChild(h("tr", {}, [
+      h("td", {}, h("b", {}, "machine-root (total inbound)")),
+      ...rootCells.map((v, i) => h("td", { class: "num" + (i === 3 && v !== "0" ? " bad" : "") }, v)),
+      h("td", {}),
+    ]));
+    for (const n of children) {
+      tbody.appendChild(h("tr", {}, [
+        h("td", {}, `  └─ ${n.resource}`),
+        h("td", { class: "num" }, String(n.threadNum)),
+        h("td", { class: "num" }, String(n.totalQps)),
+        h("td", { class: "num ok" }, String(n.passQps)),
+        h("td", { class: "num " + (n.blockQps ? "bad" : "") }, String(n.blockQps)),
+        h("td", { class: "num" }, String(n.successQps)),
+        h("td", { class: "num " + (n.exceptionQps ? "warn" : "") }, String(n.exceptionQps)),
+        h("td", { class: "num" }, String(n.averageRt)),
+        h("td", {}, [
+          h("button", { class: "sm", onclick: () => {
+            if (S.openOrigins.has(n.resource)) S.openOrigins.delete(n.resource);
+            else S.openOrigins.add(n.resource);
+            refresh();
+          } }, "origins"),
+          " ",
+          h("button", { class: "sm",
+            onclick: () => openRuleModal("flow", { resource: n.resource }) },
+            "+ flow rule"),
+          " ",
+          h("button", { class: "sm",
+            onclick: () => openRuleModal("degrade", { resource: n.resource }) },
+            "+ degrade rule"),
+        ]),
+      ]));
+      if (S.openOrigins.has(n.resource)) {
+        tbody.appendChild(await originsSubtable(ip, port, n.resource, 9));
+      }
+    }
+    if (!children.length && !root) {
+      tbody.appendChild(h("tr", {}, h("td", { colspan: 9, class: "dim" },
+        "no live nodes on this machine")));
     }
   }
   await refresh();

@@ -27,6 +27,7 @@ statistics, like the reference.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -92,8 +93,10 @@ class RuleSet(NamedTuple):
     # slots' rule ids in ONE pass over the big row table (a 512k random
     # gather from a [1M]-row table costs ~6 ms on the v5 chip; two of
     # them were ~25% of the scalar step). None = gather separately.
-    # ALWAYS build via with_joint() — the consumer splits at
-    # flow_idx.shape[1], so a hand-concatenated copy can silently desync.
+    # ALWAYS build via with_joint() (or build_joint_np on the SAME numpy
+    # arrays being shipped as flow_idx/deg_idx — the runtime's host-side
+    # assembly) — the consumer splits at flow_idx.shape[1], so any other
+    # hand-concatenated copy can silently desync.
     joint_idx: Optional[jnp.ndarray] = None
 
     def with_joint(self) -> "RuleSet":
@@ -101,6 +104,14 @@ class RuleSet(NamedTuple):
         THIS ruleset actually carries (desync-proof by construction)."""
         return self._replace(joint_idx=jnp.concatenate(
             [self.flow_idx, self.deg_idx], axis=1))
+
+    @staticmethod
+    def build_joint_np(flow_idx_np, deg_idx_np):
+        """Host-side form of :meth:`with_joint` for callers that assemble
+        the ruleset in numpy and device_put once (cold-start path): pass
+        the EXACT arrays that become flow_idx/deg_idx."""
+        import numpy as np
+        return np.concatenate([flow_idx_np, deg_idx_np], axis=1)
 
 
 class EntryBatch(NamedTuple):
@@ -155,7 +166,7 @@ class Verdicts(NamedTuple):
     wait_ms: jnp.ndarray        # int32[B]
 
 
-def init_state(spec: EngineSpec, nf: int, nd: int) -> SentinelState:
+def _init_state_traced(spec: EngineSpec, nf: int, nd: int) -> SentinelState:
     minute_rows = spec.rows if spec.minute else 1
     minute_spec = spec.minute or WindowSpec(1, 1000, track_rt=False)
     return SentinelState(
@@ -168,6 +179,97 @@ def init_state(spec: EngineSpec, nf: int, nd: int) -> SentinelState:
         breakers=deg_mod.init_breaker_state(nd),
         param_dyn=pf_mod.init_param_dyn(spec.param_keys),
     )
+
+
+@functools.lru_cache(maxsize=None)
+def _init_state_jit(spec: EngineSpec, nf: int, nd: int):
+    return jax.jit(functools.partial(_init_state_traced, spec, nf, nd))
+
+
+def _init_state_np(spec: EngineSpec, nf: int, nd: int) -> SentinelState:
+    """Numpy mirror of :func:`_init_state_traced` (bit-identical leaves —
+    pinned by ``tests/test_pipeline.py::test_init_state_np_parity``)."""
+    import numpy as np
+
+    # python literals, NOT the module's device scalars (int(NEVER) would
+    # be a blocking device readback — the RPC this function exists to
+    # avoid); parity with the traced constants pinned by the test
+    never = -(2 ** 30)
+    i32max = np.iinfo(np.int32).max
+
+    def win(wspec, rows):
+        b_rt = wspec.buckets if wspec.track_rt else 0
+        return WindowState(
+            counters=np.zeros((rows, wspec.buckets, ev.NUM_EVENTS),
+                              np.int32),
+            stamps=np.full((rows, wspec.buckets), never, np.int32),
+            rt_sum=np.zeros((rows, b_rt), np.float32),
+            min_rt=np.full((rows, b_rt), i32max, np.int32))
+
+    minute_rows = spec.rows if spec.minute else 1
+    minute_spec = spec.minute or WindowSpec(1, 1000, track_rt=False)
+    pk = spec.param_keys
+    return SentinelState(
+        second=win(spec.second, spec.rows),
+        minute=win(minute_spec, minute_rows),
+        alt_second=win(spec.second, spec.alt_rows),
+        threads=np.zeros((spec.rows,), np.int32),
+        alt_threads=np.zeros((spec.alt_rows,), np.int32),
+        flow_dyn=flow_mod.FlowDynState(
+            latest_passed_ms=np.full((nf + 1,), never, np.int32),
+            stored_tokens=np.zeros((nf + 1,), np.float32),
+            last_filled_sec=np.full((nf + 1,), never, np.int32),
+            occupied_count=np.zeros(
+                (spec.rows, spec.second.buckets + 1), np.float32),
+            occupied_window=np.full(
+                (spec.rows, spec.second.buckets + 1), never, np.int32)),
+        breakers=deg_mod.BreakerState(
+            state=np.zeros((nd + 1,), np.int32),
+            next_retry_ms=np.full((nd + 1,), never, np.int32),
+            win_stamp=np.full((nd + 1,), never, np.int32),
+            bad=np.zeros((nd + 1,), np.int32),
+            total=np.zeros((nd + 1,), np.int32)),
+        param_dyn=pf_mod.ParamDynState(
+            tokens=np.zeros((pk + 1,), np.float32),
+            last_fill_ms=np.full((pk + 1,), never, np.int32),
+            latest_passed_ms=np.full((pk + 1,), never, np.int32),
+            threads=np.zeros((pk + 1,), np.int32),
+            override=np.full((pk + 1,), -1.0, np.float32)),
+    )
+
+
+# above this, raw zero-transfers beat the fused fill program; below it,
+# the one-program form wins (bench-scale 1M-row states would transfer
+# ~90 MB). Measured on the tunneled v5: 25 MB state transfers in ~1.1 s
+# vs ~3.1 s for the fused program's cached-executable load.
+_TRANSFER_STATE_LIMIT_BYTES = 48 * 1024 * 1024
+
+
+def init_state(spec: EngineSpec, nf: int, nd: int) -> SentinelState:
+    """Initial device state — WITHOUT paying per-process program loads
+    where possible.
+
+    Eager construction dispatched ~17 tiny fill programs; each cached
+    executable pays a program-load round-trip on a tunneled TPU (~0.12 s
+    each, ~2 s of every warm start — the cold-start story in
+    docs/OPERATIONS.md). Serving-sized states (≤ ~48 MB) are instead
+    built host-side and device_put as ONE transfer (no XLA program at
+    all, ~1.1 s for the default geometry); bigger states (the 1M-row
+    bench scale) fall back to one fused fill program, jit-cached per
+    geometry."""
+    import math
+    import os
+    mode = os.environ.get("SENTINEL_INIT_MODE", "")
+    # size from shapes alone — don't allocate ~90 MB of numpy zeros just
+    # to discard them on the program path
+    shapes = jax.eval_shape(
+        functools.partial(_init_state_traced, spec, nf, nd))
+    nbytes = sum(math.prod(leaf.shape) * leaf.dtype.itemsize
+                 for leaf in jax.tree.leaves(shapes))
+    if mode != "program" and (mode == "transfer"
+                              or nbytes <= _TRANSFER_STATE_LIMIT_BYTES):
+        return jax.device_put(_init_state_np(spec, nf, nd))
+    return _init_state_jit(spec, nf, nd)()
 
 
 def _stat_targets(spec: EngineSpec, rows, origin_rows, chain_rows, valid,

@@ -96,6 +96,10 @@ class CompiledDegradeRules(NamedTuple):
     rules: Tuple[DegradeRule, ...]
     num_active: int
     k_used: int = 1                  # max rules on any one resource
+    # the numpy original of rule_idx, kept so the runtime's ruleset
+    # assembly (used-slot slicing + joint-gather concat) runs host-side
+    # — two fewer program loads per process on a tunneled TPU
+    rule_idx_np: Optional["np.ndarray"] = None
 
 
 def init_breaker_state(nd: int) -> BreakerState:
@@ -153,7 +157,8 @@ def compile_degrade_rules(rules: Sequence[DegradeRule], *, resource_registry,
     return CompiledDegradeRules(table=table, rule_idx=jnp.asarray(rule_idx),
                                 rules=tuple(valid), num_active=len(valid),
                                 k_used=max(1, max(slots_used.values(),
-                                                  default=0)))
+                                                  default=0)),
+                                rule_idx_np=rule_idx)
 
 
 def degrade_entry_check(

@@ -153,6 +153,10 @@ class CompiledFlowRules(NamedTuple):
     k_used: int = 1                 # max rules on any ONE resource (the
     # rule-gather width the device steps actually need — rule_idx slots
     # are front-packed, so slicing [:, :k_used] loses nothing)
+    # numpy original of rule_idx: the runtime's ruleset assembly (slice +
+    # joint concat) runs host-side — fewer program loads per process on a
+    # tunneled TPU (cold-start story)
+    rule_idx_np: Optional[np.ndarray] = None
 
 
 def init_flow_dyn(nf: int, buckets: int = 2, rows: int = 1) -> FlowDynState:
@@ -268,7 +272,8 @@ def compile_flow_rules(rules: Sequence[FlowRule], *, resource_registry,
     return CompiledFlowRules(table=table, rule_idx=jnp.asarray(rule_idx),
                              rules=tuple(valid), num_active=len(valid),
                              k_used=max(1, max(slots_used.values(),
-                                               default=0)))
+                                               default=0)),
+                             rule_idx_np=rule_idx)
 
 
 # ---------------------------------------------------------------------------

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import collections
 import functools
+import os
 import threading
 from typing import List, Optional, Sequence, Tuple
 
@@ -139,6 +140,12 @@ def _jit_bucket_snapshot(spec: WindowSpec):
 _H1 = 0x9E3779B1
 _H2 = 0x85EBCA6B
 _MASK = 0xFFFFFFFF
+
+# (A background first-execution warmup thread was built and measured in
+# round 5: overlapping the tunnel's fixed first-execution cost with
+# construction's own RPCs changed the warm start by <0.3 s — the tunnel
+# serializes the RPCs server-side — so it was removed. The measured
+# decomposition lives in docs/OPERATIONS.md.)
 
 
 def _alt_hash(row: int, kind: int, key_id: int, ra: int) -> int:
@@ -306,10 +313,10 @@ class Sentinel:
         # main row → alt rows it ever hashed to; consulted on row eviction so
         # the recycled row's origin/context stats are cleared too
         self._alt_rows_by_row: dict = {}
-        # Eager init measured FASTER than a single fused jitted init on
-        # the tunneled device (2.8 s vs 4.4 s warm: ~30 tiny cached
-        # executables load quicker than one large one) — see
-        # OPERATIONS.md "Cold start" for the full startup decomposition.
+        # init_state picks transfer-based init (one device_put, no XLA
+        # program) for serving-sized geometries and one fused fill
+        # program at bench scale — see OPERATIONS.md "Cold start" for
+        # the measured round-5 decomposition.
         self._state = init_state(self.spec, cfg.max_flow_rules,
                                  cfg.max_degrade_rules)
         if mesh is not None:
@@ -444,8 +451,6 @@ class Sentinel:
         # amortized by the persistent compilation cache).
         kf = self._flow.k_used
         kd = self._deg.k_used
-        flow_idx = self._flow.rule_idx[:, :kf]
-        deg_idx = self._deg.rule_idx[:, :kd]
         # Static step flags (jit static args — variants recompile when they
         # flip, steady-state rulesets keep one trace):
         self._scalar_has_rl = any(
@@ -484,6 +489,28 @@ class Sentinel:
                 alt_threads=st.alt_threads * 0,
                 param_dyn=st.param_dyn._replace(
                     threads=st.param_dyn.threads * 0))
+        # Used-slot slice + joint concat in NUMPY, one device transfer:
+        # the jnp forms dispatch dynamic_slice/concatenate programs whose
+        # per-process loads cost ~0.6 s each on a tunneled TPU (the cold-
+        # start story, docs/OPERATIONS.md).
+        if self._flow.rule_idx_np is not None \
+                and self._deg.rule_idx_np is not None:
+            fi_np = self._flow.rule_idx_np[:, :kf]
+            di_np = self._deg.rule_idx_np[:, :kd]
+            joint_np = RuleSet.build_joint_np(fi_np, di_np)
+            flow_idx, deg_idx, joint = jax.device_put(
+                (fi_np, di_np, joint_np))
+            return RuleSet(
+                flow_table=self._flow.table,
+                flow_idx=flow_idx,
+                deg_table=self._deg.table,
+                deg_idx=deg_idx,
+                auth_table=self._auth.table, auth_idx=self._auth.rule_idx,
+                sys_thresholds=self._sys,
+                param_table=self._param.table,
+                joint_idx=joint)
+        flow_idx = self._flow.rule_idx[:, :kf]
+        deg_idx = self._deg.rule_idx[:, :kd]
         return RuleSet(
             flow_table=self._flow.table,
             flow_idx=flow_idx,

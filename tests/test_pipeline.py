@@ -598,3 +598,24 @@ def test_sample_count_one_outbound_batch_keeps_entry_prev_window(clk):
         sph.spec.second, sph._state.second,
         np.array([ENTRY_NODE_ROW], np.int32), ev.PASS, now_idx)
     assert int(np.asarray(prev)[0]) == 4
+
+
+def test_init_state_np_parity():
+    """The numpy mirror used for transfer-based cold start must be
+    bit-identical to the traced init (drift pin for pipeline._init_state_np
+    vs _init_state_traced)."""
+    import jax
+    import numpy as np
+    from sentinel_tpu.engine.pipeline import (
+        EngineSpec, _init_state_np, _init_state_traced,
+    )
+    from sentinel_tpu.stats.window import WindowSpec
+    spec = EngineSpec(rows=32, alt_rows=16, second=WindowSpec(2, 500),
+                      minute=WindowSpec(60, 1000), statistic_max_rt=5000,
+                      param_keys=8, param_pairs=2)
+    a = _init_state_np(spec, 5, 3)
+    b = _init_state_traced(spec, 5, 3)
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        assert la.dtype == np.asarray(lb).dtype
+        assert la.shape == np.asarray(lb).shape
+        assert np.array_equal(la, np.asarray(lb))
