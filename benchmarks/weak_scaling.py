@@ -1,0 +1,91 @@
+"""Virtual weak-scaling curve for the row-sharded product engine.
+
+CORRECTNESS-TIER ONLY: the 1/2/4/8 "devices" are virtual CPU devices
+sharing one physical host CPU, so absolute times mean nothing and
+speedups are not expected. What the curve shows is that per-step cost
+does NOT blow up as device count grows at fixed per-device rows — i.e.
+the sharded step's collective/layout overhead is flat, not pathological
+(VERDICT r4 #6: when real multi-chip hardware appears, the build should
+already know its collectives aren't the problem).
+
+Fixed per-device rows (default 128k) → R = rows_per_device x n. One
+fused scalar decide step per measurement, chained + honest-gated like
+every other harness.
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     python benchmarks/weak_scaling.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from sentinel_tpu.core.clock import ManualClock
+    from sentinel_tpu.core.config import load_config
+    from sentinel_tpu.parallel.local_shard import MESH_AXIS
+    from sentinel_tpu.runtime import Sentinel
+    from sentinel_tpu.rules.flow import FlowRule
+
+    ROWS_PER_DEV = int(os.environ.get("WEAK_ROWS_PER_DEV", str(1 << 17)))
+    B = int(os.environ.get("WEAK_BATCH", str(1 << 16)))
+    STEPS = int(os.environ.get("WEAK_STEPS", "8"))
+    t0 = 1_785_000_000_000
+
+    for n in (1, 2, 4, 8):
+        devs = jax.devices()[:n]
+        if len(devs) < n:
+            print(json.dumps({"devices": n, "error": "not enough devices"}))
+            continue
+        R = ROWS_PER_DEV * n
+        mesh = Mesh(np.array(devs), (MESH_AXIS,))
+        clk = ManualClock(start_ms=t0)
+        eng = Sentinel(load_config(max_resources=R, max_flow_rules=512,
+                                   max_degrade_rules=64,
+                                   max_authority_rules=16,
+                                   host_fast_path=False),
+                       clock=clk, mesh=mesh)
+        eng.load_flow_rules([FlowRule(resource=f"r{i}", count=1e6)
+                             for i in range(512)])
+        assert (eng._state.second.counters.sharding.spec == P(MESH_AXIS))
+        rng = np.random.default_rng(2)
+        rows = rng.integers(1, R, B).astype(np.int32)
+        z = np.zeros(B, np.int32)
+        p = np.full(B, eng.spec.alt_rows, np.int32)
+        ones = np.ones(B, np.int32)
+        tru = np.ones(B, np.bool_)
+        fal = np.zeros(B, np.bool_)
+
+        def step(i):
+            return eng.decide_raw(rows, z, p, z, p, ones, tru, fal,
+                                  at_ms=t0 + i * 2)
+
+        step(0)                      # warm compile
+        t0s = time.perf_counter()
+        for i in range(STEPS):
+            step(1 + i)
+        dt = (time.perf_counter() - t0s) / STEPS * 1000
+        print(json.dumps({"devices": n, "rows": R, "batch": B,
+                          "step_ms": round(dt, 1),
+                          "rows_per_device": ROWS_PER_DEV,
+                          "tier": "virtual-cpu-correctness"}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
