@@ -376,7 +376,11 @@ def decide_entries(
     # order, SURVEY §1). Static skip when the engine has no param geometry.
     param_dyn = state.param_dyn
     if spec.param_keys and batch.param_rules is not None:
-        param_dyn, param_ok, param_wait = pf_mod.param_check(
+        # scalar_flow/fast_flow imply host-verified uniform acquire — the
+        # precondition for the rank-prefix param variant (VERDICT r4 #9)
+        pcheck = (pf_mod.param_check_scalar
+                  if (scalar_flow or fast_flow) else pf_mod.param_check)
+        param_dyn, param_ok, param_wait = pcheck(
             rules.param_table, param_dyn, batch.param_rules, batch.param_keys,
             batch.acquire, live2, rel_now_ms)
         live2 = live2 & param_ok

@@ -893,17 +893,147 @@ def param_check(
     consumed = jnp.where(live_qps & pair_pass_s & event_ok_pair_s, acq_s, 0.0)
     _, incl_consumed = seg.segment_prefix_sum(consumed, starts, leader)
     new_tokens = t0 - incl_consumed
-    # last element of each key segment carries the final value
+    # last element of each key segment carries the final value. Dropped
+    # writes target PK+1 (out of range → mode="drop" discards) rather
+    # than the sentinel row PK, so the sentinel slot stays clean and the
+    # scalar variant can be pinned bit-exact against this path.
     is_last = jnp.concatenate([starts[1:], jnp.ones((1,), jnp.bool_)])
-    tok_target = jnp.where(is_last & live_qps, kj_s, PK)
+    tok_target = jnp.where(is_last & live_qps, kj_s, PK + 1)
     tokens = dyn.tokens.at[tok_target].set(new_tokens, mode="drop")
-    fill_target = jnp.where(is_last & live_qps & (never | refill), kj_s, PK)
+    fill_target = jnp.where(is_last & live_qps & (never | refill), kj_s,
+                            PK + 1)
     last_fill_new = dyn.last_fill_ms.at[fill_target].set(rel_now_ms, mode="drop")
 
     rl_latest = jnp.where(is_rl & pair_pass_s & valid_s & event_ok_pair_s,
                           latest_s, _NEVER)
-    rl_target = jnp.where(is_rl & valid_s, kj_s, PK)
+    rl_target = jnp.where(is_rl & valid_s, kj_s, PK + 1)
     latest_passed = dyn.latest_passed_ms.at[rl_target].max(rl_latest, mode="drop")
+
+    dyn = dyn._replace(tokens=tokens, last_fill_ms=last_fill_new,
+                       latest_passed_ms=latest_passed)
+    return dyn, allow, wait_ms
+
+
+def param_check_scalar(
+    table: ParamRuleTable,
+    dyn: ParamDynState,
+    pair_rules: jnp.ndarray,     # int32[B, PV] table slot, NP = none
+    pair_keys: jnp.ndarray,      # int32[B, PV] key row, PK = none
+    acquire: jnp.ndarray,        # int32[B] — HOST-VERIFIED uniform (>= 1)
+    valid: jnp.ndarray,          # bool[B]
+    rel_now_ms: jnp.ndarray,     # int32 scalar
+) -> Tuple[ParamDynState, jnp.ndarray, jnp.ndarray]:
+    """Scalar-path param check → (dyn', allow bool[B], wait_ms int32[B]).
+
+    Bit-exact with :func:`param_check` under the uniform-acquire
+    precondition the host verifies before selecting the scalar/fast flow
+    variants (runtime.decide_raw): within a key segment every admission
+    quantity — refilled bucket ``t0``, threshold, pacing cost — is a
+    function of the KEY alone, so the greedy token consumption, the
+    rate-limiter fixed point, and the THREAD-concurrency check all
+    collapse to arrival-rank compares (the round-4/5 playbook —
+    rules/flow.flow_check_scalar), replacing the key sort + prefix-sum
+    machinery with ONE rank pass (:func:`ops.segments.ranks_by_key`) and
+    elementwise math. Writebacks become scatters keyed directly by the
+    key row (same final values: ``t0 - total_consumed``, refill stamp,
+    pacing max — per-key constants either way).
+
+    Reference parity: ParamFlowChecker.java:122-220 (token bucket +
+    burst), rate-limiter mode (cost per element, strict '<' on
+    maxQueueingTimeMs), THREAD mode per-key concurrency.
+    """
+    B, PV = pair_rules.shape
+    NP = table.active.shape[0] - 1
+    PK = dyn.tokens.shape[0] - 1
+
+    rj = pair_rules.reshape(-1)
+    kj = pair_keys.reshape(-1)
+    valid_p = jnp.repeat(valid, PV) & (rj != NP) & (kj < PK) & table.active[rj]
+    rj = jnp.where(valid_p, rj, NP)
+    kj = jnp.where(valid_p, kj, PK)
+    # the uniform acquire (device-side derivation masked by valid, same
+    # as flow_check_scalar)
+    a = (jnp.float32(0)
+         + jnp.max(jnp.where(valid, acquire, 0)).astype(jnp.float32))
+
+    rank = seg.ranks_by_key(kj)
+    rankf = rank.astype(jnp.float32)
+
+    ov = dyn.override[kj]
+    threshold = jnp.where(ov >= 0.0, ov, table.count[rj])
+    maxc = threshold + table.burst[rj]
+    duration = jnp.maximum(table.duration_ms[rj], 1).astype(jnp.float32)
+    grade = table.grade[rj]
+    behavior = table.behavior[rj]
+
+    # --- QPS default: per-key refill, then rank-prefix consumption ---
+    last_fill = dyn.last_fill_ms[kj]
+    never = last_fill == _NEVER
+    pass_time = (rel_now_ms - last_fill).astype(jnp.float32)
+    refill = pass_time > duration
+    to_add = jnp.floor(pass_time * threshold / duration)
+    t0 = jnp.where(never, maxc,
+                   jnp.where(refill,
+                             jnp.minimum(dyn.tokens[kj] + to_add, maxc),
+                             dyn.tokens[kj]))
+    # same operand association as greedy_admit's `base + excl + amounts`
+    # with base = 0 (f32-exact while counts stay under 2^24)
+    qps_pass = (rankf * a) + a <= t0
+    qps_pass = qps_pass & (threshold > 0.0) & (a <= maxc)
+
+    # --- QPS rate limiter: per-key closed form (bounded rank budget) ---
+    cost = jnp.round(1000.0 * a * duration / 1000.0
+                     / jnp.maximum(threshold, 1e-9)).astype(jnp.int32)
+    L0 = dyn.latest_passed_ms[kj]
+    due = (L0 == _NEVER) | ((L0 + cost - rel_now_ms) <= 0)
+    base_time = jnp.where(due, rel_now_ms - cost, L0)
+    maxq = table.max_queue_ms[rj]
+    # pass ⇔ wait <= 0 OR wait < maxq ⇔ wait < max(maxq, 1) — strict '<'
+    # (maxQueueingTimeMs 0 admits only zero-wait, like the reference)
+    maxq_eff = jnp.maximum(maxq, 1)
+    rl_numer = rel_now_ms + maxq_eff - base_time
+    # (k+1)*cost < numer ⇔ k < (numer-1)//cost — ints, overflow-free
+    max_k = jnp.maximum((rl_numer - 1) // jnp.maximum(cost, 1), 0)
+    wait0_ok = jnp.maximum(base_time - rel_now_ms, 0) < maxq_eff
+    max_k = jnp.where(cost > 0, max_k,
+                      jnp.where(wait0_ok, jnp.int32(2 ** 30), 0))
+    rl_pass = (rank < max_k) & (threshold > 0.0)
+    safe_rank = jnp.minimum(rank, max_k)
+    wait_pair = jnp.maximum(
+        base_time + (safe_rank + 1) * cost - rel_now_ms, 0)
+
+    # --- THREAD grade: per-key concurrency, +1 regardless of acquire ---
+    thread_pass = (dyn.threads[kj].astype(jnp.float32) + rankf) + 1.0 \
+        <= threshold
+
+    is_rl = (grade == GRADE_QPS) & (behavior == BEHAVIOR_RATE_LIMITER)
+    is_qps = (grade == GRADE_QPS) & ~is_rl
+    pair_pass = jnp.where(is_qps, qps_pass,
+                          jnp.where(is_rl, rl_pass, thread_pass))
+    pair_pass = pair_pass | ~valid_p
+    pair_wait = jnp.where(is_rl & pair_pass & valid_p, wait_pair, 0)
+
+    allow = jnp.all(pair_pass.reshape(B, PV), axis=1)
+    wait_ms = jnp.max(pair_wait.reshape(B, PV), axis=1).astype(jnp.int32)
+    allow = allow | ~valid
+
+    # --- state writeback (scatters keyed by key row; PK+1 = dropped) ---
+    event_ok_pair = jnp.repeat(allow & valid, PV)
+    live_qps = valid_p & is_qps
+    drop = PK + 1
+    tgt_qps = jnp.where(live_qps, kj, drop)
+    # refreshed bucket value, then subtract what this batch consumed
+    tokens = dyn.tokens.at[tgt_qps].set(t0, mode="drop")
+    consumed = jnp.where(live_qps & pair_pass & event_ok_pair, a, 0.0)
+    tokens = tokens.at[tgt_qps].add(-consumed, mode="drop")
+    fill_tgt = jnp.where(live_qps & (never | refill), kj, drop)
+    last_fill_new = dyn.last_fill_ms.at[fill_tgt].set(rel_now_ms,
+                                                      mode="drop")
+    latest_pair = jnp.where(is_rl & rl_pass & valid_p & event_ok_pair,
+                            base_time + (safe_rank + 1) * cost, _NEVER)
+    rl_tgt = jnp.where(is_rl & valid_p, kj, drop)
+    latest_passed = dyn.latest_passed_ms.at[rl_tgt].max(latest_pair,
+                                                        mode="drop")
 
     dyn = dyn._replace(tokens=tokens, last_fill_ms=last_fill_new,
                        latest_passed_ms=latest_passed)

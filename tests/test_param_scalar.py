@@ -1,0 +1,123 @@
+"""Scalar param-flow parity: param_check_scalar must be bit-exact with
+param_check under the uniform-acquire precondition — token-bucket refill,
+burst, per-item overrides, rate-limiter pacing (strict maxQueueingTimeMs),
+and THREAD-mode concurrency, across window refills and multiple steps.
+
+Reference semantics: ParamFlowChecker.java:122-220.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sentinel_tpu.rules import param_flow as pf
+
+
+def _compile(rules, cap=8):
+    class _Reg:
+        def pin(self, name):
+            return 0
+
+        def get_or_create(self, name):
+            return 0
+
+    return pf.compile_param_rules(rules, resource_registry=_Reg(),
+                                  capacity=cap, k_per_resource=8)
+
+
+RULES = [
+    pf.ParamFlowRule(resource="hot", param_idx=0, count=5),
+    pf.ParamFlowRule(resource="hot", param_idx=1, count=3, burst_count=2),
+    pf.ParamFlowRule(resource="hot", param_idx=0, count=10,
+                     control_behavior=pf.BEHAVIOR_RATE_LIMITER,
+                     max_queueing_time_ms=200),
+    pf.ParamFlowRule(resource="hot", param_idx=0, count=4,
+                     grade=pf.GRADE_THREAD),
+    pf.ParamFlowRule(resource="hot", param_idx=2, count=0),   # zero count
+    pf.ParamFlowRule(resource="hot", param_idx=0, count=1e9,  # huge: cost 0
+                     control_behavior=pf.BEHAVIOR_RATE_LIMITER,
+                     max_queueing_time_ms=100),
+]
+
+
+def _state_equal(a, b):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        assert np.array_equal(np.asarray(la), np.asarray(lb)), \
+            "param dyn leaf diverged"
+
+
+@pytest.mark.parametrize("acquire", [1, 2])
+def test_param_scalar_parity_randomized(acquire):
+    compiled = _compile(RULES)
+    PK = 64
+    rng = np.random.default_rng(9)
+    d1 = d2 = pf.init_param_dyn(PK)
+    # a few per-key overrides (parsedHotItems)
+    d1 = d2 = d1._replace(override=d1.override.at[jnp.asarray([3, 7])].set(
+        jnp.asarray([2.0, 0.0])))
+    B, PV = 24, 3
+    gen = jax.jit(pf.param_check)
+    sca = jax.jit(pf.param_check_scalar)
+    now = 0
+    for step in range(12):
+        # keys are interned per (rule, value) in the real system — a key
+        # row always pairs with ONE rule; mirror that invariant here
+        # (rule slot len(RULES) == NP sentinel sometimes: pair inactive)
+        pair_rules = rng.integers(0, len(RULES) + 1, (B, PV)).astype(np.int32)
+        values = rng.integers(0, 8, (B, PV)).astype(np.int32)
+        pair_keys = np.where(pair_rules < len(RULES),
+                             pair_rules * 8 + values,
+                             rng.integers(0, PK + 1, (B, PV))).astype(
+            np.int32)
+        valid = rng.random(B) > 0.2
+        acq = np.full(B, acquire, np.int32)
+        args1 = (compiled.table, d1, jnp.asarray(pair_rules),
+                 jnp.asarray(pair_keys), jnp.asarray(acq),
+                 jnp.asarray(valid), jnp.int32(now))
+        args2 = (compiled.table, d2, jnp.asarray(pair_rules),
+                 jnp.asarray(pair_keys), jnp.asarray(acq),
+                 jnp.asarray(valid), jnp.int32(now))
+        d1, ok1, w1 = gen(*args1)
+        d2, ok2, w2 = sca(*args2)
+        assert np.array_equal(np.asarray(ok1), np.asarray(ok2)), \
+            f"allow diverged at step {step}"
+        assert np.array_equal(np.asarray(w1), np.asarray(w2)), \
+            f"wait diverged at step {step}"
+        _state_equal(d1, d2)
+        # move time: sometimes within the window, sometimes across refills
+        now += int(rng.integers(50, 1500))
+        # occasionally bump per-key live concurrency (THREAD reads it)
+        if step % 3 == 0:
+            d1 = d1._replace(threads=d1.threads.at[rng.integers(0, PK)].add(1))
+            d2 = d2._replace(threads=jnp.asarray(np.asarray(d1.threads)))
+
+
+def test_param_scalar_pacing_ladder():
+    """RL mode: k-th admitted request waits k*cost, pacing clock advances
+    identically (the per-key RateLimiter semantics)."""
+    rules = [pf.ParamFlowRule(resource="hot", param_idx=0, count=10,
+                              control_behavior=pf.BEHAVIOR_RATE_LIMITER,
+                              max_queueing_time_ms=500)]
+    compiled = _compile(rules)
+    PK = 8
+    d1 = d2 = pf.init_param_dyn(PK)
+    B = 6
+    pair_rules = np.zeros((B, 1), np.int32)
+    pair_keys = np.zeros((B, 1), np.int32)       # all on one hot key
+    acq = np.ones(B, np.int32)
+    valid = np.ones(B, bool)
+    for now in (0, 137, 1000):
+        d1, ok1, w1 = pf.param_check(
+            compiled.table, d1, jnp.asarray(pair_rules),
+            jnp.asarray(pair_keys), jnp.asarray(acq), jnp.asarray(valid),
+            jnp.int32(now))
+        d2, ok2, w2 = pf.param_check_scalar(
+            compiled.table, d2, jnp.asarray(pair_rules),
+            jnp.asarray(pair_keys), jnp.asarray(acq), jnp.asarray(valid),
+            jnp.int32(now))
+        assert np.array_equal(np.asarray(ok1), np.asarray(ok2))
+        assert np.array_equal(np.asarray(w1), np.asarray(w2))
+        _state_equal(d1, d2)
+    assert np.asarray(w1).max() > 0      # the ladder actually paced
