@@ -18,6 +18,11 @@ the google.protobuf runtime) plays the Envoy client. This script
    doesn't declare; proto3 skips them).
 
 Run: python ci/envoy_golden.py   (CI job; also runnable locally)
+
+The companion CI job `envoy-binary` goes further where a binary IS
+available: `ci/envoy_binary_interop.py` downloads the official static
+Envoy release, points its ratelimit http filter at
+``SentinelRlsGrpcServer``, and asserts 200→429 through the real proxy.
 """
 
 from __future__ import annotations
