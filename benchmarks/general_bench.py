@@ -146,10 +146,13 @@ def build_general_fixture(jax, R: int, B: int, NRULES: int,
     return spec, ruleset, state, batches, 1_000_000_000
 
 
-def ablate(jax, spec, ruleset, state0, batches, t0_ms, STEPS) -> None:
+def ablate(jax, spec, ruleset, state0, batches, t0_ms, STEPS,
+           mode: str = "general") -> None:
     """GENERAL_ABLATE=1: marginal cost of each general-path component
     (same subtractive method as benchmarks/ablate_step.py, but with the
-    origin-bearing fixture and record_alt=True)."""
+    origin-bearing fixture and record_alt=True). ``mode="fast"`` ablates
+    the round-5 fast path (flow_check_fast) instead of the legacy sorted
+    path — different stub targets, same discipline."""
     import contextlib
 
     import jax.numpy as jnp
@@ -196,6 +199,22 @@ def ablate(jax, spec, ruleset, state0, batches, t0_ms, STEPS) -> None:
     def stub_add_one_row(wspec, wstate, row, vec, now_idx, **kw):
         return wstate
 
+    def stub_ranks(key):
+        return jnp.zeros(key.shape, jnp.int32)
+
+    def stub_joint_gather(idx_table, rows, sentinel):
+        return jnp.zeros((rows.shape[0], idx_table.shape[1]), jnp.int32)
+
+    def stub_flow_fast(table, dyn, rule_idx, wspec, main_second, alt_second,
+                       main_threads, alt_threads, batch, now_idx_s,
+                       rel_now_ms, **kw):
+        return (dyn, jnp.ones(batch.rows.shape, jnp.bool_),
+                jnp.zeros(batch.rows.shape, jnp.int32))
+
+    def stub_degrade_scalar(table, st, rule_idx, rows, valid, rel_now_ms,
+                            **kw):
+        return st, jnp.ones(rows.shape, jnp.bool_)
+
     targets = {
         "sort": (seg_mod, "sort_by_keys", stub_sort_by_keys),
         "unsort": (seg_mod, "unsort", stub_unsort),
@@ -207,6 +226,12 @@ def ablate(jax, spec, ruleset, state0, batches, t0_ms, STEPS) -> None:
         "refresh": (pl, "refresh_all", stub_refresh_all),
         "scatter": (pl, "add_rows_multi", stub_add_rows_multi),
         "entryrow": (pl, "add_one_row", stub_add_one_row),
+        # fast-path targets (mode="fast")
+        "ranks": (seg_mod, "ranks_by_key", stub_ranks),
+        "joint": (seg_mod, "padded_table_gather", stub_joint_gather),
+        "flowfast": (pl.flow_mod, "flow_check_fast", stub_flow_fast),
+        "degscalar": (pl.deg_mod, "degrade_entry_check_scalar",
+                      stub_degrade_scalar),
     }
 
     @contextlib.contextmanager
@@ -236,12 +261,15 @@ def ablate(jax, spec, ruleset, state0, batches, t0_ms, STEPS) -> None:
 
     results = {}
 
+    fast_kw = (dict(fast_flow=True, skip_threads=True)
+               if mode == "fast" else {})
+
     def run(name, *stub_names):
         state = jax.tree.map(jnp.copy, state0)
         with patched(*stub_names):
             step = jax.jit(ft.partial(
                 pl.decide_entries, spec, enable_occupy=False,
-                record_alt=True, skip_auth=True, skip_sys=True),
+                record_alt=True, skip_auth=True, skip_sys=True, **fast_kw),
                 donate_argnums=(1,))
             state, v = step(ruleset, state, batches[0], times_for(0),
                             sys_scalars)
@@ -256,16 +284,30 @@ def ablate(jax, spec, ruleset, state0, batches, t0_ms, STEPS) -> None:
         results[name] = dt
         print(f"  {name:<40s} {dt:9.2f} ms", flush=True)
 
-    run("FULL")
-    run("-sorts", "sort")
-    run("-unsorts", "unsort")
-    run("-winsum", "winsum")
-    run("-warmup", "warmup")
-    run("-prefixsums", "prefix")
-    run("-admit", "admit")
-    run("-degrade", "degrade")
-    run("-recording", "refresh", "scatter", "entryrow")
-    run("-all (floor)", *targets.keys())
+    if mode == "fast":
+        floor_stubs = ("flowfast", "degscalar", "joint", "refresh",
+                       "scatter", "entryrow")
+        run("FULL")
+        run("-joint-gather", "joint")
+        run("-ranksort", "ranks")
+        run("-winsum", "winsum")
+        run("-warmup", "warmup")
+        run("-flow(whole)", "flowfast")
+        run("-degrade", "degscalar")
+        run("-recording", "refresh", "scatter", "entryrow")
+        run("-all (floor)", *floor_stubs)
+    else:
+        run("FULL")
+        run("-sorts", "sort")
+        run("-unsorts", "unsort")
+        run("-winsum", "winsum")
+        run("-warmup", "warmup")
+        run("-prefixsums", "prefix")
+        run("-admit", "admit")
+        run("-degrade", "degrade")
+        run("-recording", "refresh", "scatter", "entryrow")
+        run("-all (floor)", "sort", "unsort", "winsum", "warmup", "prefix",
+            "admit", "degrade", "refresh", "scatter", "entryrow")
     full = results["FULL"]
     print("marginal costs:")
     for k, v in results.items():
@@ -289,7 +331,7 @@ def measure(jax, mode: str, R: int, B: int, STEPS: int, NRULES: int,
 
     if os.environ.get("GENERAL_ABLATE"):
         ablate(jax, spec, ruleset, state, batches, t0_ms,
-               int(os.environ.get("PROF_STEPS", "15")))
+               int(os.environ.get("PROF_STEPS", "15")), mode=mode)
         return {}
 
     if mode == "mixed":

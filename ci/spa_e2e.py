@@ -193,6 +193,21 @@ def drive(dport: int, traffic) -> None:
         assert page.locator("td", has_text="listening :").count() >= 1, \
             "assign did not promote the machine to a listening server"
         print("cluster assign OK")
+
+        # ---- token-server config editor + QPS monitor appear once a
+        # server exists (reference cluster_app_server_manage / _monitor)
+        page.wait_for_timeout(1200)
+        assert page.locator("h3", has_text="Token server config").count() == 1, \
+            "server config card missing after assign"
+        assert page.locator("h3", has_text="Token server QPS").count() == 1, \
+            "QPS monitor card missing after assign"
+        cfg_card = page.locator(".card", has_text="Token server config")
+        cfg_card.locator("input[placeholder=unlimited]").fill("250")
+        cfg_card.get_by_text("apply", exact=True).click()
+        page.wait_for_timeout(700)
+        assert cfg_card.locator("span", has_text="applied").count() >= 1, \
+            "maxAllowedQps apply did not confirm"
+        print("server config editor OK")
         browser.close()
     hard = [e for e in errors if "favicon" not in e]
     if hard:

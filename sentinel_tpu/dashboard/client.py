@@ -139,3 +139,33 @@ class SentinelApiClient:
         resp = self._post(ip, port, "setClusterClientConfig",
                           {"data": json.dumps(cfg)})
         return "success" in resp
+
+    def fetch_cluster_server_config(self, ip: str, port: int,
+                                    namespace: str = "") -> Dict[str, Any]:
+        """``cluster/server/fetchConfig`` — ``{flow, namespaceSet,
+        transport}`` without a namespace, or the per-namespace
+        ``ServerFlowConfig`` view (``maxAllowedQps``) with one (reference
+        ``FetchClusterServerConfigHandler``)."""
+        params = {"namespace": namespace} if namespace else None
+        return json.loads(self._get(ip, port, "cluster/server/fetchConfig",
+                                    params) or "{}")
+
+    def set_cluster_server_flow_config(self, ip: str, port: int,
+                                       namespace: str,
+                                       max_allowed_qps: float) -> bool:
+        """Per-namespace ``ServerFlowConfig.maxAllowedQps`` (reference
+        ``ModifyClusterServerFlowConfigHandler`` →
+        ``GlobalRequestLimiter``)."""
+        resp = self._post(
+            ip, port, "cluster/server/modifyFlowConfig",
+            {"namespace": namespace,
+             "data": json.dumps({"maxAllowedQps": max_allowed_qps})})
+        return "success" in resp
+
+    def set_cluster_server_namespace_set(self, ip: str, port: int,
+                                         namespaces: List[str]) -> bool:
+        """Replace the token server's served-namespace set (reference
+        ``ModifyServerNamespaceSetHandler``)."""
+        resp = self._post(ip, port, "cluster/server/modifyNamespaceSet",
+                          {"data": json.dumps(list(namespaces))})
+        return "success" in resp

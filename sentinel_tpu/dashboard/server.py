@@ -59,10 +59,20 @@ class Dashboard:
     """Wires discovery + repos + fetcher + api client; host for route logic."""
 
     def __init__(self, *, username: str = "sentinel",
-                 password: str = "sentinel", clock=None):
+                 password: str = "sentinel", clock=None,
+                 agent_timeout_s: Optional[float] = None):
+        import os
         self.apps = AppManagement()
         self.metrics = InMemoryMetricsRepository()
-        self.client = SentinelApiClient()
+        # per-request agent deadline (reference: the dashboard apiClient's
+        # configurable http timeouts). An agent's FIRST hit on a stats
+        # command jit-compiles its snapshot — allow overriding where 3 s
+        # of compile is realistic (cold agents, loaded hosts).
+        if agent_timeout_s is None:
+            agent_timeout_s = float(
+                os.environ.get("SENTINEL_DASH_AGENT_TIMEOUT_S", "0") or 0)
+        self.client = (SentinelApiClient(timeout_s=agent_timeout_s)
+                       if agent_timeout_s > 0 else SentinelApiClient())
         self.fetcher = MetricFetcher(self.apps, self.metrics,
                                      self.client, clock=clock)
         self.auth = AuthService(username, password)
@@ -259,6 +269,38 @@ class Dashboard:
             return _fail(str(exc))
         return _ok(ok)
 
+    def cluster_server_config(self, ip: str, port: int,
+                              namespace: str = "") -> dict:
+        """Token-server config view (reference
+        ``cluster_app_server_manage`` screen): flow geometry +
+        namespaceSet + transport, or one namespace's maxAllowedQps."""
+        try:
+            return _ok(self.client.fetch_cluster_server_config(
+                ip, port, namespace))
+        except AgentUnreachable as exc:
+            return _fail(str(exc))
+
+    def set_cluster_server_config(self, ip: str, port: int,
+                                  namespace: str = "",
+                                  max_allowed_qps: Optional[float] = None,
+                                  namespaces: Optional[list] = None) -> dict:
+        """Apply a server-config edit: the namespace set, the
+        per-namespace QPS ceiling, or both in one call."""
+        try:
+            if namespaces is not None:
+                if not self.client.set_cluster_server_namespace_set(
+                        ip, port, [str(n) for n in namespaces]):
+                    return _fail("modify namespace set rejected")
+            if max_allowed_qps is not None:
+                if not namespace:
+                    return _fail("namespace required for maxAllowedQps")
+                if not self.client.set_cluster_server_flow_config(
+                        ip, port, namespace, float(max_allowed_qps)):
+                    return _fail("modify flow config rejected")
+        except AgentUnreachable as exc:
+            return _fail(str(exc))
+        return _ok("success")
+
     def cluster_assign(self, app: str, server_ip: str, server_port: int,
                        request_timeout_ms: int = 10_000) -> dict:
         """One-click topology (reference ``ClusterAssignService``): make the
@@ -442,6 +484,29 @@ class _Handler(BaseHTTPRequestHandler):
                     q.get("namespace", "") or q.get("app", ""))))
             except AgentUnreachable as exc:
                 self._json(_fail(str(exc)))
+            return
+        if method == "GET" and path == "/cluster/serverConfig.json":
+            self._json(d.cluster_server_config(
+                q.get("ip", ""), int(q.get("port", "0") or 0),
+                q.get("namespace", "")))
+            return
+        if method == "POST" and path == "/cluster/serverConfig":
+            p = self._body_params(body)
+            qps = p.get("maxAllowedQps")
+            nss = p.get("namespaces")
+            if isinstance(nss, str):
+                nss = [s.strip() for s in nss.split(",") if s.strip()]
+            if nss is not None and not nss:
+                # an empty set would silently stop serving every namespace
+                # while the UI still shows the app-name fallback
+                self._json(_fail("namespace set must not be empty"))
+                return
+            self._json(d.set_cluster_server_config(
+                str(p.get("ip", "")), int(p.get("port", 0) or 0),
+                namespace=str(p.get("namespace", "") or ""),
+                max_allowed_qps=(float(qps) if qps not in (None, "")
+                                 else None),
+                namespaces=nss))
             return
         if method == "POST" and path == "/cluster/mode":
             p = self._body_params(body)

@@ -498,6 +498,7 @@ async function viewTree(c) {
   await loadMachines();
   const sel = machineSelector(() => refresh());
   const tbody = h("tbody", {});
+  let apiNamesCache = null;   // per-view-load cache of API-group names
   c.appendChild(h("div", { class: "card" }, [
     h("h3", {}, [h("span", {}, `Node Tree — ${S.app}`),
                  h("span", { class: "toolbar" }, [
@@ -520,7 +521,10 @@ async function viewTree(c) {
     }
     const nodes = j.data || [];
     const root = nodes.find(n => n.resource === "__total_inbound_traffic__");
-    const children = nodes.filter(n => n !== root);
+    // gateway-classified resources (ResourceTypeConstants gateway = 3) get
+    // their own section, the reference gateway identity page's tree
+    const children = nodes.filter(n => n !== root && n.classification !== 3);
+    const gateway = nodes.filter(n => n !== root && n.classification === 3);
     const rootCells = root
       ? [String(root.threadNum), String(root.totalQps), String(root.passQps),
          String(root.blockQps), String(root.successQps),
@@ -561,7 +565,41 @@ async function viewTree(c) {
         tbody.appendChild(await originsSubtable(ip, port, n.resource, 9));
       }
     }
-    if (!children.length && !root) {
+    if (gateway.length) {
+      // which gateway resources are API groups (vs routes) comes from the
+      // app's API definitions, same as the reference gateway identity
+      // page — fetched once per view load (each fetch round-trips to the
+      // agent), not on every 3 s tree poll
+      if (apiNamesCache === null) {
+        const aj = await api(`/v1/gatewayApi/rules?app=${encodeURIComponent(S.app)}`);
+        apiNamesCache = new Set(((aj && aj.data) || []).map(r => r.apiName));
+      }
+      const apiNames = apiNamesCache;
+      tbody.appendChild(h("tr", {}, [
+        h("td", { colspan: 9 },
+          h("b", {}, "gateway — routes and API groups"))]));
+      for (const n of gateway) {
+        const kind = apiNames.has(n.resource) ? "API group" : "route";
+        tbody.appendChild(h("tr", {}, [
+          h("td", {}, [`  └─ ${n.resource} `,
+                       h("span", { class: "sub" }, `[${kind}]`)]),
+          h("td", { class: "num" }, String(n.threadNum)),
+          h("td", { class: "num" }, String(n.totalQps)),
+          h("td", { class: "num ok" }, String(n.passQps)),
+          h("td", { class: "num " + (n.blockQps ? "bad" : "") },
+            String(n.blockQps)),
+          h("td", { class: "num" }, String(n.successQps)),
+          h("td", { class: "num " + (n.exceptionQps ? "warn" : "") },
+            String(n.exceptionQps)),
+          h("td", { class: "num" }, String(n.averageRt)),
+          h("td", {}, h("button", { class: "sm",
+            onclick: () => openRuleModal("gatewayFlow",
+                                         { resource: n.resource }) },
+            "+ gateway rule")),
+        ]));
+      }
+    }
+    if (!children.length && !gateway.length && !root) {
       tbody.appendChild(h("tr", {}, h("td", { colspan: 9, class: "dim" },
         "no live nodes on this machine")));
     }
@@ -575,6 +613,8 @@ const MODES = { "-1": "off", 0: "client", 1: "server" };
 async function viewCluster(c) {
   const tbody = h("tbody", {});
   const topo = h("div", {});
+  const srvConfig = h("div", {});
+  const srvMonitor = h("div", {});
   const srvMetrics = h("div", {});
   c.appendChild(h("div", { class: "card" }, [
     h("h3", {}, [h("span", {}, `Cluster — ${S.app}`)]), topo]));
@@ -586,13 +626,114 @@ async function viewCluster(c) {
       "machine", "mode", "token server", "",
     ].map(t => h("th", {}, t)))), tbody]),
   ]));
+  c.appendChild(srvConfig);
+  c.appendChild(srvMonitor);
   c.appendChild(srvMetrics);
+
+  // --- token-server config editor (reference cluster_app_server_manage) —
+  // rebuilt only when the server machine changes so edits aren't clobbered
+  let cfgKey = null;
+  async function refreshServerConfig(server) {
+    const key = server ? `${server.ip}:${server.port}` : "";
+    if (key === cfgKey) return;
+    cfgKey = key;
+    srvConfig.innerHTML = "";
+    if (!server) return;
+    let j;
+    try {
+      j = await api(`/cluster/serverConfig.json?ip=${server.ip}&port=${server.port}`);
+    } catch (e) {
+      cfgKey = null;        // transient fetch failure: retry next poll
+      return;
+    }
+    if (!j || !j.success) { cfgKey = null; return; }
+    const cfg = j.data || {};
+    const nsList = (cfg.namespaceSet && cfg.namespaceSet.length)
+      ? cfg.namespaceSet : [S.app];
+    const nsInput = h("input", { value: nsList.join(", "), size: "40" });
+    const nsSel = h("select", {},
+      nsList.map(ns => h("option", { value: ns }, ns)));
+    const qpsInput = h("input", { type: "number", min: "0",
+                                  placeholder: "unlimited" });
+    const applied = h("span", { class: "sub" }, "");
+    const loadQps = async () => {
+      const r = await api(`/cluster/serverConfig.json?ip=${server.ip}&port=${server.port}&namespace=${encodeURIComponent(nsSel.value)}`);
+      const v = (r && r.success && r.data && r.data.flow)
+        ? r.data.flow.maxAllowedQps : null;
+      qpsInput.value = (v == null || v < 0) ? "" : String(v);
+      applied.textContent = "";
+    };
+    nsSel.onchange = loadQps;
+    await loadQps();
+    const sub = (cfg.transport
+      ? `token port :${cfg.transport.port} · idle ${cfg.transport.idleSeconds}s · `
+      : "") + (cfg.flow
+      ? `window ${cfg.flow.intervalMs}ms × ${cfg.flow.sampleCount} buckets`
+      : "");
+    srvConfig.appendChild(h("div", { class: "card" }, [
+      h("h3", {}, [h("span", {}, `Token server config — ${key}`),
+                   h("span", { class: "sub" }, sub)]),
+      h("div", { class: "toolbar" }, [
+        h("span", { class: "sub" }, "namespace set"), nsInput,
+        h("button", { class: "sm primary", onclick: async () => {
+          const r = await post("/cluster/serverConfig",
+            { ip: server.ip, port: server.port, namespaces: nsInput.value });
+          if (r && !r.success) alert(r.msg);
+          cfgKey = null; refreshServerConfig(server);
+        } }, "save set"),
+      ]),
+      h("div", { class: "toolbar" }, [
+        h("span", { class: "sub" }, "maxAllowedQps"), nsSel, qpsInput,
+        h("button", { class: "sm primary", onclick: async () => {
+          if (qpsInput.value === "") { alert("enter a QPS ceiling"); return; }
+          const r = await post("/cluster/serverConfig",
+            { ip: server.ip, port: server.port, namespace: nsSel.value,
+              maxAllowedQps: qpsInput.value });
+          if (r && !r.success) alert(r.msg);
+          else applied.textContent = "applied";
+        } }, "apply"),
+        applied,
+      ]),
+    ]));
+  }
+
+  // --- token-server QPS monitor (reference cluster_app_server_monitor) —
+  // granted/rejected per poll, charted from a client-side history
+  let monKey = null, monCv = null;
+  function ensureMonitor(server) {
+    const key = server ? `${server.ip}:${server.port}` : "";
+    if (key === monKey) return;
+    monKey = key;
+    srvMonitor.innerHTML = "";
+    monCv = null;
+    if (!server) return;
+    monCv = h("canvas", { class: "chart" });
+    srvMonitor.appendChild(h("div", { class: "card" }, [
+      h("h3", {}, [h("span", {}, `Token server QPS — ${key}`),
+        h("span", { class: "sub" },
+          "granted (pass) vs rejected (block), summed over cluster flows")]),
+      monCv]));
+  }
+
   async function refreshServerMetrics(server) {
+    refreshServerConfig(server);
+    ensureMonitor(server);
     srvMetrics.innerHTML = "";
     if (!server) return;
     const j = await api(`/cluster/metrics.json?app=${encodeURIComponent(S.app)}&ip=${server.ip}&port=${server.port}`);
     if (!j || !j.success) return;
-    const rows = (j.data || []).map(n => h("tr", {}, [
+    const flows = j.data || [];
+    const hist = (S.clusterHist = S.clusterHist || {});
+    const pts = (hist[monKey] = hist[monKey] || []);
+    pts.push({
+      timestamp: Date.now(),
+      passQps: flows.reduce((a, n) => a + (+n.passQps || 0), 0),
+      blockQps: flows.reduce((a, n) => a + (+n.blockQps || 0), 0),
+      rt: 0,
+    });
+    if (pts.length > 180) pts.shift();
+    if (monCv) drawChart(monCv, pts, null);
+    const rows = flows.map(n => h("tr", {}, [
       h("td", {}, String(n.flowId)),
       h("td", {}, n.resourceName),
       h("td", { class: "num ok" }, String(n.passQps)),

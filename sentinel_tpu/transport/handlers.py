@@ -191,6 +191,7 @@ def register_default_handlers(
 
     def _node_dicts():
         out = []
+        rtypes = dict(getattr(s, "resource_types", {}) or {})
         for name, row, t in s.all_node_totals():
             if not (t["pass"] or t["block"] or t["success"] or t["threads"]):
                 continue
@@ -198,6 +199,10 @@ def register_default_handlers(
                 "id": row,
                 "resource": TOTAL_IN_RESOURCE_NAME if row == ENTRY_NODE_ROW
                 else name,
+                # ResourceTypeConstants classification (0 common, 1 web,
+                # 2 rpc, 3 gateway) — the SPA's gateway tree grouping keys
+                # off this the way the reference gateway identity page does
+                "classification": int(rtypes.get(name, 0)),
                 "threadNum": t["threads"], "passQps": t["pass"],
                 "blockQps": t["block"], "totalQps": t["pass"] + t["block"],
                 "successQps": t["success"], "exceptionQps": t["exception"],

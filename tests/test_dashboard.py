@@ -57,8 +57,11 @@ def agent(clk, tmp_path):
 
 @pytest.fixture
 def dash(clk):
+    # generous agent deadline: a stats command's first hit jit-compiles
+    # its snapshot, which can exceed the 3 s default on a loaded CI host
     server = DashboardServer(
-        Dashboard(password="", clock=clk), host="127.0.0.1", port=0)
+        Dashboard(password="", clock=clk, agent_timeout_s=30.0),
+        host="127.0.0.1", port=0)
     port = server.start(fetch=False)     # fetch loops driven manually
     yield server.dashboard, port
     server.stop()
@@ -338,9 +341,14 @@ def test_json_tree_route(agent, dash, clk):
     _beat(aport, dport, clk)
     with sph.entry("tree-res"):
         pass
+    with sph.entry("gw-route", resource_type=3):   # TYPE_GATEWAY
+        pass
     out = _get(dport, f"/resource/jsonTree.json?ip=127.0.0.1&port={aport}")
     assert out["success"]
-    assert any(n.get("resource") == "tree-res" for n in out["data"])
+    nodes = {n.get("resource"): n for n in out["data"]}
+    assert nodes["tree-res"]["classification"] == 0
+    # the SPA's gateway tree section keys off this field
+    assert nodes["gw-route"]["classification"] == 3
 
 
 def test_cluster_server_metrics_route(dash, clk, tmp_path):
@@ -373,6 +381,55 @@ def test_cluster_server_metrics_route(dash, clk, tmp_path):
         node = out["data"][0]
         assert node["flowId"] == 11
         assert node["passQps"] == 4.0 and node["blockQps"] == 2.0
+    finally:
+        coord.stop()
+        rt.stop()
+
+
+def test_cluster_server_config_routes(dash, clk):
+    """GET /cluster/serverConfig.json + POST /cluster/serverConfig
+    round-trip the token server's namespace set and per-namespace
+    maxAllowedQps (the reference cluster_app_server_manage screen)."""
+    from sentinel_tpu.cluster.coordinator import ClusterCoordinator
+    from sentinel_tpu.transport import start_transport
+
+    d, dport = dash
+    cfg = stpu.load_config(max_resources=64, max_flow_rules=16,
+                           max_degrade_rules=16, max_authority_rules=16)
+    sph = stpu.Sentinel(config=cfg, clock=clk)
+    coord = ClusterCoordinator(sph, clock=clk)
+    rt = start_transport(sph, host="0.0.0.0", port=0, metric_log=False,
+                         clock=clk)
+    coord.bind(rt.cluster_state, command_center=rt.center)
+    try:
+        coord.on_mode_change(1)
+        _beat(rt.port, dport, clk)
+        base = f"/cluster/serverConfig.json?ip=127.0.0.1&port={rt.port}"
+        out = _get(dport, base)
+        assert out["success"], out
+        assert "flow" in out["data"]
+        r = _send(dport, "/cluster/serverConfig",
+                  body={"ip": "127.0.0.1", "port": rt.port,
+                        "namespaces": "nsa, nsb"})
+        assert r["success"], r
+        assert _get(dport, base)["data"]["namespaceSet"] == ["nsa", "nsb"]
+        r = _send(dport, "/cluster/serverConfig",
+                  body={"ip": "127.0.0.1", "port": rt.port,
+                        "namespace": "nsa", "maxAllowedQps": 123.0})
+        assert r["success"], r
+        per = _get(dport, base + "&namespace=nsa")
+        assert per["data"]["flow"]["maxAllowedQps"] == 123.0
+        # a QPS write without a namespace is rejected, not silently dropped
+        r = _send(dport, "/cluster/serverConfig",
+                  body={"ip": "127.0.0.1", "port": rt.port,
+                        "maxAllowedQps": 5})
+        assert not r["success"]
+        # an emptied namespace-set input must not wipe the served set
+        r = _send(dport, "/cluster/serverConfig",
+                  body={"ip": "127.0.0.1", "port": rt.port,
+                        "namespaces": ""})
+        assert not r["success"]
+        assert _get(dport, base)["data"]["namespaceSet"] == ["nsa", "nsb"]
     finally:
         coord.stop()
         rt.stop()
