@@ -203,6 +203,9 @@ def ablate(jax, spec, ruleset, state0, batches, t0_ms, STEPS,
         return jnp.zeros(key.shape, jnp.int32)
 
     def stub_joint_gather(idx_table, rows, sentinel):
+        # CAVEAT: zeros collapse every pair onto rule 0, which perturbs
+        # the downstream sort/scatter distributions — this stub's marginal
+        # can come out negative; read the whole-flow-slot number instead
         return jnp.zeros((rows.shape[0], idx_table.shape[1]), jnp.int32)
 
     def stub_flow_fast(table, dyn, rule_idx, wspec, main_second, alt_second,

@@ -65,7 +65,8 @@ class ClusterTokenServer:
                  host: str = "0.0.0.0",
                  port: int = codec.DEFAULT_CLUSTER_SERVER_PORT,
                  idle_seconds: float = DEFAULT_IDLE_SECONDS,
-                 batch_window_ms: float = DEFAULT_BATCH_WINDOW_MS):
+                 batch_window_ms: float = DEFAULT_BATCH_WINDOW_MS,
+                 log_dir: Optional[str] = None):
         self.engine = engine
         self.concurrent = concurrent or ConcurrentTokenManager()
         self.clock = clock or Clock()
@@ -74,10 +75,12 @@ class ClusterTokenServer:
         self.idle_seconds = idle_seconds
         self.batch_window_ms = batch_window_ms
         # ClusterServerStatLogUtil → cluster-server.log: per-second rollup
-        # of grant/deny counts per flow id (EagleEye StatLogger analog)
+        # of grant/deny counts per flow id (EagleEye StatLogger analog;
+        # file IO rides the async appender's flush daemon)
         from sentinel_tpu.core.logs import BlockStatLogger
         self.stat_log = BlockStatLogger(
-            self.clock, file_name="sentinel-cluster-server.log")
+            self.clock, base_dir=log_dir,
+            file_name="sentinel-cluster-server.log")
 
         self._conns: Set[_Conn] = set()
         self._ns_conns: Dict[str, Set[str]] = {}

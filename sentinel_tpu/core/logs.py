@@ -7,8 +7,10 @@ EagleEye-backed block log (``slots/logger/EagleEyeLogUtil.java`` +
 rolled up per (resource, exception, limitApp, origin, ruleId) key every
 second and flushed as one pipe-delimited line — that per-interval rollup is
 what keeps logging off the hot path, and is reproduced here by
-:class:`BlockStatLogger`. Python's stdlib logging plays the ``Logger`` SPI
-role (handlers are swappable, the slf4j-binding analog)."""
+:class:`BlockStatLogger` (the generic rollup + async appender machinery
+lives in :mod:`sentinel_tpu.core.statlog`). Python's stdlib logging plays
+the ``Logger`` SPI role (handlers are swappable, the slf4j-binding
+analog)."""
 
 from __future__ import annotations
 
@@ -16,7 +18,9 @@ import logging
 import logging.handlers
 import os
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
+
+from sentinel_tpu.core.statlog import AsyncRollingAppender, StatLogger
 
 _DEF_DIR = os.path.join(os.path.expanduser("~"), "logs", "csp")
 
@@ -54,22 +58,22 @@ def record_log(to_file: bool = True) -> logging.Logger:
         return _record_logger
 
 
-class BlockStatLogger:
+class BlockStatLogger(StatLogger):
     """Per-second rollup of block events → ``sentinel-block.log``.
 
-    Line format mirrors the EagleEye stat line:
-    ``ms|resource,exception,limitApp,origin,ruleId|count`` with at most
-    ``max_entries`` distinct keys per interval (overflow keys are dropped,
-    like the StatLogger's maxEntryCount=6000).
-
-    Written LINES are additionally rate-limited by a token bucket
+    The generic :class:`~sentinel_tpu.core.statlog.StatLogger` rollup
+    (1 s period, max_entries key cap, async rolling appender) with the
+    block log's fixed 5-part key and an additional per-LINE token bucket
     (``max_lines_per_sec``, burst = one second's worth) — the EagleEye
     ``TokenBucket`` analog. The DEFAULT equals ``max_entries`` so the
     documented per-interval key contract is never silently trimmed; the
     knob exists for operators with a tighter disk budget (a sustained
     block storm over high-cardinality resources still rolls up to 6000
-    lines/s otherwise). Trimmed intervals append one ``__dropped__``
-    summary line so the loss is visible, not silent."""
+    lines/s otherwise). Trimmed or overflowed intervals append one
+    ``__dropped__`` summary line so the loss is visible, not silent.
+
+    Line format mirrors the EagleEye stat line:
+    ``ms|resource,exception,limitApp,origin,ruleId|count``."""
 
     FILE_NAME = "sentinel-block.log"
 
@@ -77,15 +81,16 @@ class BlockStatLogger:
                  max_entries: int = 6000, max_bytes: int = 300 * 1024 * 1024,
                  backups: int = 3, file_name: Optional[str] = None,
                  max_lines_per_sec: Optional[int] = None):
-        self._clock = clock
         self._dir = base_dir or log_base_dir()
         self.file_name = file_name or self.FILE_NAME
-        self._max_entries = max_entries
-        self._max_bytes = max_bytes
-        self._backups = backups
-        self._lock = threading.Lock()
-        self._bucket_sec = 0
-        self._counts: Dict[Tuple[str, str, str, str, str], int] = {}
+        # size rotation + actual file IO live on the appender's flush
+        # daemon — the entry/exit hot path only formats and enqueues
+        # (EagleEyeRollingFileAppender + EagleEyeLogDaemon split)
+        super().__init__(
+            self.file_name, clock, period_ms=1000, max_entries=max_entries,
+            appender=AsyncRollingAppender(
+                os.path.join(self._dir, self.file_name),
+                max_bytes=max_bytes, backups=backups))
         self._line_rate = max(1, max_lines_per_sec
                               if max_lines_per_sec is not None
                               else max_entries)
@@ -94,25 +99,13 @@ class BlockStatLogger:
 
     def log(self, resource: str, exception_name: str, limit_app: str = "",
             origin: str = "", rule_id: str = "", count: int = 1) -> None:
-        sec = self._clock.now_ms() // 1000
-        flush = None
-        with self._lock:
-            if sec != self._bucket_sec and self._counts:
-                flush = (self._bucket_sec, self._counts)
-                self._counts = {}
-            self._bucket_sec = sec
-            key = (resource, exception_name, limit_app, origin, rule_id)
-            if key in self._counts or len(self._counts) < self._max_entries:
-                self._counts[key] = self._counts.get(key, 0) + count
-        if flush:
-            self._write(*flush)
+        self.stat(resource, exception_name, limit_app, origin, rule_id,
+                  values=(count,))
 
-    def flush(self) -> None:
-        with self._lock:
-            pending = (self._bucket_sec, self._counts)
-            self._counts = {}
-        if pending[1]:
-            self._write(*pending)
+    def close(self) -> None:
+        """Flush pending rollups and retire the appender (terminal)."""
+        self.flush()
+        self.appender.close()
 
     def _take_line_tokens(self, sec: int, want: int) -> int:
         """Token-bucket refill + take → number of lines allowed now."""
@@ -126,22 +119,12 @@ class BlockStatLogger:
             self._line_tokens -= granted
             return granted
 
-    def _write(self, sec: int, counts: Dict) -> None:
-        path = os.path.join(self._dir, self.file_name)
-        budget = self._take_line_tokens(sec, len(counts))
-        dropped = len(counts) - budget
-        try:
-            os.makedirs(self._dir, exist_ok=True)
-            if os.path.exists(path) and os.path.getsize(path) > self._max_bytes:
-                for i in range(self._backups - 1, 0, -1):
-                    src = f"{path}.{i}"
-                    if os.path.exists(src):
-                        os.replace(src, f"{path}.{i + 1}")
-                os.replace(path, f"{path}.1")
-            with open(path, "a", encoding="utf-8") as fh:
-                for (res, exc, la, org, rid), n in list(counts.items())[:budget]:
-                    fh.write(f"{sec * 1000}|{res},{exc},{la},{org},{rid}|{n}\n")
-                if dropped > 0:
-                    fh.write(f"{sec * 1000}|__dropped__|{dropped}\n")
-        except OSError:   # pragma: no cover — never break the hot path on IO
-            pass
+    def _emit(self, bucket: int, counts: Dict, overflow: int) -> None:
+        budget = self._take_line_tokens(bucket, len(counts))
+        trimmed = len(counts) - budget
+        ms = bucket * self._period
+        lines = [f"{ms}|{','.join(k)}|{vs[0]}"
+                 for k, vs in list(counts.items())[:budget]]
+        if trimmed + overflow > 0:
+            lines.append(f"{ms}|__dropped__|{trimmed + overflow}")
+        self.appender.append_many(lines)

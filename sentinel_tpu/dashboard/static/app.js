@@ -657,7 +657,10 @@ async function viewCluster(c) {
                                   placeholder: "unlimited" });
     const applied = h("span", { class: "sub" }, "");
     const loadQps = async () => {
-      const r = await api(`/cluster/serverConfig.json?ip=${server.ip}&port=${server.port}&namespace=${encodeURIComponent(nsSel.value)}`);
+      let r = null;
+      try {
+        r = await api(`/cluster/serverConfig.json?ip=${server.ip}&port=${server.port}&namespace=${encodeURIComponent(nsSel.value)}`);
+      } catch (e) { /* transient: leave the field; onchange retries */ }
       const v = (r && r.success && r.data && r.data.flow)
         ? r.data.flow.maxAllowedQps : null;
       qpsInput.value = (v == null || v < 0) ? "" : String(v);

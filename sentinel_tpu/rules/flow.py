@@ -873,11 +873,13 @@ def flow_check_fast(
                       jnp.where(wait0_ok, jnp.int32(2 ** 30), 0))
     max_k = jnp.where(table.count > 0, max_k, 0)
 
-    # ---- per-EVENT stat reads ([B]-sized; the general path gathered all
-    # of these per PAIR from the 1M-row table) ----
-    safe_rows = jnp.minimum(batch.rows, R - 1)
-    ev_pass = window_sum_rows(spec, main_second, safe_rows, ev.PASS,
-                              now_idx_s).astype(jnp.float32)
+    # ---- stat reads. MAIN/REF rows are PER-RULE quantities: a valid
+    # (event, rule) pair always has rule.sync_row == the event's row (the
+    # rule was gathered FROM that row; sync_row = own row, or ref_row for
+    # RELATE), so the main-table window/thread reads are [NF+1]-sized and
+    # ride the packed gather below — no [B]-sized gather over the 1M-row
+    # window table at all. Only the ORIGIN/CHAIN reads are per-event, and
+    # those hit the small [RA]-row alt table. ----
     safe_orow = jnp.minimum(batch.origin_rows, RA - 1)
     safe_crow = jnp.minimum(batch.chain_rows, RA - 1)
     or_pass = window_sum_rows(spec, alt_second, safe_orow, ev.PASS,
@@ -885,13 +887,13 @@ def flow_check_fast(
     cr_pass = window_sum_rows(spec, alt_second, safe_crow, ev.PASS,
                               now_idx_s).astype(jnp.float32)
     if has_thread_rules:
-        ev_thr = main_threads[safe_rows].astype(jnp.float32)
         or_thr = alt_threads[safe_orow].astype(jnp.float32)
         cr_thr = alt_threads[safe_crow].astype(jnp.float32)
 
-    # per-rule REF-row reads (ref_row is a rule attribute, [NF+1]-sized)
-    srow_ref = jnp.minimum(table.ref_row, R - 1)
-    ref_pass = window_sum_rows(spec, main_second, srow_ref, ev.PASS,
+    # per-rule selected-row reads ([NF+1]-sized; sync_row covers both the
+    # MAIN row — the rule's own resource — and the REF row for RELATE)
+    srow_sel = jnp.minimum(table.sync_row, R - 1)
+    row_pass = window_sum_rows(spec, main_second, srow_sel, ev.PASS,
                                now_idx_s).astype(jnp.float32)
 
     # ---- ONE packed per-rule gather [NF+1, C] → [B, K, C] (columns
@@ -907,12 +909,12 @@ def flow_check_fast(
         cost,                                                # 7
         max_k,                                               # 8
         lax.bitcast_convert_type(eff_limit, jnp.int32),      # 9
-        lax.bitcast_convert_type(ref_pass, jnp.int32),       # 10
+        lax.bitcast_convert_type(row_pass, jnp.int32),       # 10
     ]
     if has_thread_rules:
-        ref_thr = main_threads[srow_ref].astype(jnp.float32)
+        row_thr = main_threads[srow_sel].astype(jnp.float32)
         cols += [
-            lax.bitcast_convert_type(ref_thr, jnp.int32),    # 11
+            lax.bitcast_convert_type(row_thr, jnp.int32),    # 11
             table.grade,                                     # 12
         ]
     vt = jnp.stack(cols, axis=1)
@@ -937,16 +939,14 @@ def flow_check_fast(
     app = app & jnp.where(use_alt, alt_row < RA, True)
     valid_pair = batch.valid[:, None] & app
 
-    # ---- per-pair base (selected stat row's count) ----
-    ref_pass_p = lax.bitcast_convert_type(g[..., 10], jnp.float32)
-    main_pass_p = jnp.where(kind == SEL_REF, ref_pass_p, ev_pass[:, None])
+    # ---- per-pair base (selected stat row's count; MAIN/REF both come
+    # from the per-rule sync_row column) ----
+    main_pass_p = lax.bitcast_convert_type(g[..., 10], jnp.float32)
     alt_pass_p = jnp.where(kind == SEL_CHAIN, cr_pass[:, None],
                            or_pass[:, None])
     cur_pass = jnp.where(use_alt, alt_pass_p, main_pass_p)
     if has_thread_rules:
-        ref_thr_p = lax.bitcast_convert_type(g[..., 11], jnp.float32)
-        main_thr_p = jnp.where(kind == SEL_REF, ref_thr_p,
-                               ev_thr[:, None])
+        main_thr_p = lax.bitcast_convert_type(g[..., 11], jnp.float32)
         alt_thr_p = jnp.where(kind == SEL_CHAIN, cr_thr[:, None],
                               or_thr[:, None])
         cur_thr = jnp.where(use_alt, alt_thr_p, main_thr_p)

@@ -290,8 +290,8 @@ def test_cluster_server_stat_log(tmp_path, monkeypatch):
     engine = ClusterEngine(ClusterSpec(n_shards=8, flows_per_shard=16,
                                        namespaces=4))
     server = ClusterTokenServer(engine, host="127.0.0.1", port=0,
-                                clock=ManualClock(start_ms=10_000_000))
-    server.stat_log._dir = str(tmp_path)
+                                clock=ManualClock(start_ms=10_000_000),
+                                log_dir=str(tmp_path))
     server.load_flow_rules("ns", [ClusterFlowRule(
         flow_id=9, count=1, threshold_type=THRESHOLD_GLOBAL)])
     server.start()

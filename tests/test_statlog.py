@@ -1,0 +1,131 @@
+"""Async rolling appender + generic stat logger (core/statlog.py — the
+EagleEye analog: EagleEyeRollingFileAppender/EagleEyeLogDaemon/StatLogger).
+"""
+
+import time
+
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.core.statlog import AsyncRollingAppender, StatLogger
+
+T0 = 1_700_000_000_000
+
+
+def test_appender_flush_writes_lines(tmp_path):
+    p = tmp_path / "a.log"
+    ap = AsyncRollingAppender(str(p), flush_interval_s=60)
+    assert ap.append("one")
+    assert ap.append_many(["two", "three"]) == 2
+    ap.flush()
+    assert p.read_text().splitlines() == ["one", "two", "three"]
+    ap.close()
+
+
+def test_appender_daemon_flushes_without_explicit_flush(tmp_path):
+    p = tmp_path / "d.log"
+    ap = AsyncRollingAppender(str(p), flush_interval_s=0.05)
+    ap.append("hands-off")
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if p.exists() and "hands-off" in p.read_text():
+            break
+        time.sleep(0.02)
+    else:
+        raise AssertionError("daemon never drained the queue")
+    ap.close()
+
+
+def test_appender_size_rotation_keeps_backups(tmp_path):
+    p = tmp_path / "r.log"
+    ap = AsyncRollingAppender(str(p), max_bytes=64, backups=2,
+                              flush_interval_s=60)
+    for i in range(3):
+        ap.append_many([f"chunk-{i}-{j}-{'x' * 40}" for j in range(4)])
+        ap.flush()       # each drain sees the file over 64 bytes → rotates
+    ap.close()
+    assert p.exists() and (tmp_path / "r.log.1").exists()
+    assert (tmp_path / "r.log.2").exists()
+    assert not (tmp_path / "r.log.3").exists()   # bounded by backups=2
+    # newest backup holds the previous generation
+    assert "chunk-1-" in (tmp_path / "r.log.1").read_text()
+
+
+def test_appender_overflow_drops_visibly(tmp_path):
+    p = tmp_path / "o.log"
+    ap = AsyncRollingAppender(str(p), queue_cap=4, flush_interval_s=60)
+    accepted = sum(1 for i in range(10) if ap.append(f"l{i}"))
+    assert accepted == 4
+    ap.flush()
+    lines = p.read_text().splitlines()
+    assert lines[:4] == ["l0", "l1", "l2", "l3"]
+    assert lines[4] == "__appender_dropped__|6"
+    ap.close()
+
+
+def test_appender_idle_daemon_exits_and_revives(tmp_path):
+    import sentinel_tpu.core.statlog as sl_mod
+    p = tmp_path / "i.log"
+    ap = AsyncRollingAppender(str(p), flush_interval_s=0.01)
+    ap.append("first")
+    deadline = time.time() + 10      # drain + 60 idle wakeups ≈ 0.6 s
+    while time.time() < deadline:
+        t = ap._thread
+        if t is None or not t.is_alive():
+            break
+        time.sleep(0.02)
+    else:
+        raise AssertionError("idle daemon never exited")
+    ap.append("second")              # must revive the daemon
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if p.exists() and "second" in p.read_text():
+            break
+        time.sleep(0.02)
+    else:
+        raise AssertionError("daemon did not revive after idle exit")
+    ap.close()
+    assert ap not in sl_mod._all_appenders
+
+
+def test_stat_logger_rolls_per_period(tmp_path):
+    clk = ManualClock(start_ms=T0)
+    sl = StatLogger("cluster-server", clk, base_dir=str(tmp_path))
+    sl.stat("flow-1", "pass")
+    sl.stat("flow-1", "pass", values=(3,))
+    sl.stat("flow-2", "block")
+    clk.advance_ms(1000)
+    sl.stat("flow-1", "pass")      # rolls the previous period out
+    sl.flush()
+    lines = (tmp_path / "cluster-server.log").read_text().splitlines()
+    assert f"{T0}|flow-1,pass|4" in lines
+    assert f"{T0}|flow-2,block|1" in lines
+    assert f"{T0 + 1000}|flow-1,pass|1" in lines
+
+
+def test_stat_logger_multi_value_and_overflow(tmp_path):
+    clk = ManualClock(start_ms=T0)
+    sl = StatLogger("multi", clk, base_dir=str(tmp_path), max_entries=2)
+    sl.stat("a", values=(1, 10))
+    sl.stat("a", values=(2, 20))
+    sl.stat("b", values=(5, 50))
+    sl.stat("c", values=(9, 90))    # over max_entries → dropped, counted
+    sl.flush()
+    lines = (tmp_path / "multi.log").read_text().splitlines()
+    assert f"{T0}|a|3,30" in lines
+    assert f"{T0}|b|5,50" in lines
+    assert f"{T0}|__dropped__|1" in lines
+
+
+def test_block_log_hot_path_never_touches_disk(tmp_path):
+    """BlockStatLogger.log() only enqueues — the file appears on the
+    appender drain (daemon/flush), not on the caller's thread."""
+    from sentinel_tpu.core.logs import BlockStatLogger
+    clk = ManualClock(start_ms=T0)
+    log = BlockStatLogger(clk, base_dir=str(tmp_path))
+    log.appender._interval = 60     # keep the daemon parked for the test
+    log.log("svc", "FlowException")
+    clk.advance_ms(1000)
+    log.log("svc", "FlowException")   # rolls the first second → enqueue
+    assert not (tmp_path / BlockStatLogger.FILE_NAME).exists()
+    log.flush()
+    lines = (tmp_path / BlockStatLogger.FILE_NAME).read_text().splitlines()
+    assert any(ln.startswith(f"{T0}|svc,FlowException") for ln in lines)
