@@ -42,8 +42,9 @@ from sentinel_tpu.rules import param_flow as pf_mod
 from sentinel_tpu.rules import system as sys_mod
 from sentinel_tpu.stats import events as ev
 from sentinel_tpu.stats.window import (
-    WindowSpec, WindowState, add_one_row, add_rows, add_rows_multi,
-    add_rows_vec, init_window, invalidate_rows, refresh_all, refresh_rows,
+    WindowSpec, WindowState, add_one_row, add_rows, add_rows_hist,
+    add_rows_multi, add_rows_vec, init_window, invalidate_rows,
+    refresh_all, refresh_rows,
 )
 
 
@@ -570,23 +571,31 @@ def decide_entries(
     second = add_one_row(spec.second, second, ENTRY_NODE_ROW, entry_vec,
                          now_idx_s)
 
-    # alt rows (origin + chain hashes) keep the two-half scatter: both
-    # halves are real hashed rows; no OCCUPIED lane on alt (as before)
+    # alt rows (origin + chain hashes): no OCCUPIED lane on alt (as before)
     if record_alt:
         alt_mask1 = pass_now | blocked_rec
         alt_mask2 = jnp.concatenate([alt_mask1, alt_mask1])
         ev_ids2 = jnp.concatenate([ev_ids1, ev_ids1])
-        acq2 = jnp.concatenate([acq, acq])
         alt_rec = jnp.where(alt_mask2, alt_targets, pad_a)
-        alt_amt = jnp.where(alt_mask2, acq2, 0)
         if spec.second.buckets >= 2:
             alt_second = refresh_all(spec.second, state.alt_second,
                                      now_idx_s)
         else:
             alt_second = refresh_rows(spec.second, state.alt_second,
                                       alt_targets, now_idx_s)
-        alt_second = add_rows_multi(spec.second, alt_second, alt_rec,
-                                    ev_ids2, alt_amt, now_idx_s)
+        if fast_flow and RA <= 4096:
+            # the [2B]-index scatter collides massively on the small alt
+            # table; the histogram matmul is ~3x cheaper on the MXU, and
+            # fast_flow's host-verified uniform acquire makes its int32
+            # post-scaling bit-exact (see stats.window.add_rows_hist)
+            a_uni = jnp.max(jnp.where(batch.valid, acq, 0))
+            alt_second = add_rows_hist(spec.second, alt_second, alt_rec,
+                                       ev_ids2, a_uni, now_idx_s)
+        else:
+            acq2 = jnp.concatenate([acq, acq])
+            alt_amt = jnp.where(alt_mask2, acq2, 0)
+            alt_second = add_rows_multi(spec.second, alt_second, alt_rec,
+                                        ev_ids2, alt_amt, now_idx_s)
     else:
         alt_second = state.alt_second
 

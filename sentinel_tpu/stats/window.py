@@ -41,7 +41,9 @@ from __future__ import annotations
 import dataclasses
 from typing import NamedTuple, Optional
 
+import jax
 import jax.numpy as jnp
+from jax import lax
 
 from sentinel_tpu.stats import events as ev
 
@@ -277,6 +279,51 @@ def add_rows_multi(spec: WindowSpec, state: WindowState, rows: jnp.ndarray,
     """Scatter-add with per-element event ids (fused multi-event record)."""
     k = _bucket_of(spec, now_idx)
     counters = state.counters.at[rows, k, event_ids].add(amounts, mode="drop")
+    return state._replace(counters=counters)
+
+
+def add_rows_hist(spec: WindowSpec, state: WindowState, rows: jnp.ndarray,
+                  event_ids: jnp.ndarray, amount: jnp.ndarray,
+                  now_idx: jnp.ndarray, chunk: int = 1 << 15) -> WindowState:
+    """:func:`add_rows_multi` for SMALL row tables with heavy index
+    collisions (the alt origin/chain table): per-(row, lane) counts via a
+    chunked one-hot matmul on the MXU, then ONE dense bucket-slice add —
+    measured 10.1 → 3.3 ms against the colliding [2B]-index scatter at
+    1M updates into 1024 rows on the v5 chip (BASELINE round-5
+    continuation A/B).
+
+    ``amount`` is the batch's single UNIFORM acquire (int32 scalar, may
+    be traced): the matmul counts pure 0/1 one-hots (bf16 operands are
+    exact, f32 accumulation is exact below 2^24 — asserted) and the
+    scaling happens in int32 afterwards, so the result is bit-identical
+    to the scatter for any uniform-acquire batch. Padding rows == R drop
+    via the extra one-hot class."""
+    R = state.counters.shape[0]
+    n_ev = state.counters.shape[2]
+    n = rows.shape[0]
+    ch = min(chunk, n)
+    pad = (-n) % ch          # fill the last chunk with drop-class rows —
+    if pad:                  # bit-identical, and non-power-of-2 batches
+        rows = jnp.concatenate(   # keep full-width matmul chunks
+            [rows, jnp.full(pad, R, rows.dtype)])
+        event_ids = jnp.concatenate(
+            [event_ids, jnp.zeros(pad, event_ids.dtype)])
+        n += pad
+    assert n < (1 << 24), "histogram add needs count sums exact in f32"
+
+    def _chunk(carry, xs):
+        r, e = xs
+        oh = jax.nn.one_hot(r, R + 1, dtype=jnp.bfloat16)
+        v = jax.nn.one_hot(e, n_ev, dtype=jnp.bfloat16)
+        return carry + jnp.dot(oh.T, v,
+                               preferred_element_type=jnp.float32), None
+
+    delta, _ = lax.scan(
+        _chunk, jnp.zeros((R + 1, n_ev), jnp.float32),
+        (rows.reshape(n // ch, ch), event_ids.reshape(n // ch, ch)))
+    counts = delta.astype(jnp.int32)[:R] * amount
+    k = _bucket_of(spec, now_idx)
+    counters = state.counters.at[:, k, :].add(counts)
     return state._replace(counters=counters)
 
 

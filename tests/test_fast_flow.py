@@ -243,3 +243,57 @@ def test_fast_flow_matches_scalar_on_origin_free(clk):
         assert np.array_equal(np.asarray(v1.allow), np.asarray(v.allow))
         assert np.array_equal(np.asarray(v1.wait_ms), np.asarray(v.wait_ms))
     _assert_state_equal(s1, s2)
+
+
+def test_sync_row_covers_every_gathered_pair():
+    """The fast path's per-rule stat fold rests on one compile-time
+    invariant: for every (row, slot) pair in the rule-gather table, the
+    gathered rule's ``sync_row`` IS the stat row the general path would
+    select — the row itself for MAIN/ORIGIN/CHAIN selection, ``ref_row``
+    for RELATE. A rule-compiler change that breaks this silently breaks
+    ``flow_check_fast``'s base reads, so pin it on a randomized load."""
+    from sentinel_tpu.core.registry import (
+        OriginRegistry, Registry, ResourceRegistry,
+    )
+    from sentinel_tpu.rules import flow as flow_mod
+
+    rng = np.random.default_rng(11)
+    R = 256
+    resources = ResourceRegistry(R)
+    origins = OriginRegistry(16)
+    contexts = Registry(16, reserved=("sentinel_default_context",))
+    rules = []
+    for i in range(64):
+        res = f"r{rng.integers(0, 40)}"
+        strategy = int(rng.integers(0, 3))
+        rules.append(flow_mod.FlowRule(
+            resource=res,
+            count=float(rng.integers(1, 50)),
+            grade=int(rng.integers(0, 2)),
+            strategy=strategy,
+            ref_resource=(f"ref{rng.integers(0, 8)}"
+                          if strategy == flow_mod.STRATEGY_RELATE
+                          else (f"ctx{rng.integers(0, 4)}"
+                                if strategy == flow_mod.STRATEGY_CHAIN
+                                else "")),
+            limit_app=rng.choice(["default", "other", "app-x"]),
+            control_behavior=int(rng.integers(0, 4)),
+            warm_up_period_sec=5))
+    compiled = flow_mod.compile_flow_rules(
+        rules, resource_registry=resources, context_registry=contexts,
+        capacity=len(rules), k_per_resource=8, num_rows=R,
+        origin_registry=origins)
+    idx = np.asarray(compiled.rule_idx)
+    sync = np.asarray(compiled.table.sync_row)
+    sel = np.asarray(compiled.table.sel_kind)
+    ref = np.asarray(compiled.table.ref_row)
+    nf = sync.shape[0] - 1
+    checked = 0
+    for row in range(R):
+        for j in idx[row]:
+            if j == nf:
+                continue        # padding sentinel
+            expected = ref[j] if sel[j] == flow_mod.SEL_REF else row
+            assert sync[j] == expected, (row, j, sync[j], expected)
+            checked += 1
+    assert checked >= 64        # every rule row reached through the gather
