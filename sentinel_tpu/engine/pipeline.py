@@ -583,7 +583,7 @@ def decide_entries(
         else:
             alt_second = refresh_rows(spec.second, state.alt_second,
                                       alt_targets, now_idx_s)
-        if fast_flow and RA <= 4096:
+        if fast_flow and RA <= 4096 and 2 * batch.rows.shape[0] < (1 << 24):
             # the [2B]-index scatter collides massively on the small alt
             # table; the histogram matmul is ~3x cheaper on the MXU, and
             # fast_flow's host-verified uniform acquire makes its int32

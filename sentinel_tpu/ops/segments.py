@@ -114,6 +114,22 @@ def ranks_by_key(key: jnp.ndarray) -> jnp.ndarray:
     return jnp.zeros((n,), jnp.int32).at[order].set(rank_s)
 
 
+def ranks_per_slot(key2d: jnp.ndarray) -> jnp.ndarray:
+    """:func:`ranks_by_key` over each SLOT column of a [B, K] pair-key
+    table → int32[B, K].
+
+    Valid whenever slot columns carry DISJOINT key groups — true for the
+    rule-gather tables: a rule lives at exactly one (row, slot), so every
+    admission segment is confined to one slot and K sorts of [B]
+    reproduce the flattened [B*K] sort's ranks exactly. Caveat carried
+    once here for both call sites (flow_check_scalar / flow_check_fast):
+    a sentinel key shared ACROSS slots (the invalid/padding group) ranks
+    differently per slot than globally — callers must never consume
+    sentinel ranks (both flow paths mask them)."""
+    K = key2d.shape[1]
+    return jnp.stack([ranks_by_key(key2d[:, k]) for k in range(K)], axis=1)
+
+
 def padded_table_gather(idx_table: jnp.ndarray, rows: jnp.ndarray,
                         sentinel) -> jnp.ndarray:
     """Gather ``idx_table[rows]`` ([R, K] → [B, K]) where out-of-range

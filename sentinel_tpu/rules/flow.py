@@ -46,7 +46,8 @@ from jax import lax
 from sentinel_tpu.ops import segments as seg
 from sentinel_tpu.stats import events as ev
 from sentinel_tpu.stats.window import (
-    WindowSpec, WindowState, prev_window_sum_rows, window_sum_rows,
+    WindowSpec, WindowState, prev_window_sum_rows, window_sum_all,
+    window_sum_rows,
 )
 
 # Grades (reference RuleConstant.FLOW_GRADE_*)
@@ -723,7 +724,10 @@ def flow_check_scalar(
     # its table row (limit=+inf, is_rl off) is equivalent and saves the
     # applies[rj] gather.
     key = jnp.where(valid_bk, rj, NF)
-    rank = seg.ranks_by_key(key)                             # int32[BK]
+    # per-slot ranks: slot columns carry disjoint rule sets (see
+    # seg.ranks_per_slot; the NF sentinel group's per-slot ranks only
+    # feed the npairs lane of the inactive rule)
+    rank = seg.ranks_per_slot(key.reshape(B, K)).reshape(-1)  # int32[BK]
 
     a_bk = jnp.repeat(acquire, K).astype(jnp.float32)
     is_rl_eff = is_rl & applies
@@ -880,15 +884,22 @@ def flow_check_fast(
     # ride the packed gather below — no [B]-sized gather over the 1M-row
     # window table at all. Only the ORIGIN/CHAIN reads are per-event, and
     # those hit the small [RA]-row alt table. ----
-    safe_orow = jnp.minimum(batch.origin_rows, RA - 1)
-    safe_crow = jnp.minimum(batch.chain_rows, RA - 1)
-    or_pass = window_sum_rows(spec, alt_second, safe_orow, ev.PASS,
-                              now_idx_s).astype(jnp.float32)
-    cr_pass = window_sum_rows(spec, alt_second, safe_crow, ev.PASS,
-                              now_idx_s).astype(jnp.float32)
+    # the alt table is tiny ([RA] rows): sum it DENSELY once (cheap) and
+    # gather [B] values from the result — one gather per read instead of
+    # per-bucket counter+stamp gathers; padding rows index the appended 0
+    alt_pass_dense = jnp.concatenate([
+        window_sum_all(spec, alt_second, ev.PASS,
+                       now_idx_s).astype(jnp.float32),
+        jnp.zeros((1,), jnp.float32)])
+    safe_orow = jnp.minimum(batch.origin_rows, RA)
+    safe_crow = jnp.minimum(batch.chain_rows, RA)
+    or_pass = alt_pass_dense[safe_orow]
+    cr_pass = alt_pass_dense[safe_crow]
     if has_thread_rules:
-        or_thr = alt_threads[safe_orow].astype(jnp.float32)
-        cr_thr = alt_threads[safe_crow].astype(jnp.float32)
+        alt_thr_dense = jnp.concatenate([
+            alt_threads.astype(jnp.float32), jnp.zeros((1,), jnp.float32)])
+        or_thr = alt_thr_dense[safe_orow]
+        cr_thr = alt_thr_dense[safe_crow]
 
     # per-rule selected-row reads ([NF+1]-sized; sync_row covers both the
     # MAIN row — the rule's own resource — and the REF row for RELATE)
@@ -959,7 +970,9 @@ def flow_check_fast(
     subrow = jnp.where(use_alt & ~rl_p, alt_row + 1, 0)
     key = rules_bk * (RA + 1) + subrow
     key = jnp.where(valid_pair, key, NF * (RA + 1))
-    rank = seg.ranks_by_key(key.reshape(-1)).reshape(B, K)
+    # per-slot ranks: slot columns carry disjoint rule sets (see
+    # seg.ranks_per_slot; sentinel ranks are never consumed)
+    rank = seg.ranks_per_slot(key)
 
     # ---- admission (closed forms) ----
     a_f = acq_of_rule                       # the uniform acquire, float32
