@@ -264,7 +264,7 @@ def ablate(jax, spec, ruleset, state0, batches, t0_ms, STEPS,
 
     results = {}
 
-    fast_kw = (dict(fast_flow=True, skip_threads=True)
+    fast_kw = (dict(fast_flow=True, skip_threads=True, scalar_has_rl=False)
                if mode == "fast" else {})
 
     def run(name, *stub_names):
@@ -379,7 +379,11 @@ def measure(jax, mode: str, R: int, B: int, STEPS: int, NRULES: int,
 
     # skip_threads mirrors the runtime's elision for this ruleset (all
     # QPS-grade, no system rules — VERDICT r4 #2)
-    flow_kw = ({"fast_flow": True} if mode in ("fast",) else {})
+    # scalar_has_rl=False mirrors the runtime's auto-derived flag for
+    # this fixture (no rate-limiter rules loaded) — the RL columns and
+    # closed forms compile away
+    flow_kw = ({"fast_flow": True, "scalar_has_rl": False}
+               if mode in ("fast",) else {})
     step = jax.jit(functools.partial(decide_entries, spec,
                                      enable_occupy=False, record_alt=True,
                                      skip_auth=True, skip_sys=True,

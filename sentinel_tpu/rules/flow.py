@@ -622,7 +622,12 @@ def flow_check_scalar(
     minute_spec: Optional[WindowSpec] = None,
     main_minute: Optional[WindowState] = None,
     now_idx_m: Optional[jnp.ndarray] = None,
-    has_rate_limiter: bool = False,   # STATIC: ruleset has RL/WU-RL rules
+    has_rate_limiter: bool = True,    # STATIC: ruleset has RL/WU-RL rules
+    # — False elides the RL columns, closed forms, and pair math entirely
+    # (NOT just the pacing update): only pass False when the loaded
+    # ruleset truly has no RL/WU-RL rules, or they admit as DEFAULT.
+    # Safe default True matches flow_check_fast: forgetting the flag
+    # costs performance, never correctness.
     rules_bk: Optional[jnp.ndarray] = None,   # pre-gathered [B, K] rule
     # ids (the pipeline's joint flow+degrade gather); None = gather here
 ) -> Tuple[FlowDynState, jnp.ndarray, jnp.ndarray]:
@@ -678,39 +683,19 @@ def flow_check_scalar(
                & (~table.cluster_mode)
                & ((table.sel_kind == SEL_MAIN)
                   | (table.sel_kind == SEL_REF)))
-    is_rl = (((table.behavior == BEHAVIOR_RATE_LIMITER)
-              | (table.behavior == BEHAVIOR_WARM_UP_RATE_LIMITER))
-             & (table.grade == GRADE_QPS))
-
     # DEFAULT/WARM_UP: pair with rank r passes iff
     #   (base + r*a) + a <= eff_limit   — same operand association as the
     # general path's `base + excl + amounts <= limit` so the float32
     # rounding is identical (bit-exact while r*a < 2^24, where the general
     # path's cumsum is itself exact)
-
-    # RATE_LIMITER closed form (cost is per-rule for uniform acquire).
-    # All arithmetic stays per-RULE and BOUNDED: the admitted-rank budget
-    # max_k = (now + maxq - base_time) // cost has numerator in
-    # [0, cost + maxq] (due ⇒ base_time = now - cost; else now - L0 <
-    # cost), so no rank*cost product over the unbounded arrival rank can
-    # overflow int32 — a pair passes iff rank < max_k.
     acq_of_rule = jnp.float32(0) + jnp.max(
         jnp.where(valid, acquire, 0)).astype(jnp.float32)    # the uniform a
-    count_safe = jnp.maximum(table.count, 1e-9)
-    cost = jnp.round(acq_of_rule / count_safe * 1000.0).astype(jnp.int32)
-    L0 = dyn.latest_passed_ms
-    due = (L0 + cost - rel_now_ms) <= 0
-    base_time = jnp.where(due, rel_now_ms - cost, L0)
-    maxq_eff = jnp.where(table.count > 0, table.max_queue_ms,
-                         jnp.int32(-1))  # count<=0 RL blocks everything
-    rl_numer = rel_now_ms + maxq_eff - base_time
-    max_k = jnp.maximum(rl_numer // jnp.maximum(cost, 1), 0)
-    # cost == 0 (huge count): every rank shares one wait = max(base-now,0),
-    # matching the general path's uniform-latest case
-    wait0_ok = jnp.maximum(base_time - rel_now_ms, 0) <= maxq_eff
-    max_k = jnp.where(cost > 0, max_k,
-                      jnp.where(wait0_ok, jnp.int32(2 ** 30), 0))
-    max_k = jnp.where(table.count > 0, max_k, 0)
+    if has_rate_limiter:
+        is_rl = (((table.behavior == BEHAVIOR_RATE_LIMITER)
+                  | (table.behavior == BEHAVIOR_WARM_UP_RATE_LIMITER))
+                 & (table.grade == GRADE_QPS))
+        base_time, cost, max_k = _rl_closed_form(
+            table, dyn, acq_of_rule, rel_now_ms)
 
     # ---- per-pair work ----
     if rules_bk is None:
@@ -730,42 +715,47 @@ def flow_check_scalar(
     rank = seg.ranks_per_slot(key.reshape(B, K)).reshape(-1)  # int32[BK]
 
     a_bk = jnp.repeat(acquire, K).astype(jnp.float32)
-    is_rl_eff = is_rl & applies
     limit_eff = jnp.where(applies, eff_limit, jnp.float32(3e38))
-    # ONE packed per-rule verdict gather [NF+1, 6]: int columns plus the
-    # two float columns bitcast to int32 (exact round-trip). RL math stays
-    # int32 — float32 ms arithmetic drifts after ~4.6 h of uptime.
-    vt = jnp.stack([
-        is_rl_eff.astype(jnp.int32),                         # 0
-        base_time,                                           # 1
-        cost,                                                # 2
-        max_k,                                               # 3
-        lax.bitcast_convert_type(base, jnp.int32),           # 4
-        lax.bitcast_convert_type(limit_eff, jnp.int32),      # 5
-    ], axis=1)
-    g = vt[key]                                              # [BK, 6]
-    base_pair = lax.bitcast_convert_type(g[:, 4], jnp.float32)
-    limit_pair = lax.bitcast_convert_type(g[:, 5], jnp.float32)
+    # ONE packed per-rule verdict gather: int columns plus the float
+    # columns bitcast to int32 (exact round-trip). RL math stays int32 —
+    # float32 ms arithmetic drifts after ~4.6 h of uptime. The 4 RL
+    # columns + their pair math only exist when a rate-limiter rule is
+    # loaded (static elision, mirrors flow_check_fast).
+    cols = [
+        lax.bitcast_convert_type(base, jnp.int32),           # 0
+        lax.bitcast_convert_type(limit_eff, jnp.int32),      # 1
+    ]
+    if has_rate_limiter:
+        cols += [(is_rl & applies).astype(jnp.int32),        # 2
+                 base_time, cost, max_k]                     # 3, 4, 5
+    vt = jnp.stack(cols, axis=1)
+    g = vt[key]                                              # [BK, C]
+    base_pair = lax.bitcast_convert_type(g[:, 0], jnp.float32)
+    limit_pair = lax.bitcast_convert_type(g[:, 1], jnp.float32)
     rankf = rank.astype(jnp.float32)
 
     pass_default = (base_pair + rankf * a_bk) + a_bk <= limit_pair
-    # RL: pass iff rank < max_k (the rank-prefix form of
-    # `base_time + (rank+1)*cost - now <= maxQueueing`, exactly the
-    # general path's fixed point for uniform cost — and overflow-free).
-    # wait for PASSING pairs only: (rank+1)*cost is bounded there.
-    pass_rl = rank < g[:, 3]
-    safe_rank = jnp.minimum(rank, g[:, 3])     # blocked lanes: clamp the
-    # product so dead-lane arithmetic can't overflow int32
-    wait_pair = jnp.maximum(
-        g[:, 1] + (safe_rank + 1) * g[:, 2] - rel_now_ms, 0)
-    pair_is_rl = g[:, 0] != 0
-    pair_pass = jnp.where(pair_is_rl, pass_rl, pass_default)
-    pair_pass = pair_pass | (key == NF)
-    pair_wait = jnp.where(pair_is_rl & pair_pass & (key != NF),
-                          wait_pair, 0)
+    if has_rate_limiter:
+        # RL: pass iff rank < max_k (the rank-prefix form of
+        # `base_time + (rank+1)*cost - now <= maxQueueing`, exactly the
+        # general path's fixed point for uniform cost — overflow-free).
+        # wait for PASSING pairs only: (rank+1)*cost is bounded there.
+        pass_rl = rank < g[:, 5]
+        safe_rank = jnp.minimum(rank, g[:, 5])   # blocked lanes: clamp
+        # the product so dead-lane arithmetic can't overflow int32
+        wait_pair = jnp.maximum(
+            g[:, 3] + (safe_rank + 1) * g[:, 4] - rel_now_ms, 0)
+        pair_is_rl = g[:, 2] != 0
+        pair_pass = jnp.where(pair_is_rl, pass_rl, pass_default)
+        pair_pass = pair_pass | (key == NF)
+        pair_wait = jnp.where(pair_is_rl & pair_pass & (key != NF),
+                              wait_pair, 0)
+        wait_ms = jnp.max(pair_wait.reshape(B, K), axis=1)
+    else:
+        pair_pass = pass_default | (key == NF)
+        wait_ms = jnp.zeros((B,), jnp.int32)
 
     allow = jnp.all(pair_pass.reshape(B, K), axis=1)
-    wait_ms = jnp.max(pair_wait.reshape(B, K), axis=1)
 
     # ---- pacing-clock update (only when the ruleset has RL rules) ----
     if has_rate_limiter:
@@ -857,25 +847,14 @@ def flow_check_fast(
     dyn, eff_limit = _warmup_sync_and_limits(
         table, dyn, spec, main_second, now_idx_s, rel_now_ms,
         minute_spec, main_minute, now_idx_m)
-    is_rl_rule = (((table.behavior == BEHAVIOR_RATE_LIMITER)
-                   | (table.behavior == BEHAVIOR_WARM_UP_RATE_LIMITER))
-                  & (table.grade == GRADE_QPS))
-
-    # RL closed form, per rule — identical math to flow_check_scalar
     acq_of_rule = jnp.float32(0) + jnp.max(
         jnp.where(batch.valid, batch.acquire, 0)).astype(jnp.float32)
-    count_safe = jnp.maximum(table.count, 1e-9)
-    cost = jnp.round(acq_of_rule / count_safe * 1000.0).astype(jnp.int32)
-    L0 = dyn.latest_passed_ms
-    due = (L0 + cost - rel_now_ms) <= 0
-    base_time = jnp.where(due, rel_now_ms - cost, L0)
-    maxq_eff = jnp.where(table.count > 0, table.max_queue_ms, jnp.int32(-1))
-    rl_numer = rel_now_ms + maxq_eff - base_time
-    max_k = jnp.maximum(rl_numer // jnp.maximum(cost, 1), 0)
-    wait0_ok = jnp.maximum(base_time - rel_now_ms, 0) <= maxq_eff
-    max_k = jnp.where(cost > 0, max_k,
-                      jnp.where(wait0_ok, jnp.int32(2 ** 30), 0))
-    max_k = jnp.where(table.count > 0, max_k, 0)
+    if has_rate_limiter:
+        is_rl_rule = (((table.behavior == BEHAVIOR_RATE_LIMITER)
+                       | (table.behavior == BEHAVIOR_WARM_UP_RATE_LIMITER))
+                      & (table.grade == GRADE_QPS))
+        base_time, cost, max_k = _rl_closed_form(
+            table, dyn, acq_of_rule, rel_now_ms)
 
     # ---- stat reads. MAIN/REF rows are PER-RULE quantities: a valid
     # (event, rule) pair always has rule.sync_row == the event's row (the
@@ -907,27 +886,29 @@ def flow_check_fast(
     row_pass = window_sum_rows(spec, main_second, srow_sel, ev.PASS,
                                now_idx_s).astype(jnp.float32)
 
-    # ---- ONE packed per-rule gather [NF+1, C] → [B, K, C] (columns
-    # 11/12 exist only when a THREAD-grade rule is loaded) ----
+    # ---- ONE packed per-rule gather [NF+1, C] → [B, K, C]. Column count
+    # is STATIC per ruleset: the RL block (4 columns + closed forms) only
+    # exists when a rate-limiter rule is loaded, the thread block (2
+    # columns) only when something reads the gauges — the same static
+    # elision as skip_auth/skip_sys/skip_threads ----
     cols = [
         table.active.astype(jnp.int32),                      # 0
         table.limit_origin,                                  # 1
         table.cluster_mode.astype(jnp.int32),                # 2
         table.sel_kind,                                      # 3
         table.ref_context,                                   # 4
-        is_rl_rule.astype(jnp.int32),                        # 5
-        base_time,                                           # 6
-        cost,                                                # 7
-        max_k,                                               # 8
-        lax.bitcast_convert_type(eff_limit, jnp.int32),      # 9
-        lax.bitcast_convert_type(row_pass, jnp.int32),       # 10
+        lax.bitcast_convert_type(eff_limit, jnp.int32),      # 5
+        lax.bitcast_convert_type(row_pass, jnp.int32),       # 6
     ]
+    ncol = 7
+    if has_rate_limiter:
+        i_rl, i_bt, i_cost, i_mk = ncol, ncol + 1, ncol + 2, ncol + 3
+        cols += [is_rl_rule.astype(jnp.int32), base_time, cost, max_k]
+        ncol += 4
     if has_thread_rules:
+        i_thr, i_grade = ncol, ncol + 1
         row_thr = main_threads[srow_sel].astype(jnp.float32)
-        cols += [
-            lax.bitcast_convert_type(row_thr, jnp.int32),    # 11
-            table.grade,                                     # 12
-        ]
+        cols += [lax.bitcast_convert_type(row_thr, jnp.int32), table.grade]
     vt = jnp.stack(cols, axis=1)
     g = vt[rules_bk]                                         # [B, K, C]
 
@@ -952,22 +933,25 @@ def flow_check_fast(
 
     # ---- per-pair base (selected stat row's count; MAIN/REF both come
     # from the per-rule sync_row column) ----
-    main_pass_p = lax.bitcast_convert_type(g[..., 10], jnp.float32)
+    main_pass_p = lax.bitcast_convert_type(g[..., 6], jnp.float32)
     alt_pass_p = jnp.where(kind == SEL_CHAIN, cr_pass[:, None],
                            or_pass[:, None])
     cur_pass = jnp.where(use_alt, alt_pass_p, main_pass_p)
     if has_thread_rules:
-        main_thr_p = lax.bitcast_convert_type(g[..., 11], jnp.float32)
+        main_thr_p = lax.bitcast_convert_type(g[..., i_thr], jnp.float32)
         alt_thr_p = jnp.where(kind == SEL_CHAIN, cr_thr[:, None],
                               or_thr[:, None])
         cur_thr = jnp.where(use_alt, alt_thr_p, main_thr_p)
-        base = jnp.where(g[..., 12] == GRADE_QPS, cur_pass, cur_thr)
+        base = jnp.where(g[..., i_grade] == GRADE_QPS, cur_pass, cur_thr)
     else:
         base = cur_pass              # no THREAD-grade rule reads the gauge
 
     # ---- composite-key arrival ranks (the only cross-event pass) ----
-    rl_p = g[..., 5] != 0
-    subrow = jnp.where(use_alt & ~rl_p, alt_row + 1, 0)
+    if has_rate_limiter:
+        rl_p = g[..., i_rl] != 0
+        subrow = jnp.where(use_alt & ~rl_p, alt_row + 1, 0)
+    else:
+        subrow = jnp.where(use_alt, alt_row + 1, 0)
     key = rules_bk * (RA + 1) + subrow
     key = jnp.where(valid_pair, key, NF * (RA + 1))
     # per-slot ranks: slot columns carry disjoint rule sets (see
@@ -977,17 +961,22 @@ def flow_check_fast(
     # ---- admission (closed forms) ----
     a_f = acq_of_rule                       # the uniform acquire, float32
     rankf = rank.astype(jnp.float32)
-    limit_pair = lax.bitcast_convert_type(g[..., 9], jnp.float32)
+    limit_pair = lax.bitcast_convert_type(g[..., 5], jnp.float32)
     pass_default = (base + rankf * a_f) + a_f <= limit_pair
-    pass_rl = rank < g[..., 8]
-    safe_rank = jnp.minimum(rank, g[..., 8])
-    wait_pair = jnp.maximum(
-        g[..., 6] + (safe_rank + 1) * g[..., 7] - rel_now_ms, 0)
-    pair_pass = jnp.where(rl_p, pass_rl, pass_default) | ~valid_pair
-    pair_wait = jnp.where(rl_p & pair_pass & valid_pair, wait_pair, 0)
+    if has_rate_limiter:
+        pass_rl = rank < g[..., i_mk]
+        safe_rank = jnp.minimum(rank, g[..., i_mk])
+        wait_pair = jnp.maximum(
+            g[..., i_bt] + (safe_rank + 1) * g[..., i_cost] - rel_now_ms,
+            0)
+        pair_pass = jnp.where(rl_p, pass_rl, pass_default) | ~valid_pair
+        pair_wait = jnp.where(rl_p & pair_pass & valid_pair, wait_pair, 0)
+        wait_ms = jnp.max(pair_wait, axis=1)
+    else:
+        pair_pass = pass_default | ~valid_pair
+        wait_ms = jnp.zeros((B,), jnp.int32)
 
     allow = jnp.all(pair_pass, axis=1)
-    wait_ms = jnp.max(pair_wait, axis=1)
 
     # ---- pacing-clock update (per rule; RL segments are per-rule) ----
     if has_rate_limiter:
@@ -1006,6 +995,36 @@ def flow_check_fast(
 
     allow = allow | ~batch.valid
     return dyn, allow, wait_ms.astype(jnp.int32)
+
+
+def _rl_closed_form(table: FlowRuleTable, dyn: FlowDynState,
+                    acq_of_rule: jnp.ndarray, rel_now_ms: jnp.ndarray):
+    """Per-rule RATE_LIMITER closed form → (base_time, cost, max_k),
+    shared bit-exactly by the scalar and fast paths (cost is per-rule
+    for uniform acquire — RateLimiterController.java:30-90).
+
+    All arithmetic stays per-RULE and BOUNDED: the admitted-rank budget
+    ``max_k = (now + maxq - base_time) // cost`` has numerator in
+    ``[0, cost + maxq]`` (due ⇒ base_time = now - cost; else
+    now - L0 < cost), so no rank*cost product over the unbounded arrival
+    rank can overflow int32 — a pair passes iff ``rank < max_k``.
+    ``cost == 0`` (huge count): every rank shares one wait =
+    ``max(base - now, 0)``, matching the general path's uniform-latest
+    case. ``count <= 0`` RL blocks everything."""
+    count_safe = jnp.maximum(table.count, 1e-9)
+    cost = jnp.round(acq_of_rule / count_safe * 1000.0).astype(jnp.int32)
+    L0 = dyn.latest_passed_ms
+    due = (L0 + cost - rel_now_ms) <= 0
+    base_time = jnp.where(due, rel_now_ms - cost, L0)
+    maxq_eff = jnp.where(table.count > 0, table.max_queue_ms,
+                         jnp.int32(-1))
+    rl_numer = rel_now_ms + maxq_eff - base_time
+    max_k = jnp.maximum(rl_numer // jnp.maximum(cost, 1), 0)
+    wait0_ok = jnp.maximum(base_time - rel_now_ms, 0) <= maxq_eff
+    max_k = jnp.where(cost > 0, max_k,
+                      jnp.where(wait0_ok, jnp.int32(2 ** 30), 0))
+    max_k = jnp.where(table.count > 0, max_k, 0)
+    return base_time, cost, max_k
 
 
 def _warmup_sync_and_limits(

@@ -297,3 +297,65 @@ def test_sync_row_covers_every_gathered_pair():
             assert sync[j] == expected, (row, j, sync[j], expected)
             checked += 1
     assert checked >= 64        # every rule row reached through the gather
+
+
+def test_rl_elision_parity(clk):
+    """With no rate-limiter rules loaded, BOTH optimized paths compile
+    without the RL columns/closed forms (scalar_has_rl=False — the
+    headline bench's configuration) and must stay bit-exact vs the
+    general path: the fast path on full origin/chain/fallback batches,
+    the scalar path on origin-free ones."""
+    sph = make_sentinel(clk)
+    rules = [r for r in _rules()
+             if r.control_behavior not in (
+                 stpu.BEHAVIOR_RATE_LIMITER,
+                 stpu.BEHAVIOR_WARM_UP_RATE_LIMITER)]
+    sph.load_flow_rules(rules)
+    sph.load_degrade_rules(DEG_RULES)
+    assert not sph._scalar_has_rl          # the elision actually engages
+    origin_ids = np.array([sph.origins.pin("app-a"),
+                           sph.origins.pin("app-b")], np.int32)
+    ctx_ids = np.array([sph.contexts.pin("some_ctx")], np.int32)
+    spec = sph.spec
+    gen = jax.jit(functools.partial(
+        decide_entries, spec, enable_occupy=False, record_alt=True))
+    fast = jax.jit(functools.partial(
+        decide_entries, spec, enable_occupy=False, record_alt=True,
+        fast_flow=True, scalar_has_rl=False))
+    sca = jax.jit(functools.partial(
+        decide_entries, spec, enable_occupy=False, record_alt=False,
+        scalar_flow=True, scalar_has_rl=False))
+    rng = np.random.default_rng(29)
+    sysv = jnp.asarray(np.array([0.1, 0.1], np.float32))
+    s1 = s2 = sph._state
+    for step in range(6):
+        b = _origin_batch(sph, rng, 96, RESOURCES, origin_ids, ctx_ids,
+                          fallback=(step % 2 == 0))
+        times = sph._time_scalars(clk.now_ms())
+        s1, v1 = gen(sph._ruleset, s1, b, times, sysv)
+        s2, v2 = fast(sph._ruleset, s2, b, times, sysv)
+        assert np.array_equal(np.asarray(v1.allow), np.asarray(v2.allow))
+        assert np.array_equal(np.asarray(v1.wait_ms),
+                              np.asarray(v2.wait_ms))
+        _assert_state_equal(s1, s2)
+        clk.advance_ms(int(rng.integers(20, 400)))
+    # scalar elision on an origin-free batch (its host preconditions)
+    n = 96
+    names = [RESOURCES[i] for i in rng.integers(0, len(RESOURCES), n)]
+    rows = np.array([sph.resources.get_or_create(r) for r in names],
+                    np.int32)
+    b = EntryBatch(
+        rows=jnp.asarray(rows),
+        origin_ids=jnp.zeros(n, jnp.int32),
+        origin_rows=jnp.full(n, spec.alt_rows, jnp.int32),
+        context_ids=jnp.zeros(n, jnp.int32),
+        chain_rows=jnp.full(n, spec.alt_rows, jnp.int32),
+        acquire=jnp.ones(n, jnp.int32),
+        is_in=jnp.ones(n, jnp.bool_),
+        prioritized=jnp.zeros(n, jnp.bool_),
+        valid=jnp.asarray(rng.random(n) > 0.1))
+    times = sph._time_scalars(clk.now_ms())
+    s1, v1 = gen(sph._ruleset, s1, b, times, sysv)
+    s3, v3 = sca(sph._ruleset, s2, b, times, sysv)
+    assert np.array_equal(np.asarray(v1.allow), np.asarray(v3.allow))
+    assert np.array_equal(np.asarray(v1.wait_ms), np.asarray(v3.wait_ms))
