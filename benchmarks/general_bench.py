@@ -174,6 +174,9 @@ def ablate(jax, spec, ruleset, state0, batches, t0_ms, STEPS,
     def stub_winsum(wspec, wstate, rows, event, now_idx):
         return jnp.zeros(rows.shape, jnp.int32)
 
+    def stub_winsum_all(wspec, wstate, event, now_idx):
+        return jnp.zeros((wstate.counters.shape[0],), jnp.int32)
+
     def stub_warmup(table, dyn, wspec, main_second, now_idx_s, rel_now_ms,
                     minute_spec, main_minute, now_idx_m):
         return dyn, table.count
@@ -222,6 +225,9 @@ def ablate(jax, spec, ruleset, state0, batches, t0_ms, STEPS,
         "sort": (seg_mod, "sort_by_keys", stub_sort_by_keys),
         "unsort": (seg_mod, "unsort", stub_unsort),
         "winsum": (pl.flow_mod, "window_sum_rows", stub_winsum),
+        # the fast path's alt reads go through the DENSE sum since the
+        # round-5 continuation — stub both for a complete -winsum
+        "winsumall": (pl.flow_mod, "window_sum_all", stub_winsum_all),
         "warmup": (pl.flow_mod, "_warmup_sync_and_limits", stub_warmup),
         "prefix": (seg_mod, "segment_prefix_sum", stub_prefix),
         "admit": (seg_mod, "greedy_admit", stub_admit),
@@ -293,7 +299,7 @@ def ablate(jax, spec, ruleset, state0, batches, t0_ms, STEPS,
         run("FULL")
         run("-joint-gather", "joint")
         run("-ranksort", "ranks")
-        run("-winsum", "winsum")
+        run("-winsum", "winsum", "winsumall")
         run("-warmup", "warmup")
         run("-flow(whole)", "flowfast")
         run("-degrade", "degscalar")
