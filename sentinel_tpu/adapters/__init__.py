@@ -24,7 +24,7 @@ from sentinel_tpu.adapters.wsgi import SentinelWSGIMiddleware
 from sentinel_tpu.adapters.asgi import SentinelASGIMiddleware
 from sentinel_tpu.adapters.asyncio_support import async_entry
 from sentinel_tpu.adapters.http_client import (
-    SentinelSession, guarded_urlopen,
+    SentinelAiohttpSession, SentinelSession, guarded_urlopen,
 )
 from sentinel_tpu.adapters.asgi_gateway import (
     AsgiRequestItemParser, SentinelGatewayASGIMiddleware,
@@ -32,6 +32,7 @@ from sentinel_tpu.adapters.asgi_gateway import (
 
 __all__ = [
     "sentinel_resource", "SentinelWSGIMiddleware", "SentinelASGIMiddleware",
-    "async_entry", "SentinelSession", "guarded_urlopen",
+    "async_entry", "SentinelAiohttpSession", "SentinelSession",
+    "guarded_urlopen",
     "AsgiRequestItemParser", "SentinelGatewayASGIMiddleware",
 ]

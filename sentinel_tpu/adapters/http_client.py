@@ -1,6 +1,7 @@
 """Outbound HTTP client guards (reference ``sentinel-okhttp-adapter``
-``SentinelOkHttpInterceptor`` and ``sentinel-apache-httpclient-adapter``
-``SentinelApacheHttpClientExecChainHandler``).
+``SentinelOkHttpInterceptor``, ``sentinel-apache-httpclient-adapter``
+``SentinelApacheHttpClientExecChainHandler``, and — for the async
+variant — ``sentinel-spring-webflux-adapter``'s WebClient integration).
 
 Resource defaults to ``httpclient:METHOD:host/path-sans-query`` like the
 reference's ``OkHttpResourceExtractor``; override via ``resource_extractor``.
@@ -69,3 +70,45 @@ def guarded_urlopen(sentinel, url, *args,
         raise
     e.exit()
     return resp
+
+
+def SentinelAiohttpSession(sentinel, *,
+                           resource_extractor: Optional[Callable[[str, str],
+                                                                 str]] = None,
+                           **kw):
+    """An ``aiohttp.ClientSession`` guarding every outbound request —
+    the async-client analog of :class:`SentinelSession` (reference
+    ``sentinel-spring-webflux-adapter`` WebClient integration: entry
+    before the exchange, block surfaces as the request's exception,
+    5xx and transport errors trace into the exception stats).
+
+    Deny raises :class:`BlockException` from the ``await``; a pacing
+    wait is awaited on the event loop, never slept (the entry lifecycle
+    — pacing await, cancellation safety, trace-on-exception, exit —
+    rides :class:`~sentinel_tpu.adapters.asyncio_support.async_entry`).
+    Defined lazily so importing this module never requires aiohttp."""
+    import warnings
+
+    import aiohttp
+
+    from sentinel_tpu.adapters.asyncio_support import async_entry
+
+    # aiohttp deprecates ClientSession subclassing, but overriding
+    # _request is the only seam that keeps the whole request API intact
+    # (session.get(...) stays awaitable AND an async context manager);
+    # a composition wrapper would lose that dual protocol
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+
+        class _Session(aiohttp.ClientSession):
+            async def _request(self, method, str_or_url, **k):
+                resource = (resource_extractor or default_resource)(
+                    str(method), str(str_or_url))
+                async with async_entry(sentinel, resource, entry_type=0,
+                                       resource_type=TYPE_COMMON) as e:
+                    resp = await super()._request(method, str_or_url, **k)
+                    if resp.status >= 500:
+                        e.trace(RuntimeError(f"http {resp.status}"))
+                    return resp
+
+    return _Session(**kw)

@@ -1,0 +1,104 @@
+"""aiohttp adapters end-to-end: the server middleware
+(adapters/aiohttp_server.py) and the guarded client session
+(adapters/http_client.SentinelAiohttpSession), over a real aiohttp
+server on a real event loop."""
+
+import asyncio
+
+import pytest
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+
+aiohttp = pytest.importorskip("aiohttp")
+from aiohttp import web  # noqa: E402
+from aiohttp.test_utils import TestClient, TestServer  # noqa: E402
+
+from sentinel_tpu.adapters.aiohttp_server import sentinel_middleware
+from sentinel_tpu.adapters.http_client import SentinelAiohttpSession
+
+T0 = 1_700_000_000_000
+
+
+def make_sentinel():
+    cfg = stpu.load_config(max_resources=64, max_flow_rules=16,
+                           max_degrade_rules=16, max_authority_rules=16)
+    return stpu.Sentinel(config=cfg, clock=ManualClock(start_ms=T0))
+
+
+def test_server_middleware_limits_and_traces():
+    sph = make_sentinel()
+    sph.load_flow_rules([stpu.FlowRule(resource="GET:/api", count=2)])
+
+    async def api(request):
+        return web.json_response({"ok": True})
+
+    async def boom(request):
+        raise web.HTTPInternalServerError(text="boom")
+
+    async def run():
+        app = web.Application(middlewares=[sentinel_middleware(sph)])
+        app.router.add_get("/api", api)
+        app.router.add_get("/boom", boom)
+        async with TestClient(TestServer(app)) as client:
+            statuses = [(await client.get("/api")).status for _ in range(4)]
+            assert statuses == [200, 200, 429, 429]
+            blocked = await client.get("/api")
+            assert "Blocked by Sentinel" in await blocked.text()
+            # an exploding handler traces into exception stats + exits
+            assert (await client.get("/boom")).status == 500
+        return True
+
+    assert asyncio.run(run())
+    totals = {name: t for name, _row, t in sph.all_node_totals()}
+    assert totals["GET:/api"]["pass"] == 2
+    assert totals["GET:/api"]["block"] == 3
+    assert totals["GET:/boom"]["exception"] == 1
+    assert totals["GET:/boom"]["threads"] == 0     # every entry exited
+
+
+def test_client_session_guards_outbound():
+    sph = make_sentinel()
+
+    async def upstream(request):
+        if request.path == "/flaky":
+            return web.Response(status=503)
+        return web.Response(text="hi")
+
+    async def run():
+        app = web.Application()
+        app.router.add_get("/ok", upstream)
+        app.router.add_get("/flaky", upstream)
+        server = TestServer(app)
+        await server.start_server()
+        base = f"http://{server.host}:{server.port}"
+        resource = f"httpclient:GET:{server.host}:{server.port}/ok"
+        sph.load_flow_rules([stpu.FlowRule(resource=resource, count=2)])
+        session = SentinelAiohttpSession(sph)
+        try:
+            ok = 0
+            blocked = 0
+            for _ in range(5):
+                try:
+                    r = await session.get(f"{base}/ok")
+                    assert r.status == 200 and await r.text() == "hi"
+                    ok += 1
+                except stpu.BlockException:
+                    blocked += 1
+            assert (ok, blocked) == (2, 3)
+            # 5xx responses trace an exception but still return
+            r = await session.get(f"{base}/flaky")
+            assert r.status == 503
+        finally:
+            await session.close()
+            await server.close()
+        return resource
+
+    resource = asyncio.run(run())
+    totals = {name: t for name, _row, t in sph.all_node_totals()}
+    assert totals[resource]["pass"] == 2
+    assert totals[resource]["block"] == 3
+    flaky = [t for name, _row, t in sph.all_node_totals()
+             if name.endswith("/flaky")]
+    assert flaky and flaky[0]["exception"] == 1
+    assert all(t["threads"] == 0 for _n, _r, t in sph.all_node_totals())
