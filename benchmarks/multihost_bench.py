@@ -1,0 +1,58 @@
+"""1-process vs 2-process decision throughput on the CPU mesh → JSON.
+
+Same engine geometry (8 shards), same deterministic stream, two
+topologies: one process owning all 8 virtual devices vs two coordinated
+processes owning 4 each (``multihost.launch``). On a CPU mesh the
+2-process number includes the gloo collective + allgather readback tax,
+so expect it BELOW the 1-process number — the artifact exists to track
+that overhead, not to advertise speedup (real gains need real hosts).
+
+Usage (from /root/repo): python benchmarks/multihost_bench.py
+Artifact: multihost_bench.json (override with MULTIHOST_BENCH_OUT).
+Knobs: MH_BENCH_BATCH (default 512), MH_BENCH_BATCHES (default 40).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _run(num_processes: int, devices_per_process: int) -> dict:
+    from sentinel_tpu.multihost.launch import launch
+
+    env = {}
+    for k in ("MH_BENCH_BATCH", "MH_BENCH_BATCHES"):
+        if os.environ.get(k):
+            env[k] = os.environ[k]
+    results = launch(
+        ["-m", "sentinel_tpu.multihost._parity_worker", "--bench"],
+        num_processes, devices_per_process=devices_per_process,
+        env=env, timeout_s=600)
+    for r in results:
+        for line in r.stdout.splitlines():
+            if line.startswith("BENCH_JSON:"):
+                return json.loads(line.split(":", 1)[1])
+    raise RuntimeError("bench worker produced no BENCH_JSON line")
+
+
+def main() -> None:
+    out = {
+        "one_process": _run(1, 8),
+        "two_process": _run(2, 4),
+    }
+    out["rps_ratio_2p_over_1p"] = round(
+        out["two_process"]["rps"] / out["one_process"]["rps"], 4)
+    path = os.environ.get("MULTIHOST_BENCH_OUT", "multihost_bench.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+    print(f"wrote {path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""CI gate: the 2-process CPU mesh must decide exactly like 1 process.
+
+Standalone (no pytest) so it can run as its own workflow job and fail
+with a readable diff: launches the deterministic parity worker once per
+topology — 1 process × 8 devices, then 2 coordinated processes × 4
+devices — and compares every (status, wait_ms, remaining) triple.
+
+Usage (from the repo root): python ci/multihost_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _parity(num_processes: int, devices_per_process: int) -> dict:
+    from sentinel_tpu.multihost.launch import launch
+
+    results = launch(["-m", "sentinel_tpu.multihost._parity_worker"],
+                     num_processes,
+                     devices_per_process=devices_per_process, timeout_s=300)
+    for r in results:
+        for line in r.stdout.splitlines():
+            if line.startswith("PARITY_JSON:"):
+                return json.loads(line.split(":", 1)[1])
+    raise RuntimeError("parity worker produced no PARITY_JSON line")
+
+
+def main() -> int:
+    one = _parity(1, 8)
+    two = _parity(2, 4)
+    a, b = one["decisions"], two["decisions"]
+    if a == b:
+        statuses = sorted({d[0] for d in a})
+        print(f"PARITY OK: {len(a)} decisions identical across topologies "
+              f"(1x8dev vs 2x4dev); statuses seen: {statuses}")
+        return 0
+    print(f"PARITY FAILED: {len(a)} vs {len(b)} decisions", file=sys.stderr)
+    for i, (x, y) in enumerate(zip(a, b)):
+        if x != y:
+            print(f"  first mismatch at {i}: 1proc={x} 2proc={y}",
+                  file=sys.stderr)
+            break
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -86,7 +86,17 @@ def SentinelAiohttpSession(sentinel, *,
     wait is awaited on the event loop, never slept (the entry lifecycle
     — pacing await, cancellation safety, trace-on-exception, exit —
     rides :class:`~sentinel_tpu.adapters.asyncio_support.async_entry`).
-    Defined lazily so importing this module never requires aiohttp."""
+    Defined lazily so importing this module never requires aiohttp.
+
+    Entry-exit timing (PINNED, diverges from the WebFlux reference):
+    the entry exits at HEADERS time — when ``_request`` returns the
+    response object — not when the body is released/closed. RT and the
+    live-concurrency gauge therefore cover connect + request + first
+    response byte, excluding body streaming; the WebFlux adapter's
+    ``doFinally`` covers the full exchange including the body. Rationale
+    + migration notes in docs/MIGRATION.md ("aiohttp client entry
+    window"); behavior pinned by
+    tests/test_aiohttp_adapter.py::test_entry_exits_at_headers_time."""
     import warnings
 
     import aiohttp

@@ -67,6 +67,15 @@ class ClusterTokenServer:
                  idle_seconds: float = DEFAULT_IDLE_SECONDS,
                  batch_window_ms: float = DEFAULT_BATCH_WINDOW_MS,
                  log_dir: Optional[str] = None):
+        if getattr(engine, "_multiprocess", False):
+            # Socket-driven stepping from ONE process would leave the
+            # other hosts out of the collective and deadlock the mesh;
+            # multi-process serving must route every step through the
+            # collective ingest path on all processes instead.
+            raise ValueError(
+                "ClusterTokenServer cannot front an engine on a "
+                "multi-process mesh; drive it with "
+                "sentinel_tpu.multihost.MultihostIngest on every process")
         self.engine = engine
         self.concurrent = concurrent or ConcurrentTokenManager()
         self.clock = clock or Clock()

@@ -285,21 +285,39 @@ class Dashboard:
                                   max_allowed_qps: Optional[float] = None,
                                   namespaces: Optional[list] = None) -> dict:
         """Apply a server-config edit: the namespace set, the
-        per-namespace QPS ceiling, or both in one call."""
+        per-namespace QPS ceiling, or both in one call.
+
+        The two writes are NOT transactional on the agent: a flow-config
+        failure after the namespace set already landed reports partial
+        success naming what applied and what didn't, so the operator
+        re-submits only the failed half instead of assuming a clean
+        rollback."""
+        ns_applied = False
         try:
             if namespaces is not None:
                 if not self.client.set_cluster_server_namespace_set(
                         ip, port, [str(n) for n in namespaces]):
                     return _fail("modify namespace set rejected")
+                ns_applied = True
             if max_allowed_qps is not None:
                 if not namespace:
-                    return _fail("namespace required for maxAllowedQps")
+                    return self._maybe_partial(
+                        ns_applied, "namespace required for maxAllowedQps")
                 if not self.client.set_cluster_server_flow_config(
                         ip, port, namespace, float(max_allowed_qps)):
-                    return _fail("modify flow config rejected")
+                    return self._maybe_partial(
+                        ns_applied, "modify flow config rejected")
         except AgentUnreachable as exc:
-            return _fail(str(exc))
+            return self._maybe_partial(ns_applied, str(exc))
         return _ok("success")
+
+    @staticmethod
+    def _maybe_partial(ns_applied: bool, msg: str) -> dict:
+        if ns_applied:
+            return _fail(
+                "partial success: namespace set applied, but flow config "
+                f"did not: {msg}")
+        return _fail(msg)
 
     def cluster_assign(self, app: str, server_ip: str, server_port: int,
                        request_timeout_ms: int = 10_000) -> dict:

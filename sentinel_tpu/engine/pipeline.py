@@ -43,8 +43,8 @@ from sentinel_tpu.rules import system as sys_mod
 from sentinel_tpu.stats import events as ev
 from sentinel_tpu.stats.window import (
     WindowSpec, WindowState, add_one_row, add_rows, add_rows_hist,
-    add_rows_multi, add_rows_vec, init_window, invalidate_rows,
-    refresh_all, refresh_rows,
+    add_rows_multi, add_rows_vec, hist_add_fits, init_window,
+    invalidate_rows, refresh_all, refresh_rows,
 )
 
 
@@ -583,7 +583,7 @@ def decide_entries(
         else:
             alt_second = refresh_rows(spec.second, state.alt_second,
                                       alt_targets, now_idx_s)
-        if fast_flow and RA <= 4096 and 2 * batch.rows.shape[0] < (1 << 24):
+        if fast_flow and RA <= 4096 and hist_add_fits(2 * batch.rows.shape[0]):
             # the [2B]-index scatter collides massively on the small alt
             # table; the histogram matmul is ~3x cheaper on the MXU, and
             # fast_flow's host-verified uniform acquire makes its int32

@@ -1,0 +1,33 @@
+"""Multi-host mesh runtime: real multi-process operation for the engines.
+
+Takes the product engine (:mod:`sentinel_tpu.runtime` over a ``"rows"``
+mesh) and the cluster token engine
+(:mod:`sentinel_tpu.parallel.cluster`) from single-process virtual
+meshes to a coordinator-bootstrapped multi-process mesh — the reference
+system's own deployment shape (N processes speaking to shared state),
+rebuilt as one SPMD program spanning hosts.
+
+Pieces:
+
+* :mod:`~sentinel_tpu.multihost.bootstrap` — ``jax.distributed``
+  bring-up/teardown from env vars or programmatic config;
+* :mod:`~sentinel_tpu.multihost.mesh` — the global mesh over every
+  host's local devices, plus row-layout re-pinning helpers;
+* :mod:`~sentinel_tpu.multihost.ingest` — host-local batch ingestion
+  driving :meth:`ClusterEngine.step_routed` collectively;
+* :mod:`~sentinel_tpu.multihost.launch` — N-process CPU-mesh spawner so
+  all of the above is testable in CI without TPUs.
+"""
+
+from sentinel_tpu.multihost.bootstrap import (
+    MultihostConfig, MultihostRuntime, active_runtime, initialize,
+)
+from sentinel_tpu.multihost.ingest import MultihostIngest
+from sentinel_tpu.multihost.launch import LaunchError, free_port, launch
+from sentinel_tpu.multihost import mesh
+
+__all__ = [
+    "MultihostConfig", "MultihostRuntime", "MultihostIngest",
+    "LaunchError", "active_runtime", "free_port", "initialize", "launch",
+    "mesh",
+]

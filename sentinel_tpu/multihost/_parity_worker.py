@@ -1,0 +1,119 @@
+"""Worker for multihost tests/benchmarks: deterministic token streams.
+
+Spawned by :func:`sentinel_tpu.multihost.launch.launch` (any process
+count — the same script is the 1-process reference and the N-process
+subject). Bootstraps from env, builds the global mesh + cluster engine,
+replays a fixed rule set and a seeded request stream through
+:class:`MultihostIngest`, and prints one ``PARITY_JSON:``-prefixed line
+from the coordinator with every decision — the byte-identical payload
+``tests/test_multihost.py`` compares across process counts.
+
+``--bench`` switches to a throughput loop (same engine, bigger batches)
+and emits ``BENCH_JSON:`` instead — consumed by
+``benchmarks/multihost_bench.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+NOW0 = 10_000_000
+SEED = 0xC1A0
+N_FLOWS = 24
+FLOW0 = 100
+
+
+def build_engine():
+    from sentinel_tpu.multihost import mesh as mh_mesh
+    from sentinel_tpu.parallel.cluster import (
+        THRESHOLD_AVG_LOCAL, THRESHOLD_GLOBAL, ClusterEngine,
+        ClusterFlowRule, ClusterSpec,
+    )
+    mesh = mh_mesh.global_mesh()
+    n_dev = mesh.devices.size
+    spec = ClusterSpec(n_shards=n_dev, flows_per_shard=16, namespaces=4)
+    engine = ClusterEngine(spec, mesh=mesh)
+
+    # identical replay on every process (SPMD requirement)
+    rules_a = [ClusterFlowRule(
+        flow_id=FLOW0 + i, count=3 + (i % 5),
+        threshold_type=(THRESHOLD_AVG_LOCAL if i % 4 == 0
+                        else THRESHOLD_GLOBAL))
+        for i in range(N_FLOWS // 2)]
+    rules_b = [ClusterFlowRule(
+        flow_id=FLOW0 + i, count=4 + (i % 7), threshold_type=THRESHOLD_GLOBAL)
+        for i in range(N_FLOWS // 2, N_FLOWS)]
+    engine.load_rules("ns-a", rules_a)
+    engine.load_rules("ns-b", rules_b)
+    engine.set_connected_count("ns-a", 3)
+    engine.set_namespace_qps_limit("ns-b", 40)
+    return engine
+
+
+def stream(batches: int, batch: int):
+    """Seeded request stream, independent of topology."""
+    rng = np.random.RandomState(SEED)
+    for t in range(batches):
+        ids = rng.randint(FLOW0 - 2, FLOW0 + N_FLOWS + 2, size=batch)
+        acq = rng.randint(-1, 4, size=batch)   # includes bad requests
+        prio = rng.rand(batch) < 0.25
+        yield ids, acq, prio, NOW0 + t * 137
+    # and one batch a window later: slide/replenish must agree too
+    ids = rng.randint(FLOW0, FLOW0 + N_FLOWS, size=batch)
+    yield ids, np.ones(batch, np.int64), np.zeros(batch, np.bool_), \
+        NOW0 + 2_000
+
+
+def run_parity(ingest) -> dict:
+    out = []
+    for ids, acq, prio, now in stream(batches=6, batch=64):
+        out.extend(list(map(list, ingest.request_tokens(
+            ids, acq, prio, now_ms=now))))
+    return {"decisions": out}
+
+
+def run_bench(ingest, batches: int = 0, batch: int = 0) -> dict:
+    batch = batch or int(os.environ.get("MH_BENCH_BATCH", "512"))
+    batches = batches or int(os.environ.get("MH_BENCH_BATCHES", "40"))
+    # warmup: trigger every compile outside the timed region
+    for ids, acq, prio, now in stream(batches=2, batch=batch):
+        ingest.request_tokens(ids, acq, prio, now_ms=now)
+    t0 = time.perf_counter()
+    n = 0
+    for t in range(batches):
+        ids = np.arange(batch, dtype=np.int64) % N_FLOWS + FLOW0
+        acq = np.ones(batch, np.int64)
+        ingest.request_tokens(ids, acq, None,
+                              now_ms=NOW0 + 10_000 + t * 97)
+        n += batch
+    dt = time.perf_counter() - t0
+    return {"requests": n, "elapsed_s": dt, "rps": n / dt,
+            "batch": batch, "batches": batches}
+
+
+def main(argv) -> int:
+    from sentinel_tpu import multihost
+
+    bench = "--bench" in argv
+    with multihost.initialize() as rt:
+        engine = build_engine()
+        ingest = multihost.MultihostIngest(engine)
+        payload = run_bench(ingest) if bench else run_parity(ingest)
+        payload.update(
+            process_count=rt.process_count,
+            n_devices=len(rt.global_devices()),
+            local_shards=list(ingest.local_shards))
+        if rt.process_index == 0:
+            tag = "BENCH_JSON:" if bench else "PARITY_JSON:"
+            print(tag + json.dumps(payload), flush=True)
+        rt.barrier("parity-done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,180 @@
+"""Multi-process bring-up: ``jax.distributed.initialize`` with guardrails.
+
+One process per host (the JAX requirement), one of them doubling as the
+coordinator. Config comes from the environment (the ``SENTINEL_*``
+variables :func:`MultihostConfig.from_env` reads — what
+:mod:`~sentinel_tpu.multihost.launch` exports into workers) or is built
+programmatically; :func:`initialize` applies the platform switches that
+MUST land before the backend spins up (CPU platform + gloo collectives —
+without gloo the CPU backend refuses multi-process computations), calls
+``jax.distributed.initialize``, and hands back a :class:`MultihostRuntime`
+that tears everything down on ``close()``/``with``-exit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Mapping, Optional
+
+_ENV_COORDINATOR = "SENTINEL_COORDINATOR"
+_ENV_NUM_PROCESSES = "SENTINEL_NUM_PROCESSES"
+_ENV_PROCESS_ID = "SENTINEL_PROCESS_ID"
+_ENV_LOCAL_DEVICES = "SENTINEL_LOCAL_DEVICES"
+_ENV_PLATFORM = "SENTINEL_MH_PLATFORM"
+
+_active: Optional["MultihostRuntime"] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class MultihostConfig:
+    """Static multi-process topology for one participating process."""
+
+    coordinator: str               # "host:port" every process can reach
+    num_processes: int
+    process_id: int
+    local_devices: Optional[int] = None   # CPU: virtual devices per host
+    platform: Optional[str] = "cpu"       # None = leave backend selection
+
+    def __post_init__(self):
+        if self.num_processes < 1:
+            raise ValueError("num_processes must be >= 1")
+        if not (0 <= self.process_id < self.num_processes):
+            raise ValueError(
+                f"process_id {self.process_id} outside "
+                f"[0, {self.num_processes})")
+        if ":" not in self.coordinator:
+            raise ValueError(
+                f"coordinator must be host:port, got {self.coordinator!r}")
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+    @classmethod
+    def from_env(cls, env: Optional[Mapping[str, str]] = None,
+                 ) -> "MultihostConfig":
+        """Build from ``SENTINEL_COORDINATOR`` / ``SENTINEL_NUM_PROCESSES``
+        / ``SENTINEL_PROCESS_ID`` (+ optional ``SENTINEL_LOCAL_DEVICES``,
+        ``SENTINEL_MH_PLATFORM``) — the contract
+        :func:`sentinel_tpu.multihost.launch.launch` exports to workers."""
+        env = os.environ if env is None else env
+        missing = [k for k in
+                   (_ENV_COORDINATOR, _ENV_NUM_PROCESSES, _ENV_PROCESS_ID)
+                   if not env.get(k)]
+        if missing:
+            raise KeyError(
+                "multihost bootstrap env incomplete; missing "
+                + ", ".join(missing))
+        local = env.get(_ENV_LOCAL_DEVICES)
+        return cls(
+            coordinator=env[_ENV_COORDINATOR],
+            num_processes=int(env[_ENV_NUM_PROCESSES]),
+            process_id=int(env[_ENV_PROCESS_ID]),
+            local_devices=int(local) if local else None,
+            platform=env.get(_ENV_PLATFORM, "cpu") or None)
+
+
+class MultihostRuntime:
+    """Live handle for an initialized multi-process JAX runtime."""
+
+    def __init__(self, config: MultihostConfig):
+        self.config = config
+        self._closed = False
+
+    @property
+    def process_index(self) -> int:
+        import jax
+        return jax.process_index()
+
+    @property
+    def process_count(self) -> int:
+        import jax
+        return jax.process_count()
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.config.is_coordinator
+
+    def local_devices(self):
+        import jax
+        return jax.local_devices()
+
+    def global_devices(self):
+        import jax
+        return jax.devices()
+
+    def barrier(self, name: str = "sentinel-mh") -> None:
+        """Block until every process reaches the same point."""
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
+
+    def close(self) -> None:
+        """Tear down the distributed client (idempotent)."""
+        global _active
+        if self._closed:
+            return
+        self._closed = True
+        if _active is self:
+            _active = None
+        import jax
+        if self.config.num_processes > 1:
+            jax.distributed.shutdown()
+
+    def __enter__(self) -> "MultihostRuntime":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def active_runtime() -> Optional[MultihostRuntime]:
+    """The live runtime from a prior :func:`initialize`, if any."""
+    return _active
+
+
+def initialize(config: Optional[MultihostConfig] = None) -> MultihostRuntime:
+    """Bring this process into the multi-process mesh.
+
+    Order matters and is enforced here: platform + collective switches go
+    in through ``jax.config`` BEFORE ``jax.distributed.initialize`` (the
+    CPU backend only does cross-process computation with the gloo
+    collectives implementation, and the switch is read at backend
+    creation). ``config=None`` reads :func:`MultihostConfig.from_env`.
+
+    Single-process configs (``num_processes == 1``) skip the distributed
+    handshake entirely, so the same worker code runs 1-process reference
+    jobs and N-process jobs unchanged.
+    """
+    global _active
+    if _active is not None:
+        raise RuntimeError(
+            "multihost runtime already initialized; close() it first "
+            "(jax.distributed supports one client per process)")
+    if config is None:
+        config = MultihostConfig.from_env()
+
+    if config.local_devices:
+        # only effective before the backend exists — launch.py sets it in
+        # the child environment; this keeps programmatic use working too
+        flag = ("--xla_force_host_platform_device_count="
+                f"{config.local_devices}")
+        xla_flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in xla_flags:
+            os.environ["XLA_FLAGS"] = f"{xla_flags} {flag}".strip()
+
+    import jax
+    if config.platform:
+        jax.config.update("jax_platforms", config.platform)
+    if config.platform == "cpu" and config.num_processes > 1:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    if config.num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=config.coordinator,
+            num_processes=config.num_processes,
+            process_id=config.process_id)
+
+    runtime = MultihostRuntime(config)
+    _active = runtime
+    return runtime

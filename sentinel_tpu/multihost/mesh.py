@@ -1,0 +1,78 @@
+"""Global mesh construction + row-layout re-pinning across hosts.
+
+The single-process engines build their meshes from ``jax.devices()`` of
+one process; here the same call returns EVERY host's devices (ordered by
+process index), so the helpers below are thin — their value is pinning
+the conventions in one place:
+
+* the cluster token engine's axis is ``"shard"`` (one device per shard,
+  :mod:`sentinel_tpu.parallel.cluster`),
+* the product engine's axis is ``"rows"``
+  (:mod:`sentinel_tpu.parallel.local_shard`), and
+* the row-sharded ``[R, B, E]`` window layouts re-pin onto the global
+  mesh with a plain ``device_put`` — each process materializes only the
+  shards it owns, which is exactly what host-local ingestion needs.
+
+Geometry checks route through :mod:`sentinel_tpu.parallel.shard_math`
+(the one shard-math implementation shared with both engines).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sentinel_tpu.parallel import shard_math
+
+CLUSTER_AXIS = "shard"   # parallel/cluster.py mesh axis
+LOCAL_AXIS = "rows"      # parallel/local_shard.py MESH_AXIS
+
+
+def global_mesh(axis: str = CLUSTER_AXIS,
+                devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over every device of every participating process.
+
+    ``jax.devices()`` already orders globally by (process, local id), so
+    process *p*'s devices form one contiguous slab of the axis →
+    contiguous row slabs per host, matching the
+    ``row // rows_per_shard`` ownership math in ``shard_math``.
+    """
+    devs = np.array(jax.devices() if devices is None else list(devices))
+    return Mesh(devs, (axis,))
+
+
+def spans_processes(mesh: Mesh) -> bool:
+    """True when the mesh crosses process boundaries (real multihost)."""
+    return len({d.process_index for d in np.ravel(mesh.devices)}) > 1
+
+
+def local_shard_indices(mesh: Mesh) -> List[int]:
+    """Positions along the (1-D) mesh axis owned by THIS process."""
+    pid = jax.process_index()
+    return [i for i, d in enumerate(np.ravel(mesh.devices))
+            if d.process_index == pid]
+
+
+def validate_global_rows(name: str, dim: int, mesh: Mesh) -> None:
+    """Row dimension must divide over the global device count."""
+    shard_math.validate_divisible(name, dim, int(np.ravel(mesh.devices).size))
+
+
+def row_sharding(mesh: Mesh, axis: Optional[str] = None) -> NamedSharding:
+    """Shard axis 0 (rows) over the mesh axis."""
+    return NamedSharding(mesh, P(axis or mesh.axis_names[0]))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def repin_rows(tree, mesh: Mesh, axis: Optional[str] = None):
+    """Re-place every leaf of a row-leading pytree (the ``[R, B, E]``
+    window layouts) onto the global mesh's row sharding. Works from any
+    process: ``device_put`` materializes only the locally-owned shards."""
+    sh = row_sharding(mesh, axis)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)

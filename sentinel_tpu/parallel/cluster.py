@@ -44,13 +44,24 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.6 exposes shard_map at top level
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+try:  # jax >= 0.6 exposes shard_map at top level (kwarg: check_vma)
+    from jax import shard_map as _shard_map_impl
+    _SM_CHECK_KW = "check_vma"
+except ImportError:  # pragma: no cover — older jax (kwarg: check_rep)
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+    _SM_CHECK_KW = "check_rep"
+
+
+def _shard_map(body, *, mesh, in_specs, out_specs, check_vma=True):
+    """shard_map across jax versions: ``check_vma`` (≥ 0.6) and its
+    predecessor ``check_rep`` are the same switch under different names."""
+    return _shard_map_impl(body, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs,
+                           **{_SM_CHECK_KW: check_vma})
 
 from sentinel_tpu.core.pending import PendingResult, start_host_copy
 from sentinel_tpu.ops import segments as seg
+from sentinel_tpu.parallel import shard_math
 from sentinel_tpu.stats import events as ev
 from sentinel_tpu.stats.window import (
     WindowSpec, WindowState, init_window, valid_mask, window_sum_all,
@@ -398,6 +409,14 @@ class ClusterEngine:
                     f"need {spec.n_shards} devices, have {len(devs)}")
             mesh = Mesh(np.array(devs), ("shard",))
         self.mesh = mesh
+        # Multi-process mesh (multihost/): state + batches shard across
+        # processes; readbacks then go through a cross-process allgather
+        # instead of np.asarray (a host can only address its own shards).
+        # Rule loads / connected counts MUST be replayed identically on
+        # every participating process — the mesh is SPMD, every process
+        # executes every step (multihost/ingest.py drives this).
+        self._multiprocess = len(
+            {d.process_index for d in np.ravel(mesh.devices)}) > 1
         self._sh_rows = NamedSharding(mesh, P("shard"))
         self._sh_rep = NamedSharding(mesh, P())
 
@@ -428,6 +447,7 @@ class ClusterEngine:
         self.state = jax.device_put(init_cluster_state(spec), self._sh_rows)
         self._table = self._empty_table()
         self._step = self._build_step()
+        self._row_gather = None  # lazy jitted row snapshot (multiprocess)
 
     # ------------------------------------------------------------------
     def _empty_table(self) -> ClusterRuleTable:
@@ -684,16 +704,16 @@ class ClusterEngine:
                 jax.device_put(jnp.asarray(self._connected), self._sh_rep),
                 jax.device_put(jnp.asarray(self._ns_limit), self._sh_rep),
                 now_idx, in_win)
-        _start_host_copy(verdicts)
+        self._maybe_start_host_copy(verdicts)
         return PendingTokenResults(functools.partial(
             self._gather_results, verdicts, per_shard, results, S, blp))
 
     def _gather_results(self, verdicts, per_shard, results, S, blp):
         """Deferred readback: materialize the verdict arrays and scatter
         them back into request order (shared by flow + param paths)."""
-        st = np.asarray(verdicts.status).reshape(S, blp)
-        wt = np.asarray(verdicts.wait_ms).reshape(S, blp)
-        rm = np.asarray(verdicts.remaining).reshape(S, blp)
+        st = self._to_host(verdicts.status).reshape(S, blp)
+        wt = self._to_host(verdicts.wait_ms).reshape(S, blp)
+        rm = self._to_host(verdicts.remaining).reshape(S, blp)
         for s in range(S):
             for k, i in enumerate(per_shard[s]):
                 results[i] = (int(st[s, k]), int(wt[s, k]), int(rm[s, k]))
@@ -817,96 +837,128 @@ class ClusterEngine:
                         prio[s, k] = bool(prioritized[i])
                         valid[s, k] = True
 
-            PV = self.spec.max_params
-            PK = self.spec.param_keys_per_shard
-            batch = jax.device_put(TokenBatch(
-                local_rows=jnp.asarray(rows.reshape(-1)),
-                acquire=jnp.asarray(acq.reshape(-1)),
-                prioritized=jnp.asarray(prio.reshape(-1)),
-                valid=jnp.asarray(valid.reshape(-1)),
-                is_param=jnp.asarray(np.zeros((S * blp,), np.bool_)),
-                param_rows=jnp.full((S * blp, PV), PK, jnp.int32),
-                param_count=jnp.zeros((S * blp, PV), jnp.float32)), self._sh_rows)
+            verdicts = self.step_routed(rows, acq, prio, valid, blp,
+                                        now_ms=now_ms)
+        if vec is not None:
+            return PendingTokenResults(functools.partial(
+                self._gather_results_vec, verdicts, gather, blp))
+        return PendingTokenResults(functools.partial(
+            self._gather_results, verdicts, per_shard, results, S, blp))
+
+    def step_routed(self, rows, acq, prio, valid, blp: int, *,
+                    now_ms: int) -> TokenVerdicts:
+        """Run the sharded device step on pre-routed ``[S, Bl]`` lanes
+        (``shard_math.route_requests`` layout) and return the raw sharded
+        verdicts; scatter back with ``shard_math.scatter_verdicts``.
+
+        This is the SPMD choke point shared by the single-process request
+        paths and :mod:`sentinel_tpu.multihost.ingest`. In a multi-process
+        mesh every participating process must call it with the SAME
+        geometry (``blp``), ``now_ms`` and routing plan — only the lanes
+        of shards this host owns need real payload data (``device_put``
+        materializes local shards only); read verdicts back via
+        :meth:`_gather_results_vec` / ``_to_host``.
+        """
+        S = self.spec.n_shards
+        PV = self.spec.max_params
+        PK = self.spec.param_keys_per_shard
+        with self._lock:
+            batch = self._put_rows(TokenBatch(
+                local_rows=rows.reshape(-1).astype(np.int32),
+                acquire=acq.reshape(-1).astype(np.int32),
+                prioritized=prio.reshape(-1).astype(np.bool_),
+                valid=valid.reshape(-1).astype(np.bool_),
+                is_param=np.zeros((S * blp,), np.bool_),
+                param_rows=np.full((S * blp, PV), PK, np.int32),
+                param_count=np.zeros((S * blp, PV), np.float32)))
 
             w = self.spec.window
-            now_idx = jnp.int32(w.index_of(now_ms))
-            in_win = jnp.int32(now_ms % w.win_ms)
+            if self._multiprocess:
+                # scalars must be placed on every process's local devices
+                # (an uncommitted single-device array is not addressable
+                # by the other hosts of the global mesh)
+                now_idx = jax.device_put(
+                    np.int32(w.index_of(now_ms)), self._sh_rep)
+                in_win = jax.device_put(
+                    np.int32(now_ms % w.win_ms), self._sh_rep)
+            else:
+                now_idx = jnp.int32(w.index_of(now_ms))
+                in_win = jnp.int32(now_ms % w.win_ms)
             self.state, verdicts = self._step(
                 self._table, self.state, batch,
                 jax.device_put(jnp.asarray(self._connected), self._sh_rep),
                 jax.device_put(jnp.asarray(self._ns_limit), self._sh_rep),
                 now_idx, in_win)
-        _start_host_copy(verdicts)
-        if vec is not None:
-            src, sh_s, pos, status0 = gather
-            return PendingTokenResults(functools.partial(
-                self._gather_results_vec, verdicts, src, sh_s, pos,
-                status0, blp))
-        return PendingTokenResults(functools.partial(
-            self._gather_results, verdicts, per_shard, results, S, blp))
+        self._maybe_start_host_copy(verdicts)
+        return verdicts
 
-    def _vector_prep(self, flow_ids, acquire, prioritized, n: int, S: int,
-                     L: int):
-        """Vectorized request grouping via the dense fid lookup: one
-        argsort + scatter instead of per-event dict/append loops. → None
-        to fall back to the loop path (sparse ids, non-int input), or
-        ``(prep_arrays_or_None, gather_ctx_or_final_results)``."""
+    def _put_rows(self, tree):
+        """Place a host pytree on the row sharding. Multi-process meshes
+        need ``make_array_from_callback`` — each process materializes its
+        OWN shards from its own host arrays (``device_put`` would instead
+        assert the value is identical on every process, defeating
+        host-local ingestion where non-local lanes hold garbage/zeros)."""
+        if not self._multiprocess:
+            return jax.device_put(tree, self._sh_rows)
+        return jax.tree.map(
+            lambda x: jax.make_array_from_callback(
+                x.shape, self._sh_rows, lambda idx, x=x: x[idx]), tree)
+
+    def _maybe_start_host_copy(self, verdicts: TokenVerdicts) -> None:
+        # The async device→host prefetch only works on fully-addressable
+        # arrays; multi-process readback goes through _to_host's
+        # allgather instead.
+        if not self._multiprocess:
+            _start_host_copy(verdicts)
+
+    def _to_host(self, x) -> np.ndarray:
+        """Materialize a possibly cross-process row-sharded array."""
+        if self._multiprocess:
+            from jax.experimental import multihost_utils
+            return np.asarray(multihost_utils.process_allgather(
+                x, tiled=True))
+        return np.asarray(x)
+
+    def rows_for_flows(self, flow_ids) -> Optional[np.ndarray]:
+        """Global row per flow id (``-1`` = unregistered), vectorized via
+        the dense lookup when possible. → None for sparse/non-int ids
+        (callers fall back to the dict path). The row→shard math on the
+        result is :mod:`~sentinel_tpu.parallel.shard_math`'s."""
         lut = self._fid_lookup
-        if lut is None or n == 0:
+        if lut is None:
             return None
         ids = np.asarray(flow_ids)
         if ids.dtype.kind not in "iu" or ids.ndim != 1:
             return None
-        from sentinel_tpu.core.batching import pad_pow2
-        acq_arr = np.asarray(acquire, np.int64)
-        prio_arr = (np.asarray(prioritized, np.bool_)
-                    if prioritized is not None else np.zeros(n, np.bool_))
         in_rng = (ids >= 0) & (ids < lut.shape[0])
-        rowg = np.where(in_rng, lut[np.clip(ids, 0, lut.shape[0] - 1)], -1)
-        bad = acq_arr <= 0
-        norule = (rowg < 0) & ~bad
-        status0 = np.where(
-            bad, STATUS_BAD_REQUEST,
-            np.where(norule, STATUS_NO_RULE_EXISTS, STATUS_FAIL)).astype(
-                np.int64)
-        ok = ~bad & ~norule
-        if not ok.any():
-            return (None, [(int(s), 0, 0) for s in status0])
-        idx_ok = np.nonzero(ok)[0]
-        sh = rowg[idx_ok] // L
-        order = np.argsort(sh, kind="stable")
-        sh_s = sh[order]
-        counts = np.bincount(sh_s, minlength=S)
-        blp = pad_pow2(int(counts.max()))
-        starts = np.zeros(S, np.int64)
-        np.cumsum(counts[:-1], out=starts[1:])
-        pos = np.arange(sh_s.shape[0], dtype=np.int64) - np.repeat(
-            starts, counts)
-        src = idx_ok[order]
-        rows = np.zeros((S, blp), np.int32)
-        acq2 = np.zeros((S, blp), np.int32)
-        prio2 = np.zeros((S, blp), np.bool_)
-        valid2 = np.zeros((S, blp), np.bool_)
-        rows[sh_s, pos] = (rowg[src] % L).astype(np.int32)
-        acq2[sh_s, pos] = acq_arr[src].astype(np.int32)
-        prio2[sh_s, pos] = prio_arr[src]
-        valid2[sh_s, pos] = True
-        return ((rows, acq2, prio2, valid2, blp), (src, sh_s, pos, status0))
+        return np.where(in_rng, lut[np.clip(ids, 0, lut.shape[0] - 1)], -1)
 
-    def _gather_results_vec(self, verdicts, src, sh_s, pos, status0, blp):
+    def _vector_prep(self, flow_ids, acquire, prioritized, n: int, S: int,
+                     L: int):
+        """Vectorized request grouping (shard_math.route_requests): one
+        argsort + scatter instead of per-event dict/append loops. → None
+        to fall back to the loop path (sparse ids, non-int input), or
+        ``(prep_arrays_or_None, gather_ctx_or_final_results)``."""
+        if n == 0:
+            return None
+        rowg = self.rows_for_flows(flow_ids)
+        if rowg is None:
+            return None
+        lanes, plan = shard_math.route_requests(
+            rowg, acquire, prioritized, S, L,
+            status_fail=STATUS_FAIL, status_bad=STATUS_BAD_REQUEST,
+            status_no_rule=STATUS_NO_RULE_EXISTS)
+        if lanes is None:
+            return (None, [(int(s), 0, 0) for s in plan.status0])
+        return ((lanes.rows, lanes.acquire, lanes.prioritized, lanes.valid,
+                 lanes.lanes), plan)
+
+    def _gather_results_vec(self, verdicts, plan, blp):
         """Vectorized inverse of :meth:`_vector_prep`'s grouping."""
-        S = self.spec.n_shards
-        st = np.asarray(verdicts.status).reshape(S, blp)
-        wt = np.asarray(verdicts.wait_ms).reshape(S, blp)
-        rm = np.asarray(verdicts.remaining).reshape(S, blp)
-        n = status0.shape[0]
-        st_o = status0.copy()
-        wt_o = np.zeros(n, np.int64)
-        rm_o = np.zeros(n, np.int64)
-        st_o[src] = st[sh_s, pos]
-        wt_o[src] = wt[sh_s, pos]
-        rm_o[src] = rm[sh_s, pos]
-        return list(zip(st_o.tolist(), wt_o.tolist(), rm_o.tolist()))
+        return shard_math.scatter_verdicts(
+            plan, blp, self._to_host(verdicts.status),
+            self._to_host(verdicts.wait_ms),
+            self._to_host(verdicts.remaining), self.spec.n_shards)
 
     def top_params(self, flow_id: int, *, now_ms: int,
                    top_n: int = 10) -> Dict[object, int]:
@@ -924,6 +976,22 @@ class ClusterEngine:
                     or self._param_hits_prev.get(flow_id) or {})
             return dict(sorted(hits.items(), key=lambda kv: -kv[1])[:top_n])
 
+    def _row_snapshot(self, row: int):
+        """``(counters[row], stamps[row])`` of the flow window state. In a
+        multi-process mesh a host can't index shards it doesn't own, so
+        the row is gathered on-device to a replicated output — which also
+        means every process must call this collectively (SPMD), same as
+        the step itself."""
+        if not self._multiprocess:
+            return (np.asarray(self.state.flows.counters[row]),
+                    np.asarray(self.state.flows.stamps[row]))
+        if self._row_gather is None:
+            self._row_gather = jax.jit(
+                lambda c, s, r: (c[r], s[r]), out_shardings=self._sh_rep)
+        c, s = self._row_gather(self.state.flows.counters,
+                                self.state.flows.stamps, row)
+        return np.asarray(c), np.asarray(s)
+
     def flow_metrics(self, flow_id: int, *, now_ms: int) -> dict:
         """Per-flow current-window snapshot (ClusterMetricNodeGenerator)."""
         with self._lock:
@@ -932,8 +1000,7 @@ class ClusterEngine:
                 return {}
             w = self.spec.window
             now_idx = jnp.int32(w.index_of(now_ms))
-            counters = np.asarray(self.state.flows.counters[row])   # [B, E]
-            stamps = np.asarray(self.state.flows.stamps[row])       # [B]
+            counters, stamps = self._row_snapshot(row)  # [B, E], [B]
         delta = (int(now_idx) - stamps.astype(np.int64)).astype(np.int32)
         live = (delta >= 0) & (delta < w.buckets)
         tot = np.where(live[:, None], counters, 0).sum(axis=0)

@@ -50,6 +50,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sentinel_tpu.engine.pipeline import EngineSpec, SentinelState, Verdicts
+from sentinel_tpu.parallel import shard_math
 
 MESH_AXIS = "rows"
 
@@ -61,13 +62,12 @@ def validate_mesh(spec: EngineSpec, mesh: Mesh) -> None:
             f"local-engine mesh needs a {MESH_AXIS!r} axis; got "
             f"{mesh.axis_names} — build it as Mesh(devices, ({MESH_AXIS!r},))")
     n = mesh.shape[MESH_AXIS]
-    for name, dim in (("max_resources", spec.rows),
-                      ("alt_rows", spec.alt_rows)):
-        if dim % n:
-            raise ValueError(
-                f"{name}={dim} does not divide over {n} mesh devices; "
-                f"round max_resources up to a multiple of {n} "
-                f"(alt_rows follows it)")
+    shard_math.validate_divisible(
+        "max_resources", spec.rows, n,
+        f"round max_resources up to a multiple of {n}")
+    shard_math.validate_divisible(
+        "alt_rows", spec.alt_rows, n,
+        f"round max_resources up to a multiple of {n} (alt_rows follows it)")
 
 
 def state_shardings(spec: EngineSpec, mesh: Mesh,

@@ -268,7 +268,15 @@ class Sentinel:
         (parallel/local_shard.py), the product form of the north-star
         "single sharded counter tensor". Semantics are identical to the
         single-device engine (parity is pinned by tests); max_resources
-        must be a multiple of the mesh size."""
+        must be a multiple of the mesh size.
+
+        The mesh may be externally built and span PROCESSES (a
+        ``sentinel_tpu.multihost.mesh.global_mesh(axis="rows")`` over a
+        bootstrapped multi-process runtime): state then shards across
+        hosts and ``is_multihost`` is True. That mode is SPMD — every
+        process must construct the engine identically and replay the
+        same rule loads and entry batches in the same order (see
+        docs/OPERATIONS.md "Multi-host pod deployment")."""
         self.cfg = config or load_config()
         self.clock = clock or global_clock()
         self.mesh = mesh
@@ -319,6 +327,11 @@ class Sentinel:
         # the measured round-5 decomposition.
         self._state = init_state(self.spec, cfg.max_flow_rules,
                                  cfg.max_degrade_rules)
+        # Multi-process "rows" mesh (multihost/): replicated leaves
+        # (rules, verdicts) stay host-readable everywhere; row-sharded
+        # leaves are only partially addressable per host.
+        self.is_multihost = mesh is not None and len(
+            {d.process_index for d in np.ravel(np.asarray(mesh.devices))}) > 1
         if mesh is not None:
             from sentinel_tpu.parallel.local_shard import validate_mesh
             validate_mesh(self.spec, mesh)
@@ -892,6 +905,15 @@ class Sentinel:
     def set_global_switch(self, on: bool) -> None:
         """Reference setSwitch command — off = everything passes unchecked."""
         self._global_on = bool(on)
+
+    @property
+    def threads_elided(self) -> bool:
+        """True while thread-gauge maintenance is compiled away (no loaded
+        rule reads live concurrency): ``curThreadNum``-style gauges read 0
+        regardless of traffic. Observability payloads carry this as
+        ``threadsElided`` so an operator can't mistake an elided 0 for an
+        idle system (docs/OPERATIONS.md "Live-concurrency gauges")."""
+        return bool(getattr(self, "_skip_threads", False))
 
     # ------------------------------------------------------------------
     # Time helpers

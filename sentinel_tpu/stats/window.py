@@ -43,6 +43,7 @@ from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from sentinel_tpu.stats import events as ev
@@ -50,8 +51,11 @@ from sentinel_tpu.stats import events as ev
 INT32_MAX = jnp.iinfo(jnp.int32).max
 # Stamp value meaning "never written": far enough behind any real index that
 # (now - stamp) is huge-positive for the first ~6.8 years, and the wraparound
-# beyond that still reads as dead for any B < 2^30.
-NEVER = jnp.int32(-(2 ** 30))
+# beyond that still reads as dead for any B < 2^30. A numpy (not jnp)
+# scalar: materializing a device constant at import time would
+# initialize the backend, which must not happen before
+# jax.distributed.initialize in multi-process runs (multihost/bootstrap).
+NEVER = np.int32(-(2 ** 30))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -282,6 +286,15 @@ def add_rows_multi(spec: WindowSpec, state: WindowState, rows: jnp.ndarray,
     return state._replace(counters=counters)
 
 
+def hist_add_fits(n: int, chunk: int = 1 << 15) -> bool:
+    """True when an ``n``-element :func:`add_rows_hist` stays inside the
+    f32-exactness bound EVEN AFTER chunk padding (the padding adds up to
+    ``chunk - 1`` drop-class rows, so callers guarding on the raw ``n``
+    alone can still trip the assert below). The one predicate both the
+    dispatch guard (engine/pipeline.py fast-flow path) and the assert use."""
+    return n + chunk <= (1 << 24)
+
+
 def add_rows_hist(spec: WindowSpec, state: WindowState, rows: jnp.ndarray,
                   event_ids: jnp.ndarray, amount: jnp.ndarray,
                   now_idx: jnp.ndarray, chunk: int = 1 << 15) -> WindowState:
@@ -309,7 +322,9 @@ def add_rows_hist(spec: WindowSpec, state: WindowState, rows: jnp.ndarray,
         event_ids = jnp.concatenate(
             [event_ids, jnp.zeros(pad, event_ids.dtype)])
         n += pad
-    assert n < (1 << 24), "histogram add needs count sums exact in f32"
+    assert n < (1 << 24), \
+        "histogram add needs count sums exact in f32 (gate callers on " \
+        "hist_add_fits(n), which accounts for this chunk padding)"
 
     def _chunk(carry, xs):
         r, e = xs

@@ -99,6 +99,9 @@ def register_default_handlers(
             "appName": s.cfg.app_name, "appType": s.cfg.app_type,
             "version": __version__, "apiPort": s.cfg.api_port,
             "maxResources": s.cfg.max_resources,
+            # thread gauges currently compiled away → their 0s are elision,
+            # not idleness (flips live with THREAD-rule loads)
+            "threadsElided": bool(getattr(s, "threads_elided", False)),
         }
         info.update(extra_info or {})
         return CommandResponse.of_success(json.dumps(info))
@@ -184,18 +187,25 @@ def register_default_handlers(
             # SendMetricCommandHandler hides the global inbound node unless
             # asked for by name
             nodes = [n for n in nodes if n.resource != TOTAL_IN_RESOURCE_NAME]
-        return CommandResponse.of_success(
-            "".join(n.to_thin_string() + "\n" for n in nodes))
+        body = "".join(n.to_thin_string() + "\n" for n in nodes)
+        if getattr(s, "threads_elided", False) and body:
+            # marker line, not a metric line: dashboard clients skip lines
+            # that don't parse as MetricNode (dashboard/client.py), and
+            # elision-aware readers learn the 0 thread columns are elided
+            body = "# threadsElided=true\n" + body
+        return CommandResponse.of_success(body)
 
     # ---- node tree -------------------------------------------------------
 
     def _node_dicts():
         out = []
         rtypes = dict(getattr(s, "resource_types", {}) or {})
+        elided = bool(getattr(s, "threads_elided", False))
         for name, row, t in s.all_node_totals():
             if not (t["pass"] or t["block"] or t["success"] or t["threads"]):
                 continue
             out.append({
+                "threadsElided": elided,
                 "id": row,
                 "resource": TOTAL_IN_RESOURCE_NAME if row == ENTRY_NODE_ROW
                 else name,

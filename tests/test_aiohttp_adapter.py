@@ -102,3 +102,57 @@ def test_client_session_guards_outbound():
              if name.endswith("/flaky")]
     assert flaky and flaky[0]["exception"] == 1
     assert all(t["threads"] == 0 for _n, _r, t in sph.all_node_totals())
+
+
+def test_entry_exits_at_headers_time():
+    """Pins the documented divergence from the WebFlux reference
+    (docs/MIGRATION.md "aiohttp client entry window", http_client.py):
+    the guarded session's entry exits when response HEADERS arrive, not
+    when the body is released. Under WebFlux doFinally timing, the
+    THREAD-grade count=1 rule below would still hold the first entry
+    while its body is stalled and block the second request."""
+    sph = make_sentinel()
+
+    async def run():
+        gate = asyncio.Event()
+
+        async def slow(request):
+            resp = web.StreamResponse()
+            await resp.prepare(request)          # headers flushed here
+            await resp.write(b"head")
+            await gate.wait()                    # body stalls until released
+            await resp.write(b"tail")
+            await resp.write_eof()
+            return resp
+
+        app = web.Application()
+        app.router.add_get("/slow", slow)
+        server = TestServer(app)
+        await server.start_server()
+        base = f"http://{server.host}:{server.port}"
+        resource = f"httpclient:GET:{server.host}:{server.port}/slow"
+        sph.load_flow_rules([stpu.FlowRule(
+            resource=resource, count=1, grade=stpu.GRADE_THREAD)])
+        session = SentinelAiohttpSession(sph)
+        try:
+            r1 = await session.get(f"{base}/slow")
+            assert r1.status == 200
+            # headers arrived, body still gated — the entry has ALREADY
+            # exited: live concurrency reads 0 ...
+            totals = {n: t for n, _row, t in sph.all_node_totals()}
+            assert totals[resource]["threads"] == 0
+            # ... so a second request sails past the THREAD count=1 rule
+            r2 = await session.get(f"{base}/slow")
+            assert r2.status == 200
+            gate.set()
+            assert (await r1.read()).endswith(b"tail")
+            await r2.read()
+        finally:
+            await session.close()
+            await server.close()
+        return resource
+
+    resource = asyncio.run(run())
+    totals = {name: t for name, _row, t in sph.all_node_totals()}
+    assert totals[resource]["pass"] == 2
+    assert totals[resource]["block"] == 0

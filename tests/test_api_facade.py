@@ -7,6 +7,9 @@ import sentinel_tpu as stpu
 import sentinel_tpu.api as sph
 from sentinel_tpu.core.clock import ManualClock
 
+# core-path subset: the CI quick tier (PRs) runs only these files
+pytestmark = pytest.mark.quick
+
 T0 = 1_785_000_000_000
 
 

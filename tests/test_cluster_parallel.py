@@ -14,6 +14,9 @@ from sentinel_tpu.parallel.cluster import (
     ClusterEngine, ClusterFlowRule, ClusterSpec,
 )
 
+# core-path subset: the CI quick tier (PRs) runs only these files
+pytestmark = pytest.mark.quick
+
 NOW0 = 10_000_000
 
 

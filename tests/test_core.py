@@ -9,6 +9,9 @@ from sentinel_tpu.core.errors import (
 from sentinel_tpu.core.property import SentinelProperty
 from sentinel_tpu.core.registry import ENTRY_NODE_ROW, OriginRegistry, Registry, ResourceRegistry
 
+# core-path subset: the CI quick tier (PRs) runs only these files
+pytestmark = pytest.mark.quick
+
 
 def test_manual_clock():
     c = ManualClock(start_ms=1000)

@@ -465,3 +465,62 @@ def test_origin_stats_route(agent, dash, clk):
     assert out["success"]
     by = {o["origin"]: o["passQps"] for o in out["data"]}
     assert by == {"web-app": 2, "job-runner": 1}
+
+
+def test_cluster_server_config_partial_success_reporting():
+    """The two serverConfig writes are not transactional on the agent: a
+    flow-config failure AFTER the namespace set landed must say exactly
+    what applied and what didn't — not report a clean failure that makes
+    the operator assume a rollback happened."""
+    from sentinel_tpu.dashboard.server import Dashboard
+
+    class StubClient:
+        def __init__(self, flow_result):
+            self.flow_result = flow_result
+            self.calls = []
+
+        def set_cluster_server_namespace_set(self, ip, port, namespaces):
+            self.calls.append(("ns", namespaces))
+            return True
+
+        def set_cluster_server_flow_config(self, ip, port, ns, qps):
+            self.calls.append(("flow", ns, qps))
+            r = self.flow_result
+            if isinstance(r, Exception):
+                raise r
+            return r
+
+    d = Dashboard()
+
+    # flow config rejected after the namespace set already applied
+    d.client = StubClient(flow_result=False)
+    out = d.set_cluster_server_config(
+        "127.0.0.1", 8719, namespace="nsa", max_allowed_qps=5.0,
+        namespaces=["nsa", "nsb"])
+    assert not out["success"]
+    assert out["msg"].startswith("partial success: namespace set applied")
+    assert d.client.calls[0] == ("ns", ["nsa", "nsb"])  # it DID land
+
+    # same shape when the agent dies between the two writes
+    from sentinel_tpu.dashboard.client import AgentUnreachable
+    d.client = StubClient(flow_result=AgentUnreachable("conn reset"))
+    out = d.set_cluster_server_config(
+        "127.0.0.1", 8719, namespace="nsa", max_allowed_qps=5.0,
+        namespaces=["nsa"])
+    assert not out["success"]
+    assert "partial success" in out["msg"] and "conn reset" in out["msg"]
+
+    # flow-config-only failure (no namespace write attempted): a plain
+    # failure — claiming partial success would be just as misleading
+    d.client = StubClient(flow_result=False)
+    out = d.set_cluster_server_config(
+        "127.0.0.1", 8719, namespace="nsa", max_allowed_qps=5.0)
+    assert not out["success"] and "partial success" not in out["msg"]
+
+    # QPS write missing its namespace after a namespace set applied is
+    # ALSO a partial outcome, not a no-op
+    d.client = StubClient(flow_result=True)
+    out = d.set_cluster_server_config(
+        "127.0.0.1", 8719, max_allowed_qps=5.0, namespaces=["nsa"])
+    assert not out["success"]
+    assert out["msg"].startswith("partial success")

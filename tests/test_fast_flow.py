@@ -25,6 +25,9 @@ from sentinel_tpu.engine.pipeline import (
     EntryBatch, ExitBatch, decide_entries, record_exits,
 )
 
+# core-path subset: the CI quick tier (PRs) runs only these files
+pytestmark = pytest.mark.quick
+
 
 def make_sentinel(clock, **cfg_over):
     cfg = stpu.load_config(max_resources=64, max_origins=32,

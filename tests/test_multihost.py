@@ -1,0 +1,208 @@
+"""Multi-host subsystem tests: shard math, bootstrap config, and the
+2-process CPU-mesh parity gate (the tentpole acceptance check — token
+grants and flow decisions over a shared stream must be identical to the
+single-process 8-device result)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from sentinel_tpu.multihost.bootstrap import MultihostConfig
+from sentinel_tpu.multihost.launch import LaunchError, free_port, launch
+from sentinel_tpu.parallel import shard_math
+
+pytestmark = pytest.mark.multihost
+
+PARITY_ARGV = ["-m", "sentinel_tpu.multihost._parity_worker"]
+STATUS = dict(fail=-1, bad=-4, no_rule=3)
+
+
+# ---------------------------------------------------------------------------
+# shard_math: the one extracted implementation
+# ---------------------------------------------------------------------------
+
+def _route_loop_reference(rowg, acq, prio, S, L):
+    """Straight-line per-request reference for route_requests."""
+    per_shard = [[] for _ in range(S)]
+    status0 = []
+    for i, (r, a) in enumerate(zip(rowg, acq)):
+        if a <= 0:
+            status0.append(STATUS["bad"])
+        elif r < 0:
+            status0.append(STATUS["no_rule"])
+        else:
+            status0.append(STATUS["fail"])
+            per_shard[r // L].append(i)
+    return per_shard, status0
+
+
+def test_route_requests_matches_loop_reference():
+    rng = np.random.RandomState(7)
+    S, L = 8, 16
+    rowg = rng.randint(-1, S * L, size=200)
+    acq = rng.randint(-1, 5, size=200)
+    prio = rng.rand(200) < 0.5
+    lanes, plan = shard_math.route_requests(
+        rowg, acq, prio, S, L, **{"status_" + k: v
+                                  for k, v in STATUS.items()})
+    per_shard, status0 = _route_loop_reference(rowg, acq, prio, S, L)
+    assert plan.status0.tolist() == status0
+    # every routed request sits in its owner shard's lane block with its
+    # own payload, exactly once
+    seen = set()
+    for src, sh, lane in zip(plan.src, plan.shard, plan.lane):
+        assert rowg[src] // L == sh
+        assert lanes.valid[sh, lane]
+        assert lanes.rows[sh, lane] == rowg[src] % L
+        assert lanes.acquire[sh, lane] == acq[src]
+        assert lanes.prioritized[sh, lane] == prio[src]
+        assert src not in seen
+        seen.add(src)
+    assert sorted(seen) == sorted(i for p in per_shard for i in p)
+    # non-valid lanes are zeroed padding
+    assert int(lanes.valid.sum()) == len(seen)
+    assert lanes.lanes >= max(len(p) for p in per_shard)
+
+
+def test_route_requests_all_unroutable():
+    lanes, plan = shard_math.route_requests(
+        np.array([-1, -1]), np.array([1, 0]), None, 4, 8,
+        **{"status_" + k: v for k, v in STATUS.items()})
+    assert lanes is None
+    assert plan.status0.tolist() == [STATUS["no_rule"], STATUS["bad"]]
+
+
+def test_scatter_verdicts_roundtrip():
+    rng = np.random.RandomState(11)
+    S, L = 4, 8
+    rowg = rng.randint(-1, S * L, size=64)
+    acq = rng.randint(0, 3, size=64)
+    lanes, plan = shard_math.route_requests(
+        rowg, acq, None, S, L, **{"status_" + k: v
+                                  for k, v in STATUS.items()})
+    # fabricate device verdicts encoding each lane's identity
+    st = np.arange(S * lanes.lanes).reshape(S, lanes.lanes)
+    out = shard_math.scatter_verdicts(
+        plan, lanes.lanes, st, st * 10, st * 100, S)
+    assert len(out) == 64
+    for src, sh, lane in zip(plan.src, plan.shard, plan.lane):
+        code = sh * lanes.lanes + lane
+        assert out[src] == (code, code * 10, code * 100)
+    routed = set(plan.src.tolist())
+    for i, (s, w, r) in enumerate(out):
+        if i not in routed:
+            assert (s, w, r) == (plan.status0[i], 0, 0)
+
+
+def test_mask_to_local_lanes_zeroes_only_remote():
+    rng = np.random.RandomState(3)
+    S, L = 8, 4
+    rowg = rng.randint(0, S * L, size=40)
+    lanes, plan = shard_math.route_requests(
+        rowg, np.ones(40, np.int64), None, S, L,
+        **{"status_" + k: v for k, v in STATUS.items()})
+    local = shard_math.mask_to_local_lanes(lanes, plan, [2, 3])
+    for s in range(S):
+        if s in (2, 3):
+            assert (local.rows[s] == lanes.rows[s]).all()
+            assert (local.valid[s] == lanes.valid[s]).all()
+        else:
+            assert not local.valid[s].any()
+            assert not local.acquire[s].any()
+
+
+def test_validate_divisible():
+    shard_math.validate_divisible("rows", 64, 8)
+    with pytest.raises(ValueError, match="rows=65 does not divide over 8"):
+        shard_math.validate_divisible("rows", 65, 8)
+    with pytest.raises(ValueError, match="use a multiple"):
+        shard_math.validate_divisible("rows", 65, 8, "use a multiple")
+
+
+def test_owner_and_local_row():
+    rows = np.array([0, 15, 16, 127])
+    assert shard_math.owner_shard(rows, 16).tolist() == [0, 0, 1, 7]
+    assert shard_math.local_row(rows, 16).tolist() == [0, 15, 0, 15]
+
+
+# ---------------------------------------------------------------------------
+# bootstrap config
+# ---------------------------------------------------------------------------
+
+def test_config_from_env_roundtrip():
+    cfg = MultihostConfig.from_env({
+        "SENTINEL_COORDINATOR": "10.0.0.1:1234",
+        "SENTINEL_NUM_PROCESSES": "4",
+        "SENTINEL_PROCESS_ID": "2",
+        "SENTINEL_LOCAL_DEVICES": "8",
+    })
+    assert cfg.coordinator == "10.0.0.1:1234"
+    assert cfg.num_processes == 4 and cfg.process_id == 2
+    assert cfg.local_devices == 8 and cfg.platform == "cpu"
+    assert not cfg.is_coordinator
+    assert MultihostConfig.from_env({
+        "SENTINEL_COORDINATOR": "h:1", "SENTINEL_NUM_PROCESSES": "1",
+        "SENTINEL_PROCESS_ID": "0"}).is_coordinator
+
+
+def test_config_from_env_missing_vars():
+    with pytest.raises(KeyError, match="SENTINEL_NUM_PROCESSES"):
+        MultihostConfig.from_env({"SENTINEL_COORDINATOR": "h:1",
+                                  "SENTINEL_PROCESS_ID": "0"})
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="process_id"):
+        MultihostConfig("h:1", num_processes=2, process_id=2)
+    with pytest.raises(ValueError, match="host:port"):
+        MultihostConfig("nohostport", num_processes=1, process_id=0)
+    with pytest.raises(ValueError, match="num_processes"):
+        MultihostConfig("h:1", num_processes=0, process_id=0)
+
+
+def test_free_port_is_bindable():
+    import socket
+    p = free_port()
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", p))
+
+
+# ---------------------------------------------------------------------------
+# launch + the 2-process parity gate
+# ---------------------------------------------------------------------------
+
+def test_launch_surfaces_worker_failure():
+    with pytest.raises(LaunchError) as ei:
+        launch(["-c", "import sys; print('boom'); sys.exit(3)"], 1,
+               timeout_s=60)
+    assert "boom" in str(ei.value)
+    assert ei.value.procs[0].returncode == 3
+
+
+def _parity_payload(num_processes: int, devices_per_process: int) -> dict:
+    results = launch(PARITY_ARGV, num_processes,
+                     devices_per_process=devices_per_process, timeout_s=240)
+    for r in results:
+        for line in r.stdout.splitlines():
+            if line.startswith("PARITY_JSON:"):
+                return json.loads(line.split(":", 1)[1])
+    raise AssertionError(
+        "no PARITY_JSON payload in worker output:\n"
+        + "\n".join(r.stdout + r.stderr for r in results))
+
+
+def test_two_process_parity_with_single_process_8dev():
+    """THE acceptance gate: 2 processes × 4 devices decide a shared
+    deterministic stream identically to 1 process × 8 devices — token
+    grants, waits, and remaining counts, element for element."""
+    one = _parity_payload(1, 8)
+    two = _parity_payload(2, 4)
+    assert one["n_devices"] == two["n_devices"] == 8
+    assert two["process_count"] == 2
+    assert two["local_shards"] == [0, 1, 2, 3]  # coordinator owns 0-3
+    assert one["decisions"] == two["decisions"]
+    # the stream exercises real admission: grants, blocks, and host-side
+    # statuses must all be present or the parity proves nothing
+    statuses = {d[0] for d in one["decisions"]}
+    assert {0, 1, STATUS["bad"], STATUS["no_rule"]} <= statuses

@@ -9,6 +9,9 @@ import pytest
 import sentinel_tpu as stpu
 from sentinel_tpu.core.clock import ManualClock
 
+# core-path subset: the CI quick tier (PRs) runs only these files
+pytestmark = pytest.mark.quick
+
 
 def make_sentinel(clock, **cfg_over):
     cfg = stpu.load_config(max_resources=64, max_origins=32, max_flow_rules=16,

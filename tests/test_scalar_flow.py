@@ -25,6 +25,9 @@ from sentinel_tpu.engine.pipeline import (
 from sentinel_tpu.rules import degrade as deg_mod
 from sentinel_tpu.rules import flow as flow_mod
 
+# core-path subset: the CI quick tier (PRs) runs only these files
+pytestmark = pytest.mark.quick
+
 
 def make_sentinel(clock, **cfg_over):
     cfg = stpu.load_config(max_resources=64, max_origins=32,

@@ -10,6 +10,9 @@ import pytest
 import sentinel_tpu as stpu
 from sentinel_tpu.core.clock import ManualClock
 
+# core-path subset: the CI quick tier (PRs) runs only these files
+pytestmark = pytest.mark.quick
+
 T0 = 1_785_000_000_000
 
 

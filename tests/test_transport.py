@@ -31,6 +31,9 @@ from sentinel_tpu.transport import (
     HeartbeatSender, register_default_handlers,
 )
 
+# core-path subset: the CI quick tier (PRs) runs only these files
+pytestmark = pytest.mark.quick
+
 T0 = 1_785_000_000_000
 
 
@@ -471,3 +474,68 @@ def test_mounted_asgi_non_http_scopes_handled_gracefully():
         return sent
     sent = asyncio.run(drive_ws())
     assert sent == [{"type": "websocket.close", "code": 1000}]
+
+
+# ------------------------------------------------- thread-gauge elision
+
+
+def test_threads_elided_flag_flips_with_thread_rule_loads(center, sentinel):
+    """Observability surfaces must say when a 0 thread gauge is ELISION
+    (maintenance compiled away — docs/OPERATIONS.md) vs true idleness: the
+    threadsElided field rides basicInfo / clusterNode / cnode and flips
+    live with THREAD-grade rule loads."""
+    # QPS-only deployment: nothing loaded reads live concurrency
+    info = json.loads(_ok(center.handle("basicInfo", CommandRequest())))
+    assert info["threadsElided"] is True
+    with sentinel.entry("el-api"):
+        pass
+    nodes = json.loads(_ok(center.handle("clusterNode", CommandRequest())))
+    assert nodes and all(n["threadsElided"] is True for n in nodes)
+    one = json.loads(_ok(center.handle(
+        "cnode", CommandRequest(parameters={"id": "el-api"}))))
+    assert one and one[0]["threadsElided"] is True
+    assert one[0]["threadNum"] == 0          # the elided 0 being flagged
+
+    # a THREAD-grade flow rule reads the gauge → maintenance on, flag off
+    sentinel.load_flow_rules([FlowRule(resource="el-api", count=100,
+                                       grade=stpu.GRADE_THREAD)])
+    info = json.loads(_ok(center.handle("basicInfo", CommandRequest())))
+    assert info["threadsElided"] is False
+    with sentinel.entry("el-api"):
+        one = json.loads(_ok(center.handle(
+            "cnode", CommandRequest(parameters={"id": "el-api"}))))
+        assert one and one[0]["threadsElided"] is False
+        assert one[0]["threadNum"] == 1      # gauge maintained for real
+
+    # unloading the reader restores elision
+    sentinel.load_flow_rules([])
+    info = json.loads(_ok(center.handle("basicInfo", CommandRequest())))
+    assert info["threadsElided"] is True
+
+
+def test_metric_command_carries_elision_marker(sentinel):
+    """While elided, the metric body is prefixed with a marker line that
+    is NOT a thin metric line — elision-aware readers see it, the
+    dashboard parser (which skips unparseable lines) is unaffected."""
+    from sentinel_tpu.metrics.node import MetricNode
+
+    class StubSearcher:
+        def find(self, begin, end, identity=None, max_lines=0):
+            return [MetricNode(timestamp=T0, resource="svc", pass_qps=3)]
+
+    c = CommandCenter()
+    register_default_handlers(c, sentinel, metric_searcher=StubSearcher())
+    req = CommandRequest(parameters={"startTime": "0"})
+    assert sentinel.threads_elided
+    body = _ok(c.handle("metric", req))
+    marker, *lines = body.splitlines()
+    assert marker == "# threadsElided=true"
+    assert [MetricNode.from_thin_string(l).resource for l in lines] == ["svc"]
+    with pytest.raises((ValueError, IndexError)):
+        MetricNode.from_thin_string(marker)   # what keeps clients safe
+
+    # maintenance on → plain reference-format body, no marker
+    sentinel.load_flow_rules([FlowRule(resource="svc", count=100,
+                                       grade=stpu.GRADE_THREAD)])
+    body = _ok(c.handle("metric", req))
+    assert not body.startswith("#")

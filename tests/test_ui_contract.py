@@ -123,3 +123,53 @@ def test_editor_fields_survive_codec_roundtrip(rtype):
     dropped = [f for f in fields if f not in canonical]
     assert not dropped, (
         f"{rtype}: editor fields silently dropped by the codec: {dropped}")
+
+
+def test_dashboard_metric_parser_skips_elision_marker(monkeypatch):
+    """The agent prepends `# threadsElided=true` to metric bodies while
+    thread gauges are compiled away (transport/handlers.py). The
+    dashboard's thin-line parser must treat it as noise, not data — the
+    SPA charts only real MetricNode lines."""
+    from sentinel_tpu.dashboard.client import SentinelApiClient
+    from sentinel_tpu.metrics.node import MetricNode
+
+    node = MetricNode(timestamp=1_785_000_000_000, resource="svc",
+                      pass_qps=7, block_qps=2)
+    body = "# threadsElided=true\n" + node.to_thin_string() + "\n"
+    cli = SentinelApiClient()
+    monkeypatch.setattr(cli, "_get", lambda *a, **k: body)
+    parsed = cli.fetch_metrics("127.0.0.1", 8719, 0, 10)
+    assert [(n.resource, n.pass_qps, n.block_qps) for n in parsed] == \
+        [("svc", 7, 2)]
+
+
+def test_spa_receives_threads_elided_through_machine_resource():
+    """/resource/machineResource.json passes agent node dicts through
+    verbatim, so the threadsElided field the agent stamps on each node
+    (transport cnode/clusterNode) reaches the SPA unmodified — pinned so
+    a dashboard-side reshape can't silently drop it."""
+    import sentinel_tpu as stpu
+    from sentinel_tpu.core.clock import ManualClock
+    from sentinel_tpu.transport import (
+        CommandCenter, CommandRequest, register_default_handlers,
+    )
+
+    cfg = stpu.load_config(max_resources=64, max_flow_rules=16,
+                           max_degrade_rules=16, max_authority_rules=16)
+    sph = stpu.Sentinel(config=cfg,
+                        clock=ManualClock(start_ms=1_785_000_000_000))
+    center = CommandCenter()
+    register_default_handlers(center, sph)
+    with sph.entry("ui-api"):
+        pass
+    resp = center.handle("clusterNode", CommandRequest())
+    assert resp.success
+    nodes = json.loads(resp.result)
+    assert nodes and all(n["threadsElided"] is True for n in nodes)
+
+    # the THREAD-rule load flips the field the SPA sees
+    sph.load_flow_rules([stpu.FlowRule(resource="ui-api", count=100,
+                                       grade=stpu.GRADE_THREAD)])
+    resp = center.handle("clusterNode", CommandRequest())
+    nodes = json.loads(resp.result)
+    assert nodes and all(n["threadsElided"] is False for n in nodes)

@@ -3,6 +3,7 @@ ArrayMetricTest semantics (reference sentinel-core test tier 1)."""
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from sentinel_tpu.stats import events as ev
 from sentinel_tpu.stats.window import (
@@ -10,6 +11,9 @@ from sentinel_tpu.stats.window import (
     min_rt_rows, refresh_rows, rolling_totals, rt_totals, valid_mask,
     window_sum_all, window_sum_rows,
 )
+
+# core-path subset: the CI quick tier (PRs) runs only these files
+pytestmark = pytest.mark.quick
 
 
 def _add(spec, st, row, event, n, now_ms, rt=None):
@@ -282,3 +286,38 @@ def test_add_rows_hist_matches_scatter_bitwise():
                           jnp.full(m, 2, jnp.int32), idx)
     assert np.array_equal(np.asarray(got.counters),
                           np.asarray(want.counters))
+
+
+def test_hist_add_fits_accounts_for_chunk_padding():
+    """Regression for the fast-flow dispatch guard (engine/pipeline.py):
+    add_rows_hist pads the batch to a full chunk with drop-class rows, so
+    a caller gating on raw ``n < 2**24`` can still trip the f32-exactness
+    assert. hist_add_fits is the shared predicate that budgets for the
+    padding — pin both sides of its boundary against the real kernel."""
+    import jax
+
+    from sentinel_tpu.stats.window import add_rows_hist, hist_add_fits
+
+    CH = 1 << 15
+    LIM = 1 << 24
+    assert hist_add_fits(LIM - CH)          # largest admissible n
+    assert not hist_add_fits(LIM - CH + 1)  # padding would reach 2**24
+    # the engine guard passes 2*B (pass+block lanes concatenated): a
+    # 2**23-row batch is exactly the first size the guard must refuse
+    assert not hist_add_fits(2 * (1 << 23))
+    assert hist_add_fits(2 * (1 << 23) - CH)
+
+    spec = SECOND_SPEC
+    st = init_window(spec, rows=4)
+
+    def trace(n):
+        # eval_shape: the assert fires at trace time, nothing allocates
+        jax.eval_shape(
+            lambda r, e: add_rows_hist(spec, st, r, e, jnp.int32(1),
+                                       jnp.int32(0)),
+            jax.ShapeDtypeStruct((n,), jnp.int32),
+            jax.ShapeDtypeStruct((n,), jnp.int32))
+
+    trace(LIM - CH)                          # boundary size traces clean
+    with pytest.raises(AssertionError, match="hist_add_fits"):
+        trace(LIM - CH + 1)                  # raw-n guards admit this one
