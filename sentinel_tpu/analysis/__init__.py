@@ -1,0 +1,40 @@
+"""graftlint — self-hosted AST static analysis for SPMD, trace, and
+concurrency safety.
+
+The bug classes PR 1/2 fixed by hand, caught by tooling instead of
+reviewers (see ``docs/LINT.md`` for the catalog and rationale):
+
+* **SPMD001** — collectives reachable under process-divergent branches
+  (multihost deadlock).
+* **DEV001** — import-time device access (backend init before
+  ``jax.distributed.initialize``; the PR 1 ``stats/window.py`` class).
+* **TRACE001** — host syncs inside jit/shard_map-traced functions.
+* **ASYNC001** — blocking calls in coroutines; thread locks held across
+  ``await``.
+* **LOCK001** — module-level mutable state mutated from both async and
+  threaded contexts without a lock.
+
+Usage::
+
+    python -m sentinel_tpu.analysis sentinel_tpu/
+
+Programmatic::
+
+    from sentinel_tpu.analysis import analyze_paths, ALL_RULES
+    findings = analyze_paths(["sentinel_tpu/"], ALL_RULES)
+
+This package is intentionally dependency-free (stdlib ``ast`` only): it
+parses source, it never imports the modules it analyzes, and no JAX
+backend is touched beyond what ``import sentinel_tpu`` itself does.
+"""
+
+from sentinel_tpu.analysis.core import (      # noqa: F401
+    Finding, ModuleContext, Rule, analyze_paths, analyze_source,
+    iter_python_files, parse_suppressions,
+)
+from sentinel_tpu.analysis.rules import ALL_RULES, RULES_BY_ID  # noqa: F401
+
+__all__ = [
+    "Finding", "ModuleContext", "Rule", "analyze_paths", "analyze_source",
+    "iter_python_files", "parse_suppressions", "ALL_RULES", "RULES_BY_ID",
+]

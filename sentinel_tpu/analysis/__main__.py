@@ -1,0 +1,84 @@
+"""graftlint CLI.
+
+``python -m sentinel_tpu.analysis sentinel_tpu/`` — exit 0 iff zero
+unsuppressed findings (the CI gate). See ``docs/LINT.md``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from sentinel_tpu.analysis import core, reporting
+from sentinel_tpu.analysis.rules import ALL_RULES, RULES_BY_ID
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m sentinel_tpu.analysis",
+        description="graftlint: AST static analysis for SPMD, trace, and "
+                    "concurrency safety")
+    p.add_argument("paths", nargs="*",
+                   help="files or directories to analyze")
+    p.add_argument("--select", metavar="IDS",
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--ignore", metavar="IDS",
+                   help="comma-separated rule ids to skip")
+    p.add_argument("--format", choices=("human", "json"), default="human")
+    p.add_argument("--json-out", metavar="FILE",
+                   help="also write the JSON report to FILE")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="print suppressed findings too (human format)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            print("%s  %s\n    %s" % (r.id, r.name, r.rationale))
+        return 0
+
+    rules = list(ALL_RULES)
+    for flag, keep in (("select", True), ("ignore", False)):
+        raw = getattr(args, flag)
+        if not raw:
+            continue
+        ids = {s.strip() for s in raw.split(",") if s.strip()}
+        unknown = ids - set(RULES_BY_ID)
+        if unknown:
+            print("unknown rule id(s): %s" % ", ".join(sorted(unknown)),
+                  file=sys.stderr)
+            return 2
+        rules = [r for r in rules if (r.id in ids) == keep]
+
+    if not args.paths:
+        print("error: no paths given (try: python -m sentinel_tpu.analysis "
+              "sentinel_tpu/)", file=sys.stderr)
+        return 2
+
+    files = list(core.iter_python_files(args.paths))
+    if not files:
+        print("error: no Python files under %s" % ", ".join(args.paths),
+              file=sys.stderr)
+        return 2
+    findings = core.analyze_paths(args.paths, rules)
+
+    if args.json_out:
+        with open(args.json_out, "w", encoding="utf-8") as fh:
+            fh.write(reporting.render_json(findings, len(files)) + "\n")
+    if args.format == "json":
+        print(reporting.render_json(findings, len(files)))
+    else:
+        reporting.render_human(findings, sys.stdout,
+                               show_suppressed=args.show_suppressed)
+    active, _ = reporting.split_findings(findings)
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

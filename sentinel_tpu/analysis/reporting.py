@@ -1,0 +1,44 @@
+"""graftlint reporters: human-readable text and machine JSON."""
+
+from __future__ import annotations
+
+import json
+from typing import List, Sequence, TextIO
+
+from sentinel_tpu.analysis.core import Finding
+
+
+def split_findings(findings: Sequence[Finding]):
+    active = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+    return active, suppressed
+
+
+def render_human(findings: Sequence[Finding], stream: TextIO,
+                 show_suppressed: bool = False) -> None:
+    active, suppressed = split_findings(findings)
+    for f in active:
+        stream.write(f.format() + "\n")
+    if show_suppressed:
+        for f in suppressed:
+            stream.write(f.format() + "\n")
+    by_rule = {}
+    for f in active:
+        by_rule[f.rule_id] = by_rule.get(f.rule_id, 0) + 1
+    summary = ", ".join("%s=%d" % kv for kv in sorted(by_rule.items()))
+    stream.write(
+        "graftlint: %d finding(s)%s, %d suppressed\n"
+        % (len(active), " (%s)" % summary if summary else "",
+           len(suppressed)))
+
+
+def render_json(findings: Sequence[Finding], files_scanned: int) -> str:
+    active, suppressed = split_findings(findings)
+    return json.dumps({
+        "tool": "graftlint",
+        "version": 1,
+        "files_scanned": files_scanned,
+        "unsuppressed_count": len(active),
+        "suppressed_count": len(suppressed),
+        "findings": [f.to_dict() for f in findings],
+    }, indent=2, sort_keys=False)

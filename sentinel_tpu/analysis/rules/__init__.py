@@ -1,0 +1,26 @@
+"""graftlint rule registry.
+
+Order here is presentation order in ``--list-rules``; rule ids are
+stable API (suppression comments reference them).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from sentinel_tpu.analysis.core import Rule
+from sentinel_tpu.analysis.rules.spmd import SpmdRule
+from sentinel_tpu.analysis.rules.device import DeviceImportRule
+from sentinel_tpu.analysis.rules.trace import TraceHygieneRule
+from sentinel_tpu.analysis.rules.async_block import AsyncBlockingRule
+from sentinel_tpu.analysis.rules.locks import SharedStateRule
+
+ALL_RULES: List[Rule] = [
+    SpmdRule(),
+    DeviceImportRule(),
+    TraceHygieneRule(),
+    AsyncBlockingRule(),
+    SharedStateRule(),
+]
+
+RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in ALL_RULES}

@@ -1,0 +1,76 @@
+"""DEV001 — import-time device access.
+
+The PR 1 regression class: ``stats/window.py`` once held a module-scope
+``jnp.int32(...)`` constant. Materializing any device value at import
+initializes the JAX backend — and backend initialization MUST NOT happen
+before ``jax.distributed.initialize`` (multihost/bootstrap.py), which a
+mere ``import sentinel_tpu.stats.window`` would otherwise race. The fix
+pattern is a ``np.int32``/plain-Python constant at module scope and
+device placement at first use.
+
+Import-time contexts scanned: module body, class bodies, function
+decorators, and function default arguments. ``jax.jit``/``jax.vmap`` at
+module scope are fine (tracing is lazy); ``jnp.iinfo``/``jnp.finfo`` and
+dtype *references* are metadata and fine. Every other ``jax.numpy.*``
+call — and the explicit backend probes below — flags.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from sentinel_tpu.analysis.core import Finding, ModuleContext, Rule
+from sentinel_tpu.analysis.rules import _shared
+
+#: jax.numpy entry points that only inspect dtypes/metadata (no backend).
+SAFE_JNP = frozenset({
+    "iinfo", "finfo", "dtype", "result_type", "promote_types",
+    "issubdtype", "shape", "ndim", "size",
+})
+
+#: Explicit backend-initializing / device-touching calls.
+BACKEND_EXACT = frozenset({
+    "jax.devices", "jax.local_devices", "jax.device_count",
+    "jax.local_device_count", "jax.device_put", "jax.device_get",
+    "jax.process_index", "jax.process_count", "jax.default_backend",
+    "jax.make_mesh", "jax.live_arrays", "jax.block_until_ready",
+})
+
+BACKEND_PREFIXES = (
+    "jax.random.",                 # PRNGKey materializes a device array
+    "jax.experimental.multihost_utils.",
+)
+
+
+class DeviceImportRule(Rule):
+    id = "DEV001"
+    name = "import-time-device-access"
+    rationale = (
+        "a device value materialized at import initializes the JAX "
+        "backend before jax.distributed.initialize can run, breaking "
+        "every multi-process entry point that imports the module")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in _shared.iter_import_time_nodes(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.call_name(node)
+            if name is None:
+                continue
+            if name.startswith("jax.numpy."):
+                tail = name.split(".", 2)[2]
+                if tail.split(".")[0] in SAFE_JNP:
+                    continue
+                yield self.finding(
+                    ctx, node,
+                    "module-scope '%s' materializes a device constant at "
+                    "import (initializes the backend before "
+                    "jax.distributed.initialize; keep host constants in "
+                    "numpy and device_put at first use)" % name)
+            elif name in BACKEND_EXACT or name.startswith(BACKEND_PREFIXES):
+                yield self.finding(
+                    ctx, node,
+                    "'%s' at import time touches the device backend; "
+                    "defer it into a function that runs after "
+                    "multihost bootstrap" % name)

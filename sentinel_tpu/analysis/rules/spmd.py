@@ -1,0 +1,148 @@
+"""SPMD001 — collectives reachable under process-divergent branches.
+
+The multihost deadlock class PR 2 guarded against by hand
+(``cluster/server.py`` refuses multi-process engines): a collective —
+``psum``, ``process_allgather``, ``shard_map``-launched computation,
+``jax.distributed.*`` — is a *rendezvous*: every process in the mesh must
+execute it, in the same order, or the mesh hangs. Any collective that is
+only reachable when a branch on ``process_index()`` / coordinator-ness /
+environment variables goes one way is therefore a deadlock wired in and
+waiting for traffic.
+
+Two shapes are detected:
+
+1. **Lexical**: a collective call inside the body (or else-branch) of an
+   ``if``/``while``/ternary/short-circuit whose test is process-divergent.
+2. **Guard-return**: a process-divergent ``if`` whose body leaves the
+   function (``return``/``raise``/``continue``/``break``) followed — later
+   in the same suite — by a collective call. Only the surviving processes
+   reach the rendezvous.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from sentinel_tpu.analysis.core import Finding, ModuleContext, Rule
+from sentinel_tpu.analysis.rules import _shared
+
+#: Fully-qualified collective entry points (exact names).
+COLLECTIVE_EXACT = frozenset({
+    "jax.lax.psum", "jax.lax.pmean", "jax.lax.pmax", "jax.lax.pmin",
+    "jax.lax.psum_scatter", "jax.lax.all_gather", "jax.lax.all_to_all",
+    "jax.lax.ppermute", "jax.lax.pshuffle",
+    "jax.experimental.shard_map.shard_map", "jax.shard_map",
+})
+
+#: Any call under these prefixes is a cross-process rendezvous.
+COLLECTIVE_PREFIXES = (
+    "jax.experimental.multihost_utils.",
+    "jax.distributed.",
+)
+
+#: Process-divergent signals inside a branch test.
+_DIVERGENT_SUFFIXES = (".process_index", ".is_coordinator")
+_DIVERGENT_EXACT = frozenset({
+    "jax.process_index", "process_index", "is_coordinator",
+    "socket.gethostname", "platform.node", "os.getpid",
+})
+_DIVERGENT_PREFIXES = ("os.environ", "os.getenv")
+
+
+class SpmdRule(Rule):
+    id = "SPMD001"
+    name = "collective-under-divergent-branch"
+    rationale = (
+        "collectives are rendezvous points: every process must execute "
+        "them in lockstep, so one reachable only under a per-process "
+        "branch deadlocks the mesh")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        yield from self._lexical(ctx)
+        yield from self._guard_return(ctx)
+
+    # ------------------------------------------------------------------
+    def _lexical(self, ctx: ModuleContext) -> Iterator[Finding]:
+        seen = set()
+        for node in ast.walk(ctx.tree):
+            branches: List[ast.AST] = []
+            if isinstance(node, (ast.If, ast.While)):
+                if _divergent(node.test, ctx):
+                    branches = list(node.body) + list(getattr(node, "orelse", []))
+            elif isinstance(node, ast.IfExp):
+                if _divergent(node.test, ctx):
+                    branches = [node.body, node.orelse]
+            elif isinstance(node, ast.BoolOp):
+                if any(_divergent(v, ctx) for v in node.values[:-1]):
+                    branches = list(node.values[1:])
+            for b in branches:
+                for call in ast.walk(b):
+                    if isinstance(call, ast.Call) and id(call) not in seen:
+                        name = ctx.call_name(call)
+                        if _collective(name):
+                            seen.add(id(call))
+                            yield self.finding(
+                                ctx, call,
+                                "collective '%s' reachable only under a "
+                                "process-divergent branch (test involves "
+                                "process_index/coordinator/env); every "
+                                "process must reach this rendezvous or the "
+                                "mesh deadlocks" % name)
+
+    def _guard_return(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for fn in _shared.iter_functions(ctx.tree):
+            yield from self._scan_suite(ctx, fn.body, gated=False)
+
+    def _scan_suite(self, ctx: ModuleContext, stmts, gated: bool
+                    ) -> Iterator[Finding]:
+        for stmt in stmts:
+            if gated:
+                for call in ast.walk(stmt):
+                    if isinstance(call, ast.Call):
+                        name = ctx.call_name(call)
+                        if _collective(name):
+                            yield self.finding(
+                                ctx, call,
+                                "collective '%s' follows a process-"
+                                "divergent early exit above it: processes "
+                                "that took the exit never reach this "
+                                "rendezvous and the rest hang" % name)
+            if (isinstance(stmt, ast.If) and _divergent(stmt.test, ctx)
+                    and _shared.terminates_block(stmt.body)
+                    and not stmt.orelse):
+                gated = True
+                continue
+            # recurse into nested suites with the current gating state
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if sub and not isinstance(stmt, _shared.FUNC_NODES):
+                    # findings inside nested suites of a gated region were
+                    # already reported by the blanket walk above
+                    if not gated:
+                        yield from self._scan_suite(ctx, sub, gated=False)
+
+
+def _collective(name) -> bool:
+    return _shared.name_matches(
+        name, exact=COLLECTIVE_EXACT, prefixes=COLLECTIVE_PREFIXES) or (
+        name is not None and name.split(".")[-1] in (
+            "psum", "pmean", "pmax", "pmin", "process_allgather",
+            "sync_global_devices", "broadcast_one_to_all")
+        and not name.startswith(("self.", "cls.")))
+
+
+def _divergent(test: ast.AST, ctx: ModuleContext) -> bool:
+    for node in ast.walk(test):
+        name = None
+        if isinstance(node, (ast.Attribute, ast.Name)):
+            name = ctx.dotted(node)
+        elif isinstance(node, ast.Call):
+            name = ctx.call_name(node)
+        if name is None:
+            continue
+        if (name in _DIVERGENT_EXACT
+                or name.startswith(_DIVERGENT_PREFIXES)
+                or any(name.endswith(s) for s in _DIVERGENT_SUFFIXES)):
+            return True
+    return False

@@ -1,0 +1,198 @@
+"""TRACE001 — host synchronization inside traced (jit/shard_map) code.
+
+Inside a function that JAX traces, ``.item()`` / ``float()`` / ``bool()``
+on a traced array, ``np.asarray``, and Python ``if`` on an array-valued
+expression either fail at trace time or — worse — silently bake a
+trace-time constant into the compiled program and sync the device
+pipeline. The hot paths (engine step functions) must stay pure.
+
+Traced-function discovery is two-pass:
+
+1. decorator-based — ``@jax.jit``, ``@jit``, ``@partial(jax.jit, ...)``,
+   ``@shard_map(...)`` / ``@partial(shard_map, ...)``;
+2. wrap-site-based — a local ``def f`` whose *name* is later passed to
+   ``jax.jit(f)`` / ``shard_map(f, ...)`` anywhere in the module.
+
+Nested defs inside a traced function are traced too.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Set
+
+from sentinel_tpu.analysis.core import Finding, ModuleContext, Rule
+from sentinel_tpu.analysis.rules import _shared
+
+_TRACER_WRAPPERS = frozenset({
+    "jax.jit", "jit", "jax.pmap",
+    "jax.experimental.shard_map.shard_map", "jax.shard_map", "shard_map",
+    # repo idiom: parallel/cluster.py's version-compat shard_map wrapper
+    "_shard_map",
+})
+
+#: numpy metadata calls that never touch array *values*.
+_SAFE_NP = frozenset({"iinfo", "finfo", "dtype"})
+
+_HOST_SYNC_METHODS = frozenset({"item", "tolist", "block_until_ready"})
+
+
+class TraceHygieneRule(Rule):
+    id = "TRACE001"
+    name = "host-sync-in-traced-code"
+    rationale = (
+        "host syncs inside jit/shard_map either raise TracerError or "
+        "freeze a trace-time value into the compiled program; branches "
+        "on array values must become lax.cond/jnp.where")
+
+    def prepare(self, contexts) -> None:
+        # Cross-module wrap sites: runtime.py does jax.jit(record_exits)
+        # on a function *defined* in stats/pipeline.py — record
+        # (defining module → function name) so the defining module scans
+        # it as traced code.
+        self._cross: dict = {}
+        for ctx in contexts:
+            for target in _wrap_site_targets(ctx):
+                if "." in target:
+                    mod, fn = target.rsplit(".", 1)
+                    self._cross.setdefault(mod, set()).add(fn)
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        wrapped = {t for t in _wrap_site_targets(ctx) if "." not in t}
+        mod_name = ctx.module_name
+        for mod, fns in getattr(self, "_cross", {}).items():
+            if (mod_name == mod or mod_name.endswith("." + mod)
+                    or mod.endswith("." + mod_name)):
+                wrapped |= fns
+        for fn in _traced_functions(ctx, wrapped):
+            yield from self._scan(ctx, fn)
+
+    # ------------------------------------------------------------------
+    def _scan(self, ctx: ModuleContext, fn) -> Iterator[Finding]:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = ctx.call_name(node)
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _HOST_SYNC_METHODS):
+                    yield self.finding(
+                        ctx, node,
+                        "'.%s()' inside traced function '%s' forces a "
+                        "host sync (TracerError under jit)" % (
+                            node.func.attr, fn.name))
+                elif name in ("float", "int", "bool") and node.args and \
+                        not isinstance(node.args[0], ast.Constant) and \
+                        not _static_valued(node.args[0]):
+                    yield self.finding(
+                        ctx, node,
+                        "'%s(...)' on a non-literal inside traced "
+                        "function '%s' concretizes a traced value" % (
+                            name, fn.name))
+                elif (name is not None and name.startswith("numpy.")
+                      and name.split(".")[1] not in _SAFE_NP):
+                    yield self.finding(
+                        ctx, node,
+                        "'%s' inside traced function '%s' pulls the "
+                        "value to host; use jax.numpy" % (name, fn.name))
+            elif isinstance(node, (ast.If, ast.While)) and \
+                    _array_valued(node.test, ctx):
+                yield self.finding(
+                    ctx, node,
+                    "Python branch on an array-valued expression inside "
+                    "traced function '%s'; use lax.cond/lax.select or "
+                    "jnp.where" % fn.name)
+
+
+def _static_valued(arg: ast.AST) -> bool:
+    """``int(x.shape[0])`` / ``float(len(xs))`` concretize *static* trace
+    metadata, which is legal under jit — don't flag those."""
+    for node in ast.walk(arg):
+        if isinstance(node, ast.Attribute) and node.attr in (
+                "shape", "ndim", "size", "dtype"):
+            return True
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and node.func.id == "len":
+            return True
+    return False
+
+
+def _array_valued(test: ast.AST, ctx: ModuleContext) -> bool:
+    """Conservative: the test computes an array (jnp/lax call or
+    .any()/.all()/.item() method) — static config attributes and plain
+    names do NOT flag."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            name = ctx.call_name(node)
+            if name is not None and name.startswith(
+                    ("jax.numpy.", "jax.lax.")):
+                return True
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("any", "all", "item"):
+                return True
+    return False
+
+
+def _wrap_site_targets(ctx: ModuleContext) -> Set[str]:
+    """Dotted names of functions passed to jax.jit(...) / shard_map(...),
+    including through one level of ``functools.partial`` — directly
+    (``jax.jit(partial(f, spec))``) or via an intermediate variable
+    (``body = partial(f, spec); shard_map(body, ...)``). A local ``def``
+    yields its bare name; an imported function yields its fully-qualified
+    dotted path (consumed by the cross-module ``prepare`` pass)."""
+    partial_of: dict = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            inner = _partial_target(node.value, ctx)
+            if inner is not None:
+                partial_of[node.targets[0].id] = inner
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and \
+                ctx.call_name(node) in _TRACER_WRAPPERS:
+            for arg in node.args[:1]:
+                target = None
+                if isinstance(arg, ast.Name) and arg.id in partial_of:
+                    target = partial_of[arg.id]
+                elif isinstance(arg, (ast.Name, ast.Attribute)):
+                    target = ctx.dotted(arg)
+                elif isinstance(arg, ast.Call):
+                    target = _partial_target(arg, ctx)
+                if target is not None:
+                    out.add(target)
+    return out
+
+
+def _partial_target(value: ast.AST, ctx: ModuleContext):
+    """``functools.partial(f, ...)`` → dotted name of ``f``."""
+    if isinstance(value, ast.Call) and \
+            ctx.call_name(value) in ("functools.partial", "partial") and \
+            value.args and isinstance(value.args[0], (ast.Name, ast.Attribute)):
+        return ctx.dotted(value.args[0])
+    return None
+
+
+def _traced_functions(ctx: ModuleContext, wrapped: Set[str]):
+    traced: List[ast.AST] = []
+    for fn in _shared.iter_functions(ctx.tree):
+        if fn.name in wrapped or any(
+                _is_tracer_decorator(d, ctx) for d in fn.decorator_list):
+            traced.append(fn)
+    # nested defs inside a traced function trace with it
+    seen = set(id(f) for f in traced)
+    for fn in list(traced):
+        for sub in ast.walk(fn):
+            if isinstance(sub, _shared.FUNC_NODES) and id(sub) not in seen:
+                seen.add(id(sub))
+                traced.append(sub)
+    return traced
+
+
+def _is_tracer_decorator(dec: ast.AST, ctx: ModuleContext) -> bool:
+    if isinstance(dec, ast.Call):
+        name = ctx.call_name(dec)
+        if name in _TRACER_WRAPPERS:
+            return True
+        if name in ("functools.partial", "partial") and dec.args:
+            return ctx.dotted(dec.args[0]) in _TRACER_WRAPPERS
+        return False
+    return ctx.dotted(dec) in _TRACER_WRAPPERS

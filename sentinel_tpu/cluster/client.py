@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import random
 import socket
 import struct
 import threading
@@ -26,6 +27,11 @@ from sentinel_tpu.cluster import codec
 from sentinel_tpu.parallel.cluster import STATUS_FAIL
 
 RECONNECT_DELAY_S = 2.0     # NettyTransportClient.RECONNECT_DELAY_MS
+# Failed attempts back off exponentially from RECONNECT_DELAY_S up to this
+# cap, with ±25% jitter so a restarted server isn't hit by a synchronized
+# reconnect stampede from every client that dropped at the same instant.
+RECONNECT_MAX_DELAY_S = 30.0
+RECONNECT_JITTER = 0.25
 
 
 @dataclasses.dataclass
@@ -65,6 +71,7 @@ class ClusterTokenClient:
         self._reader: Optional[threading.Thread] = None
         self._reconnector: Optional[threading.Thread] = None
         self._closed = False
+        self._stop = threading.Event()
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -77,6 +84,7 @@ class ClusterTokenClient:
 
     def stop(self) -> None:
         self._closed = True
+        self._stop.set()        # interrupt a reconnect backoff immediately
         self._teardown()
 
     @property
@@ -108,13 +116,26 @@ class ClusterTokenClient:
             self._pending.clear()
 
     def _reconnect_loop(self) -> None:
+        # Interruptible, jittered exponential backoff: a healthy (or
+        # freshly re-established) connection keeps the probe cadence at
+        # the reference's RECONNECT_DELAY_S; consecutive failed attempts
+        # double the delay up to RECONNECT_MAX_DELAY_S. Event.wait (not
+        # time.sleep) so stop() tears the loop down immediately instead
+        # of leaving a sleeping daemon holding the old socket's state.
+        delay = RECONNECT_DELAY_S
         while not self._closed:
-            time.sleep(RECONNECT_DELAY_S)
+            jittered = delay * random.uniform(1 - RECONNECT_JITTER,
+                                              1 + RECONNECT_JITTER)
+            if self._stop.wait(timeout=jittered):
+                break
             if self._sock is None and not self._closed:
                 try:
                     self._connect()
+                    delay = RECONNECT_DELAY_S
                 except OSError:
-                    pass
+                    delay = min(delay * 2, RECONNECT_MAX_DELAY_S)
+            else:
+                delay = RECONNECT_DELAY_S
 
     def _read_loop(self) -> None:
         assembler = codec.FrameAssembler()

@@ -1,0 +1,47 @@
+"""ASYNC001 fixtures: blocking calls in coroutines, a lock held across
+await, suppression, and the to_thread patterns that must stay clean."""
+
+import asyncio
+import socket
+import threading
+import time
+
+_LOCK = threading.Lock()
+
+
+async def tp_sleep():
+    time.sleep(0.5)                           # ASYNC001: stalls the loop
+
+
+async def tp_socket():
+    return socket.create_connection(("localhost", 1))   # ASYNC001
+
+
+async def tp_engine_step(engine, ids):
+    return engine.request_tokens(ids, None, None)       # ASYNC001: device step
+
+
+async def tp_lock_across_await(conn):
+    with _LOCK:                               # ASYNC001: parked holding a thread lock
+        await conn.drain()
+
+
+async def suppressed_sleep():
+    time.sleep(0.001)  # graftlint: disable=ASYNC001 -- fixture: sub-ms calibration sleep, loop idle by contract
+
+
+async def tn_to_thread(engine, ids):
+    # the cluster/server.py batcher pattern: method passed as a value
+    return await asyncio.to_thread(engine.request_tokens, ids, None, None)
+
+
+async def tn_async_primitives():
+    await asyncio.sleep(0.5)
+    async with asyncio.Lock():
+        await asyncio.sleep(0)
+
+
+def tn_sync_fn():
+    time.sleep(0.5)                           # not a coroutine: no finding
+    with _LOCK:
+        pass

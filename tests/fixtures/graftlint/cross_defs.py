@@ -1,0 +1,10 @@
+"""Defining side of the cross-module TRACE001 pair: ``body_fn`` looks
+like a plain function here — the jit wrap lives in cross_jitsite.py."""
+
+
+def body_fn(x):
+    return x.sum().item()                     # TRACE001 via cross-module wrap
+
+
+def never_traced(x):
+    return x.sum().item()                     # no wrap site anywhere: clean

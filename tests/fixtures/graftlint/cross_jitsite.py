@@ -1,0 +1,10 @@
+"""Jitting side of the cross-module TRACE001 pair (the runtime.py
+pattern: ``jax.jit(functools.partial(imported_fn, spec))``)."""
+
+import functools
+
+import jax
+
+from cross_defs import body_fn
+
+stepper = jax.jit(functools.partial(body_fn, 2))

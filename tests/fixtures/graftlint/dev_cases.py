@@ -1,0 +1,27 @@
+"""DEV001 fixtures beyond the window regression: class bodies, default
+args, suppression, and safe module-scope patterns."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEVICES = jax.devices()                       # DEV001: backend probe
+
+
+class Config:
+    scale = jnp.full((4,), 2.0)               # DEV001: class body runs at import
+
+
+def bad_default(x, pad=jnp.zeros(8)):         # DEV001: default evaluates at import
+    return x + pad
+
+
+SUPPRESSED = jnp.ones(3)  # graftlint: disable=DEV001 -- fixture: demonstrates an explicitly accepted device constant
+
+SAFE_HOST = np.int32(-(2 ** 30))              # numpy: no backend
+SAFE_META = jnp.iinfo(jnp.int32).max          # dtype metadata: no backend
+_jitted = jax.jit(bad_default)                # tracing is lazy: no backend
+
+
+def safe_inside():
+    return jnp.arange(16)                     # call time, not import time

@@ -1,0 +1,45 @@
+"""LOCK001 fixtures: module state shared across async + thread contexts."""
+
+import threading
+
+_REGISTRY = {}
+_EVENTS = []
+_SAFE = {}
+_LOCK = threading.Lock()
+
+
+async def tp_async_writer(key, value):
+    _REGISTRY[key] = value                    # LOCK001: async side, no lock
+
+
+def tp_thread_writer(key, value):
+    _REGISTRY.pop(key, None)                  # LOCK001: thread side, no lock
+
+
+async def suppressed_async_append(ev):
+    # graftlint: disable=LOCK001 -- fixture: single-producer list, reader drains under the GIL atomically
+    _EVENTS.append(ev)
+
+
+def thread_append(ev):
+    _EVENTS.append(ev)  # graftlint: disable=LOCK001 -- fixture: see suppressed_async_append
+
+
+async def tn_locked_async(key, value):
+    with _LOCK:
+        _SAFE[key] = value                    # protected on both sides
+
+
+def tn_locked_thread(key, value):
+    with _LOCK:
+        _SAFE.pop(key, None)
+
+
+def tn_reader():
+    return dict(_REGISTRY)                    # reads never flag
+
+
+def tn_local_shadow():
+    _REGISTRY = {}                            # local: not the module global
+    _REGISTRY["x"] = 1
+    return _REGISTRY

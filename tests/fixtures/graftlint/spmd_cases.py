@@ -1,0 +1,47 @@
+"""SPMD001 fixtures: true positives, a suppressed site, true negatives."""
+
+import os
+
+import jax
+from jax import lax
+from jax.experimental import multihost_utils
+
+
+def tp_lexical(x):
+    # collective only executed on the coordinator → deadlock
+    if jax.process_index() == 0:
+        return lax.psum(x, "i")
+    return x
+
+
+def tp_env_branch(x):
+    if os.environ.get("SENTINEL_ROLE") == "primary":
+        multihost_utils.broadcast_one_to_all(x)
+    return x
+
+
+def tp_guard_return(x):
+    if jax.process_index() != 0:
+        return None
+    # only process 0 reaches the rendezvous below
+    return multihost_utils.process_allgather(x)
+
+
+def suppressed_site(x):
+    if jax.process_index() == 0:
+        return lax.pmax(x, "i")  # graftlint: disable=SPMD001 -- fixture: documents the suppression syntax; never executed
+    return x
+
+
+def tn_uniform_branch(x, num_processes):
+    # uniform config value: every process takes the same side
+    if num_processes > 1:
+        return lax.psum(x, "i")
+    return x
+
+
+def tn_collective_outside(x):
+    out = lax.psum(x, "i")
+    if jax.process_index() == 0:
+        print("coordinator log only")          # host-side effect is fine
+    return out

@@ -1,0 +1,43 @@
+"""TRACE001 fixtures: host syncs in traced code, suppression, and the
+static-metadata patterns that must stay clean."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def tp_item(x):
+    return x.sum().item()                     # TRACE001: host sync
+
+
+@functools.partial(jax.jit, static_argnames=("flag",))
+def tp_branch(x, flag):
+    if jnp.any(x > 0):                        # TRACE001: branch on array
+        x = x + 1
+    return x
+
+
+def _wrapped_body(x):
+    return np.asarray(x)                      # TRACE001: via wrap site below
+
+
+step = jax.jit(_wrapped_body)
+
+
+@jax.jit
+def suppressed(x):
+    return float(x[0])  # graftlint: disable=TRACE001 -- fixture: demonstrates accepted concretization in debug-only path
+
+
+@jax.jit
+def tn_static_meta(x):
+    n = int(x.shape[0])                       # static: fine under jit
+    m = float(len(x.shape))                   # static: fine
+    return x * n * m
+
+
+def tn_not_traced(x):
+    return x.sum().item()                     # plain function: no finding

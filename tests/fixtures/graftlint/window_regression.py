@@ -1,0 +1,14 @@
+"""Regression fixture: the PR 1 ``stats/window.py`` import-time bug.
+
+The seed code held the NEVER sentinel as a module-scope ``jnp.int32``
+constant. Materializing it at import initialized the JAX backend, which
+broke ``jax.distributed.initialize`` in every multi-process entry point
+that so much as imported the stats package. DEV001 must flag line 14
+(the fixed form in stats/window.py uses ``np.int32`` and stays clean).
+"""
+
+import jax.numpy as jnp
+
+INT32_MAX = jnp.iinfo(jnp.int32).max          # metadata only: must NOT flag
+
+NEVER = jnp.int32(-(2 ** 30))                 # DEV001: the historical bug

@@ -1,0 +1,270 @@
+"""graftlint (sentinel_tpu.analysis) rule + engine tests.
+
+Fixture files under tests/fixtures/graftlint/ are *parsed, never
+imported* — each rule family gets a true-positive, a suppressed, and a
+true-negative case, plus the PR 1 ``stats/window.py`` import-time
+device-constant regression and the cross-module jit-wrap pair.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import sentinel_tpu
+from sentinel_tpu.analysis import (
+    ALL_RULES, RULES_BY_ID, analyze_paths, analyze_source,
+)
+from sentinel_tpu.analysis import reporting
+from sentinel_tpu.analysis.core import (
+    MALFORMED_SUPPRESSION, UNUSED_SUPPRESSION,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "graftlint")
+PACKAGE_DIR = os.path.dirname(sentinel_tpu.__file__)
+
+pytestmark = pytest.mark.quick
+
+
+def lint_fixture(name, rules=ALL_RULES):
+    return analyze_paths([os.path.join(FIXTURES, name)], rules)
+
+
+def active(findings, rule_id=None):
+    return [f for f in findings
+            if not f.suppressed and (rule_id is None or f.rule_id == rule_id)]
+
+
+def suppressed(findings, rule_id):
+    return [f for f in findings if f.suppressed and f.rule_id == rule_id]
+
+
+def lines_of(findings):
+    return sorted(f.line for f in findings)
+
+
+def source_line(name, lineno):
+    with open(os.path.join(FIXTURES, name)) as fh:
+        return fh.read().splitlines()[lineno - 1]
+
+
+# ----------------------------------------------------------------------
+# The acceptance gate: the real package is clean
+# ----------------------------------------------------------------------
+
+def test_package_is_clean():
+    findings = analyze_paths([PACKAGE_DIR], ALL_RULES)
+    assert active(findings) == [], "\n".join(
+        f.format() for f in active(findings))
+
+
+# ----------------------------------------------------------------------
+# DEV001 — the PR 1 regression class
+# ----------------------------------------------------------------------
+
+def test_dev001_flags_historical_window_bug():
+    findings = lint_fixture("window_regression.py")
+    hits = active(findings, "DEV001")
+    assert len(hits) == 1
+    # the jnp.int32 module constant, not the jnp.iinfo metadata line
+    assert "jnp.int32" in source_line("window_regression.py", hits[0].line)
+    assert "jax.numpy.int32" in hits[0].message
+
+
+def test_dev001_import_time_contexts_and_negatives():
+    findings = lint_fixture("dev_cases.py")
+    hits = active(findings, "DEV001")
+    flagged = {source_line("dev_cases.py", f.line).split("#")[0].strip()
+               for f in hits}
+    assert any("jax.devices()" in s for s in flagged)          # module scope
+    assert any("class" not in s and "jnp.full" in s for s in flagged)
+    assert any("pad=jnp.zeros(8)" in s for s in flagged)       # default arg
+    assert len(hits) == 3
+    assert len(suppressed(findings, "DEV001")) == 1
+    # np.int32 / jnp.iinfo / jax.jit / call-time jnp stay clean
+    for f in hits:
+        line = source_line("dev_cases.py", f.line)
+        assert "SAFE" not in line and "jax.jit" not in line
+
+
+def test_current_stats_window_is_fixed():
+    findings = analyze_paths(
+        [os.path.join(PACKAGE_DIR, "stats", "window.py")], ALL_RULES)
+    assert active(findings, "DEV001") == []
+
+
+# ----------------------------------------------------------------------
+# SPMD001
+# ----------------------------------------------------------------------
+
+def test_spmd001_positive_and_negative():
+    findings = lint_fixture("spmd_cases.py")
+    hits = active(findings, "SPMD001")
+    msgs = [f.message for f in hits]
+    assert len(hits) == 3
+    assert any("jax.lax.psum" in m for m in msgs)              # lexical
+    assert any("broadcast_one_to_all" in m for m in msgs)      # env branch
+    assert any("early exit" in m for m in msgs)                # guard-return
+    assert len(suppressed(findings, "SPMD001")) == 1
+    # uniform-config branch and collective-outside-branch stay clean
+    for f in hits:
+        fn_src = source_line("spmd_cases.py", f.line)
+        assert "tn_" not in fn_src
+
+
+# ----------------------------------------------------------------------
+# TRACE001
+# ----------------------------------------------------------------------
+
+def test_trace001_positive_and_negative():
+    findings = lint_fixture("trace_cases.py")
+    hits = active(findings, "TRACE001")
+    msgs = " | ".join(f.message for f in hits)
+    assert len(hits) == 3
+    assert ".item()" in msgs
+    assert "branch on an array-valued" in msgs
+    assert "numpy.asarray" in msgs                              # via wrap site
+    assert len(suppressed(findings, "TRACE001")) == 1
+    for f in hits:
+        assert "tn_" not in f.message
+
+
+def test_trace001_cross_module_wrap_site():
+    findings = analyze_paths(
+        [os.path.join(FIXTURES, "cross_defs.py"),
+         os.path.join(FIXTURES, "cross_jitsite.py")], ALL_RULES)
+    hits = active(findings, "TRACE001")
+    assert len(hits) == 1
+    assert hits[0].path.endswith("cross_defs.py")
+    assert "body_fn" in hits[0].message
+    # analyzed alone, the defining module has no way to know — and the
+    # never-jitted sibling stays clean either way
+    alone = analyze_paths([os.path.join(FIXTURES, "cross_defs.py")],
+                          ALL_RULES)
+    assert active(alone, "TRACE001") == []
+
+
+# ----------------------------------------------------------------------
+# ASYNC001
+# ----------------------------------------------------------------------
+
+def test_async001_positive_and_negative():
+    findings = lint_fixture("async_cases.py")
+    hits = active(findings, "ASYNC001")
+    msgs = " | ".join(f.message for f in hits)
+    assert len(hits) == 4
+    assert "time.sleep" in msgs
+    assert "socket.create_connection" in msgs
+    assert "request_tokens" in msgs                # device step in coroutine
+    assert "lock held across 'await'" in msgs
+    assert len(suppressed(findings, "ASYNC001")) == 1
+    for f in hits:
+        assert "tn_" not in source_line("async_cases.py", f.line)
+
+
+# ----------------------------------------------------------------------
+# LOCK001
+# ----------------------------------------------------------------------
+
+def test_lock001_positive_and_negative():
+    findings = lint_fixture("lock_cases.py")
+    hits = active(findings, "LOCK001")
+    assert len(hits) == 2                          # both _REGISTRY sites
+    assert all("_REGISTRY" in f.message for f in hits)
+    assert {("async" in f.message.split("also from")[0])
+            for f in hits} == {True, False}        # one per domain
+    assert len(suppressed(findings, "LOCK001")) == 2   # _EVENTS, both forms
+    # locked sites, reads, and local shadows stay clean
+    assert not any("_SAFE" in f.message for f in hits)
+
+
+# ----------------------------------------------------------------------
+# Suppression engine
+# ----------------------------------------------------------------------
+
+def test_suppression_requires_reason():
+    src = "import time\nasync def f():\n" \
+          "    time.sleep(1)  # graftlint: disable=ASYNC001\n"
+    findings = analyze_source("x.py", src, ALL_RULES)
+    ids = [f.rule_id for f in findings if not f.suppressed]
+    assert MALFORMED_SUPPRESSION in ids
+    assert "ASYNC001" in ids                       # not honored without reason
+
+
+def test_suppression_unknown_rule_rejected():
+    src = "import time\nasync def f():\n" \
+          "    time.sleep(1)  # graftlint: disable=NOPE42 -- because\n"
+    findings = analyze_source("x.py", src, ALL_RULES)
+    assert any(f.rule_id == MALFORMED_SUPPRESSION and "NOPE42" in f.message
+               for f in findings)
+
+
+def test_unused_suppression_flagged_for_ratchet():
+    src = "x = 1  # graftlint: disable=DEV001 -- stale reason\n"
+    findings = analyze_source("x.py", src, ALL_RULES)
+    assert [f.rule_id for f in findings] == [UNUSED_SUPPRESSION]
+
+
+def test_string_literals_are_not_suppressions():
+    src = 's = "# graftlint: disable=DEV001 -- inside a string"\n'
+    findings = analyze_source("x.py", src, ALL_RULES)
+    assert findings == []
+
+
+def test_standalone_comment_governs_next_code_line():
+    src = ("import time\n"
+           "async def f():\n"
+           "    # graftlint: disable=ASYNC001 -- startup probe, loop idle\n"
+           "    time.sleep(1)\n"
+           "    time.sleep(2)\n")
+    findings = analyze_source("x.py", src, ALL_RULES)
+    a = [f for f in findings if f.rule_id == "ASYNC001"]
+    assert [f.suppressed for f in sorted(a, key=lambda f: f.line)] == \
+        [True, False]
+
+
+# ----------------------------------------------------------------------
+# Reporters + CLI
+# ----------------------------------------------------------------------
+
+def test_json_report_shape():
+    findings = lint_fixture("window_regression.py")
+    doc = json.loads(reporting.render_json(findings, files_scanned=1))
+    assert doc["tool"] == "graftlint"
+    assert doc["files_scanned"] == 1
+    assert doc["unsuppressed_count"] == 1
+    rec = [r for r in doc["findings"] if r["rule"] == "DEV001"][0]
+    assert rec["path"].endswith("window_regression.py")
+    assert rec["line"] > 0 and not rec["suppressed"]
+
+
+def test_cli_gate_green_on_package():
+    proc = subprocess.run(
+        [sys.executable, "-m", "sentinel_tpu.analysis", PACKAGE_DIR],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout
+
+
+def test_cli_gate_red_on_regression_fixture(tmp_path):
+    report = tmp_path / "report.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "sentinel_tpu.analysis",
+         os.path.join(FIXTURES, "window_regression.py"),
+         "--json-out", str(report)],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 1
+    assert "DEV001" in proc.stdout
+    doc = json.loads(report.read_text())
+    assert doc["unsuppressed_count"] == 1
+
+
+def test_rule_catalog_is_stable():
+    assert set(RULES_BY_ID) == {
+        "SPMD001", "DEV001", "TRACE001", "ASYNC001", "LOCK001"}
+    for rule in ALL_RULES:
+        assert rule.name and rule.rationale
