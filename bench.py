@@ -293,6 +293,12 @@ def main() -> None:
             g_steps = int(os.environ.get("BENCH_GENERAL_STEPS", "20"))
             out["general"] = measure(jax, "fast", R, B, g_steps, NRULES, 3)
             out["mixed"] = measure(jax, "mixed", R, B, g_steps, NRULES, 3)
+            # prioritized-traffic numbers (r6: the 16x priority/occupy
+            # cliff — BENCH artifacts from r06 on must carry them so a
+            # reintroduced whole-batch demotion can never hide)
+            out["prio"] = measure(jax, "prio", R, B, g_steps, NRULES, 3)
+            out["prio_mixed"] = measure(jax, "prio_mixed", R, B, g_steps,
+                                        NRULES, 3)
         except Exception as exc:      # noqa: BLE001 — headline must print
             out["general_error"] = repr(exc)
     print(json.dumps(out))

@@ -26,6 +26,17 @@ the code: re-introducing a per-event Python loop moves the ratio by the
 same factor on a laptop, this VM, or a shared CI runner, and fails the gate
 everywhere. Margin 2.5× over the committed ratio. Run ``--update`` after
 intentional host-prep changes.
+
+Gate (c) — the prio-cliff gate (also portable): before r6, one prioritized
+event demoted a whole batch to the sorted general path (a 16× cliff on the
+TPU headline). Two checks pin it shut: (i) a BANDED ratio of the
+general_bench ``prio_mixed`` metric (the occupy-aware split: scalar bulk +
+fast-occupy prio slice) over the ``general`` metric (the sorted whole-batch
+path a demotion collapses into) — machine speed cancels, and a reintroduced
+demotion drags the ratio to ~1.0; (ii) a binary routing probe through the
+runtime itself: a mixed 1%-prio batch must still take
+``_decide_split_nowait`` (general_bench pre-stages its sub-batches, so only
+this probe sees the runtime's routing decision).
 """
 
 from __future__ import annotations
@@ -154,10 +165,87 @@ def measure_host_prep() -> dict:
             "cluster_prep_s_per_step": min(cluster_times)}
 
 
+# prio_mixed / general throughput band at gate shapes. Honest CPU value is
+# ~1.5 (both prio_mixed dispatches skip alt recording; general pays the
+# composite-key sort + alt scatter). A reintroduced whole-batch demotion
+# makes the prio-mixed workload RUN the general path, so the ratio falls to
+# ~1.0 — well below the low edge. The high edge catches a degenerated
+# denominator (the general measurement itself collapsing) rather than a
+# legitimate speedup: both sides share the same fixture and backend, so a
+# >8x gap means the gate is no longer measuring what it claims.
+PRIO_RATIO_BAND = (1.15, 8.0)
+
+
+def measure_prio_cliff() -> dict:
+    """Kernel-level prio gate: general_bench's ``prio_mixed`` (the exact
+    two-dispatch split shape the runtime issues for a 1%-prioritized batch
+    with live bookings) vs ``general`` (the sorted whole-batch path the
+    pre-r6 demotion forced everything onto), both in-process at small CPU
+    shapes. The RATIO is the gated number — machine speed cancels."""
+    sys.path.insert(0, str(HERE.parent))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from benchmarks import general_bench
+
+    R, B, STEPS, NRULES, REPEATS = 1 << 12, 1 << 12, 8, 128, 3
+    pm = general_bench.measure(jax, "prio_mixed", R, B, STEPS, NRULES,
+                               REPEATS)["value"]
+    gen = general_bench.measure(jax, "general", R, B, STEPS, NRULES,
+                                REPEATS)["value"]
+    return {"prio_mixed_per_sec": pm, "general_per_sec": gen,
+            "prio_vs_general_ratio": pm / gen}
+
+
+def check_prio_split_routing():
+    """Runtime-level prio gate → error string or None. general_bench
+    pre-stages the split's sub-batches, so a demotion reintroduced in
+    ``runtime._decide_split_nowait`` would not move the metric above —
+    this probe feeds a mixed 1%-prio batch through the runtime and
+    asserts the split dispatch actually fires."""
+    import numpy as np
+
+    sys.path.insert(0, str(HERE.parent))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import sentinel_tpu as stpu
+
+    sph = stpu.Sentinel(stpu.load_config(
+        max_resources=64, max_origins=32, max_flow_rules=32,
+        max_degrade_rules=16, max_authority_rules=16,
+        host_fast_path=False))
+    sph.load_flow_rules([
+        stpu.FlowRule(resource="api", count=500.0),
+        stpu.FlowRule(resource="api", count=3.0, limit_app="app-a"),
+    ])
+    oid = sph.origins.pin("app-a")
+    row = sph.resources.get_or_create("api")
+    rng = np.random.default_rng(7)
+    n = 8192                      # scalar side > the 4096 split threshold
+    pad_a = sph.spec.alt_rows
+    has_o = rng.random(n) < 0.1
+    oids = np.where(has_o, oid, 0).astype(np.int32)
+    orow = np.where(has_o, sph._alt_row(row, 0, int(oid)),
+                    pad_a).astype(np.int32)
+    calls = []
+    orig = sph._decide_split_nowait
+    sph._decide_split_nowait = lambda *a, **k: (calls.append(1),
+                                                orig(*a, **k))[1]
+    sph.decide_raw(np.full(n, row, np.int32), oids, orow,
+                   np.zeros(n, np.int32), np.full(n, pad_a, np.int32),
+                   np.ones(n, np.int32), np.ones(n, bool),
+                   rng.random(n) < 0.01)          # 1% prioritized
+    if not calls:
+        return ("mixed 1%-prio batch did not take the split dispatch — "
+                "whole-batch prioritized demotion is back (pre-r6 cliff)")
+    return None
+
+
 def main() -> int:
     best = max(measure_once() for _ in range(3))
     cal = calibrate()
     prep = measure_host_prep()
+    prio = measure_prio_cliff()
+    routing_err = check_prio_split_routing()
     ratios = {k.replace("_s_per_step", "_ratio"): v / cal
               for k, v in prep.items()}
     if "--update" in sys.argv:
@@ -166,6 +254,9 @@ def main() -> int:
              "measured_at_update": best,
              "machine": fingerprint(),
              "host_prep_ratios": ratios,
+             # informational: the prio band itself is fixed
+             # (PRIO_RATIO_BAND), not re-baselined per machine
+             "prio_cliff": {k: round(v, 4) for k, v in prio.items()},
              "calibration_s": cal}, indent=1))
         print(f"baseline updated: floor={best / 2:.0f} (measured {best:.0f}) "
               f"on {fingerprint()}; host-prep ratios "
@@ -182,9 +273,22 @@ def main() -> int:
         "calibration_s": round(cal, 4),
         "host_prep": {k: round(v, 4) for k, v in prep.items()},
         "host_prep_ratios": {k: round(v, 4) for k, v in ratios.items()},
+        "prio_cliff": {k: round(v, 4) for k, v in prio.items()},
+        "prio_split_routing": "ok" if routing_err is None else "DEMOTED",
     }
     print(json.dumps(out))
     rc = 0
+    lo, hi = PRIO_RATIO_BAND
+    pr = prio["prio_vs_general_ratio"]
+    if not lo <= pr <= hi:
+        print(f"PRIO-CLIFF REGRESSION: prio_mixed/general ratio {pr:.3f} "
+              f"outside band [{lo}, {hi}] — "
+              f"{'the occupy-aware split has collapsed to sorted-general speed (demotion cliff)' if pr < lo else 'the general denominator degenerated; the gate is not measuring what it claims'}",
+              file=sys.stderr)
+        rc = 1
+    if routing_err is not None:
+        print(f"PRIO-ROUTING REGRESSION: {routing_err}", file=sys.stderr)
+        rc = 1
     if best < floor:
         print(f"PERF REGRESSION: {best:.0f} decisions/s < floor {floor:.0f} "
               f"({'>2x below the rate at baseline time' if same_machine else 'below the absolute sanity floor — the fused step has degenerated'})",

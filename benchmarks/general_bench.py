@@ -19,6 +19,13 @@ Modes (env GENERAL_MODE):
   mixed     10% origin-bearing: the per-event split (scalar step on the
             origin-free 90% + fast general step on the rest — the exact
             two-dispatch shape runtime._decide_split_nowait issues)
+  prio      all events PRIORITIZED (origin-free): the occupy-capable fast
+            variant (rules/flow.flow_check_fast_occupy) — what the
+            runtime now selects for whole-prio batches; pre-r6 this
+            demoted to the sorted path (the 16x cliff, BASELINE.md)
+  prio_mixed  1% prioritized, 99% origin-free scalar: the occupy-aware
+            per-event split (occupy-base scalar step on the bulk + fast
+            occupy step on the prioritized slice)
 Knobs: BENCH_RESOURCES, BENCH_BATCH, BENCH_STEPS, BENCH_RULES,
 BENCH_REPEATS, BENCH_PLATFORM.
 
@@ -40,9 +47,11 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
 def build_general_fixture(jax, R: int, B: int, NRULES: int,
-                          origin_share: float = 1.0):
+                          origin_share: float = 1.0,
+                          prio_share: float = 0.0):
     """→ (spec, ruleset, state, batches, t0_ms). origin_share = fraction of
-    events carrying an origin id (1.0 = pure general, 0.1 = mixed)."""
+    events carrying an origin id (1.0 = pure general, 0.1 = mixed);
+    prio_share = fraction of PRIORITIZED events (occupy modes)."""
     import jax.numpy as jnp
 
     from sentinel_tpu.core.registry import (
@@ -141,7 +150,8 @@ def build_general_fixture(jax, R: int, B: int, NRULES: int,
             chain_rows=jnp.full(B, spec.alt_rows, jnp.int32),
             acquire=jnp.ones(B, jnp.int32),
             is_in=jnp.ones(B, jnp.bool_),
-            prioritized=jnp.zeros(B, jnp.bool_),
+            prioritized=jax.device_put(jnp.asarray(
+                rng.random(B) < prio_share)),
             valid=jnp.ones(B, jnp.bool_)))
     return spec, ruleset, state, batches, 1_000_000_000
 
@@ -334,25 +344,31 @@ def measure(jax, mode: str, R: int, B: int, STEPS: int, NRULES: int,
 
     from sentinel_tpu.engine.pipeline import decide_entries
 
-    share = 0.1 if mode == "mixed" else 1.0
+    share = (0.1 if mode == "mixed"
+             else 0.0 if mode in ("prio", "prio_mixed") else 1.0)
+    prio_share = (1.0 if mode == "prio"
+                  else 0.01 if mode == "prio_mixed" else 0.0)
     spec, ruleset, state, batches, t0_ms = build_general_fixture(
-        jax, R, B, NRULES, origin_share=share)
+        jax, R, B, NRULES, origin_share=share, prio_share=prio_share)
 
     if os.environ.get("GENERAL_ABLATE"):
         ablate(jax, spec, ruleset, state, batches, t0_ms,
                int(os.environ.get("PROF_STEPS", "15")), mode=mode)
         return {}
 
-    if mode == "mixed":
+    if mode in ("mixed", "prio_mixed"):
         # pre-stage the split's two sub-batches per batch (the runtime
         # partitions on host; the bench measures the device cost of the
         # resulting two dispatches, matching how the headline bench
-        # pre-stages its single batch)
+        # pre-stages its single batch). For prio_mixed the partition key
+        # is the prioritized flag (runtime routes prio events to the
+        # general side so only that side may commit occupy bookings).
         from sentinel_tpu.engine.pipeline import EntryBatch
         split_batches = []
         for b in batches:
             oid = np.asarray(b.origin_ids)
-            scalar_m = oid == 0
+            scalar_m = ((oid == 0) & ~np.asarray(b.prioritized)
+                        if mode == "prio_mixed" else oid == 0)
             idx_s = np.nonzero(scalar_m)[0]
             idx_g = np.nonzero(~scalar_m)[0]
 
@@ -390,11 +406,19 @@ def measure(jax, mode: str, R: int, B: int, STEPS: int, NRULES: int,
     # closed forms compile away
     flow_kw = ({"fast_flow": True, "scalar_has_rl": False}
                if mode in ("fast",) else {})
-    step = jax.jit(functools.partial(decide_entries, spec,
-                                     enable_occupy=False, record_alt=True,
-                                     skip_auth=True, skip_sys=True,
-                                     skip_threads=True, **flow_kw),
-                   donate_argnums=(1,))
+    if mode == "prio":
+        # whole-batch prioritized: the occupy-capable fast variant, the
+        # exact static combo the runtime dispatches (record_alt=False —
+        # origin-free population takes the *_noalt prio step)
+        step = jax.jit(functools.partial(
+            decide_entries, spec, enable_occupy=True, record_alt=False,
+            skip_auth=True, skip_sys=True, skip_threads=True,
+            fast_flow=True, scalar_has_rl=False), donate_argnums=(1,))
+    else:
+        step = jax.jit(functools.partial(
+            decide_entries, spec, enable_occupy=False, record_alt=True,
+            skip_auth=True, skip_sys=True, skip_threads=True, **flow_kw),
+            donate_argnums=(1,))
     if mode == "mixed":
         step_s = jax.jit(functools.partial(
             decide_entries, spec, enable_occupy=False, record_alt=False,
@@ -402,6 +426,19 @@ def measure(jax, mode: str, R: int, B: int, STEPS: int, NRULES: int,
             scalar_has_rl=False, skip_threads=True), donate_argnums=(1,))
         step_g = jax.jit(functools.partial(
             decide_entries, spec, enable_occupy=False, record_alt=True,
+            skip_auth=True, skip_sys=True, fast_flow=True,
+            scalar_has_rl=False, skip_threads=True), donate_argnums=(1,))
+    elif mode == "prio_mixed":
+        # the occupy-aware split: scalar step with the occupy-base fold
+        # on the 99% bulk + fast occupy step on the prioritized slice —
+        # the exact two-dispatch shape runtime._decide_split_nowait
+        # issues while bookings are live
+        step_s = jax.jit(functools.partial(
+            decide_entries, spec, enable_occupy=True, record_alt=False,
+            skip_auth=True, skip_sys=True, scalar_flow=True,
+            scalar_has_rl=False, skip_threads=True), donate_argnums=(1,))
+        step_g = jax.jit(functools.partial(
+            decide_entries, spec, enable_occupy=True, record_alt=False,
             skip_auth=True, skip_sys=True, fast_flow=True,
             scalar_has_rl=False, skip_threads=True), donate_argnums=(1,))
     sys_scalars = jnp.asarray(np.array([0.5, 0.1], np.float32))
@@ -413,7 +450,7 @@ def measure(jax, mode: str, R: int, B: int, STEPS: int, NRULES: int,
              now % spec.second.win_ms], np.int32))
 
     def run_step(i, state):
-        if mode == "mixed":
+        if mode in ("mixed", "prio_mixed"):
             bs, bg = split_batches[i % 4]
             state, v = step_s(ruleset, state, bs, scalars(i), sys_scalars)
             state, v = step_g(ruleset, state, bg, scalars(i), sys_scalars)

@@ -13,6 +13,10 @@ in ``docs/OPERATIONS.md`` ("Cold start").
 Env knobs:
 - ``SENTINEL_COMPILE_CACHE`` — cache directory (default
   ``~/.cache/sentinel_tpu/xla``); ``0``/``off`` disables.
+- ``SENTINEL_FIRST_LOAD_TIMEOUT_S`` / ``SENTINEL_FIRST_LOAD_RETRIES`` —
+  wall-clock timeout and retry budget for :func:`guarded_first_fetch`
+  (first program fetches). Default: 20 s / 2 retries on accelerator
+  backends, disabled on CPU; ``0`` disables everywhere.
 
 Default policy: AUTO-ON for accelerator backends (TPU — where a step
 compile costs tens of seconds), OPT-IN on the CPU backend (set the env
@@ -25,9 +29,10 @@ which is not an acceptable default for a serving process's logs.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
-from typing import Optional
+from typing import Optional, Tuple
 
 _lock = threading.Lock()
 _enabled_dir: Optional[str] = None
@@ -86,3 +91,101 @@ def enable_persistent_cache(path: Optional[str] = None) -> Optional[str]:
 
 def active_cache_dir() -> Optional[str]:
     return _enabled_dir
+
+
+# ---------------------------------------------------------------------------
+# First program fetch guard — the cold-start TAIL story.
+#
+# The measured warm start on the tunneled TPU is ~6-7 s, but one run in
+# three measured rounds rode a ~50 s transport stall on a SINGLE program
+# load (54.9 s total — OPERATIONS.md "Cold start"). The fetch itself is
+# cheap and idempotent (cache load + program transfer); only the stalled
+# RPC is slow. A fresh attempt opens a fresh transfer and typically
+# completes at the normal 0.1-0.6 s cost, so a timeout + bounded retry
+# caps the tail at ~(retries x timeout) instead of the full stall.
+# ---------------------------------------------------------------------------
+
+_log = logging.getLogger("sentinel_tpu.coldstart")
+
+
+def first_fetch_policy() -> Tuple[float, int]:
+    """→ ``(timeout_s, retries)`` for :func:`guarded_first_fetch`.
+
+    ``SENTINEL_FIRST_LOAD_TIMEOUT_S`` overrides the timeout (``0`` turns
+    the guard off); ``SENTINEL_FIRST_LOAD_RETRIES`` the retry budget.
+    Default policy mirrors the cache itself: on for accelerator backends
+    (where the program-load RPC can stall), off on CPU (loads are local
+    file reads — a guard thread per program would be pure overhead)."""
+    retries = 2
+    env_r = os.environ.get("SENTINEL_FIRST_LOAD_RETRIES", "")
+    if env_r:
+        try:
+            retries = max(0, int(env_r))
+        except ValueError:
+            pass
+    env_t = os.environ.get("SENTINEL_FIRST_LOAD_TIMEOUT_S", "")
+    if env_t:
+        try:
+            return max(0.0, float(env_t)), retries
+        except ValueError:
+            return 0.0, 0
+    try:
+        import jax
+        if jax.default_backend() == "cpu":
+            return 0.0, 0
+    except Exception:  # pragma: no cover
+        return 0.0, 0
+    return 20.0, retries
+
+
+def guarded_first_fetch(fn, what: str, timeout_s: float, retries: int):
+    """Run ``fn`` — an IDEMPOTENT first program fetch/execution — with a
+    wall-clock timeout and a bounded retry budget; → the first attempt's
+    result to complete. A warning is logged every time a retry fires.
+
+    ``fn`` MUST be safe to run concurrently with a stalled copy of
+    itself (throwaway inputs, no shared mutable state): a timed-out
+    attempt cannot be cancelled (the RPC is stuck inside the runtime),
+    so the retry races it and the straggler's result is discarded. The
+    LAST attempt waits without a timeout — once the budget is spent
+    there is no cap left to enforce, and the warning trail already
+    records the stalls."""
+    if timeout_s <= 0:
+        return fn()
+    import queue
+    q: "queue.Queue" = queue.Queue()
+
+    def _run():
+        try:
+            q.put((None, fn()))
+        except BaseException as e:  # noqa: BLE001 — re-raised on the caller
+            q.put((e, None))
+
+    last_err: Optional[BaseException] = None
+    for attempt in range(retries + 1):
+        threading.Thread(target=_run, daemon=True,
+                         name=f"sentinel-first-fetch-{attempt}").start()
+        final = attempt == retries
+        try:
+            err, out = q.get(timeout=None if final else timeout_s)
+        except queue.Empty:
+            _log.warning(
+                "first program fetch of %s stalled > %gs "
+                "(attempt %d/%d) — retrying; a persistent-cache load or "
+                "program transfer is likely riding a transport stall",
+                what, timeout_s, attempt + 1, retries + 1)
+            continue
+        if err is None:
+            return out
+        last_err = err
+        if final:
+            raise err
+        _log.warning(
+            "first program fetch of %s failed (%s: %s) on attempt %d/%d "
+            "— retrying", what, type(err).__name__, err, attempt + 1,
+            retries + 1)
+    # every attempt timed out and the final blocking get was interrupted
+    # by a straggler's error — surface it rather than hanging
+    if last_err is not None:  # pragma: no cover - straggler-error race
+        raise last_err
+    raise RuntimeError(f"first program fetch of {what} did not complete")

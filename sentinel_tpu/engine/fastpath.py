@@ -24,11 +24,15 @@ that dominate real traffic, while keeping every statistic on device:
   conservative direction). When the chunk is denied the row is marked hot
   for the bucket and every event takes the exact device path.
 
-Exclusions (events fall through to the device path): prioritized entries,
-entries with args on param-ruled resources, origin/non-default-context
-entries on LEASED rows (their per-origin stats need per-event recording),
-and everything while system rules are loaded (SystemSlot gates inbound
-traffic globally; host-admitting would bypass it).
+Exclusions (events fall through to the device path): prioritized entries
+(a PriorityWait admission must book the next window in the device's
+FlowDynState ring — host leases cannot; the device side is no longer a
+demotion, it runs the vectorized occupy variant,
+rules/flow.flow_check_fast_occupy), entries with args on param-ruled
+resources, origin/non-default-context entries on LEASED rows (their
+per-origin stats need per-event recording), and everything while system
+rules are loaded (SystemSlot gates inbound traffic globally;
+host-admitting would bypass it).
 
 Thread gauge: leased admissions are excluded from the concurrency gauge on
 both sides (entry pre-charge and exit both carry ``count_thread=False``),

@@ -305,13 +305,17 @@ def decide_entries(
     # (no alt rows, uniform acquire >= 1, no prioritized events, no
     # cluster_fallback bits) → flow + degrade take the scalar admission
     # path: per-rule budgets, one rank sort, sort-free breaker probes
-    # (see rules/flow.flow_check_scalar). Implies record_alt=False and
-    # enable_occupy=False.
+    # (see rules/flow.flow_check_scalar). Implies record_alt=False.
+    # With enable_occupy=True the scalar checker folds LANDED occupy
+    # bookings into the QPS base (occupy_base) — the batch still carries
+    # no prioritized events, it only dispatches AROUND live bookings.
     fast_flow: bool = False,     # STATIC: HOST-VERIFIED preconditions
-    # (uniform acquire >= 1, no prioritized events, occupy off) → the
-    # fast GENERAL path: origins/alt rows/CHAIN/fallback bits all live,
+    # (uniform acquire >= 1, composite key fits int32) → the fast
+    # GENERAL path: origins/alt rows/CHAIN/fallback bits all live,
     # admission via rank closed forms (rules/flow.flow_check_fast).
-    # Mutually exclusive with scalar_flow; implies enable_occupy=False.
+    # Mutually exclusive with scalar_flow. With enable_occupy=True the
+    # occupy-capable variant runs (rules/flow.flow_check_fast_occupy):
+    # prioritized events take the vectorized tryOccupyNext path.
     skip_auth: bool = False,     # STATIC: no authority rules loaded —
     # the whole slot (incl. its [B, Ka] gathers) compiles away
     skip_sys: bool = False,      # STATIC: no system thresholds set
@@ -342,12 +346,9 @@ def decide_entries(
     cpu_usage = sys_scalars[1]
 
     if scalar_flow:
-        assert not record_alt and not enable_occupy, \
-            "scalar_flow implies record_alt=False, enable_occupy=False"
+        assert not record_alt, "scalar_flow implies record_alt=False"
     if fast_flow:
-        assert not scalar_flow and not enable_occupy, \
-            "fast_flow is exclusive with scalar_flow and implies " \
-            "enable_occupy=False"
+        assert not scalar_flow, "fast_flow is exclusive with scalar_flow"
 
     # ---- slot cascade (each gate only sees events still alive) ----
     live = batch.valid
@@ -410,7 +411,8 @@ def decide_entries(
             main_minute=state.minute if spec.minute else None,
             now_idx_m=now_idx_m,
             has_rate_limiter=scalar_has_rl,
-            rules_bk=flow_bk)
+            rules_bk=flow_bk,
+            occupy_base=enable_occupy)
         occupied = jnp.zeros_like(flow_ok)
         live3 = live2 & flow_ok
         breakers, deg_ok = deg_mod.degrade_entry_check_scalar(
@@ -420,7 +422,6 @@ def decide_entries(
         # fast general path: per-pair origin/row selection stays live, the
         # admission machinery collapses to rank closed forms; the degrade
         # slot is origin-independent, so the scalar variant applies as-is
-        # (occupy is off, so live3 needs no ~occupied mask)
         cl_fb = (batch.cluster_fallback if batch.cluster_fallback is not None
                  else jnp.zeros(batch.valid.shape, jnp.int32))
         fview = flow_mod.FlowBatchView(
@@ -428,21 +429,40 @@ def decide_entries(
             origin_rows=batch.origin_rows, context_ids=batch.context_ids,
             chain_rows=batch.chain_rows, acquire=batch.acquire, valid=live2,
             prioritized=batch.prioritized, cluster_fallback=cl_fb)
-        flow_dyn, flow_ok, wait_ms = flow_mod.flow_check_fast(
-            rules.flow_table, state.flow_dyn, rules.flow_idx, spec.second,
-            state.second, state.alt_second, state.threads,
-            state.alt_threads, fview, now_idx_s, rel_now_ms,
-            minute_spec=spec.minute,
-            main_minute=state.minute if spec.minute else None,
-            now_idx_m=now_idx_m,
-            has_rate_limiter=scalar_has_rl,
-            has_thread_rules=not skip_threads,
-            rules_bk=flow_bk)
-        occupied = jnp.zeros_like(flow_ok)
+        if enable_occupy:
+            flow_dyn, flow_ok, wait_ms, occupied = \
+                flow_mod.flow_check_fast_occupy(
+                    rules.flow_table, state.flow_dyn, rules.flow_idx,
+                    spec.second, state.second, state.alt_second,
+                    state.threads, state.alt_threads, fview, now_idx_s,
+                    rel_now_ms,
+                    minute_spec=spec.minute,
+                    main_minute=state.minute if spec.minute else None,
+                    now_idx_m=now_idx_m,
+                    in_win_ms=in_win_ms,
+                    occupy_timeout_ms=spec.occupy_timeout_ms,
+                    has_rate_limiter=scalar_has_rl,
+                    has_thread_rules=not skip_threads,
+                    rules_bk=flow_bk)
+        else:
+            flow_dyn, flow_ok, wait_ms = flow_mod.flow_check_fast(
+                rules.flow_table, state.flow_dyn, rules.flow_idx, spec.second,
+                state.second, state.alt_second, state.threads,
+                state.alt_threads, fview, now_idx_s, rel_now_ms,
+                minute_spec=spec.minute,
+                main_minute=state.minute if spec.minute else None,
+                now_idx_m=now_idx_m,
+                has_rate_limiter=scalar_has_rl,
+                has_thread_rules=not skip_threads,
+                rules_bk=flow_bk)
+            occupied = jnp.zeros_like(flow_ok)
         live3 = live2 & flow_ok
+        # occupied (PriorityWait) events bypass the degrade slot — see the
+        # general branch below
         breakers, deg_ok = deg_mod.degrade_entry_check_scalar(
             rules.deg_table, state.breakers, rules.deg_idx, batch.rows,
-            live3, rel_now_ms, rules_bk=deg_bk)
+            live3 & ~occupied, rel_now_ms, rules_bk=deg_bk)
+        deg_ok = deg_ok | occupied
     else:
         cl_fb = (batch.cluster_fallback if batch.cluster_fallback is not None
                  else jnp.zeros(batch.valid.shape, jnp.int32))
@@ -891,5 +911,13 @@ def invalidate_resource_rows(spec: EngineSpec, state: SentinelState,
     threads = state.threads.at[rows].set(0, mode="drop")
     alt_second = invalidate_rows(spec.second, state.alt_second, alt_rows)
     alt_threads = state.alt_threads.at[alt_rows].set(0, mode="drop")
+    # occupy bookings are keyed by resource ROW — a recycled row must not
+    # inherit the evicted resource's pre-booked next-window budget
+    flow_dyn = state.flow_dyn._replace(
+        occupied_count=state.flow_dyn.occupied_count.at[rows].set(
+            0.0, mode="drop"),
+        occupied_window=state.flow_dyn.occupied_window.at[rows].set(
+            -(2 ** 30), mode="drop"))
     return state._replace(second=second, minute=minute, threads=threads,
-                         alt_second=alt_second, alt_threads=alt_threads)
+                          alt_second=alt_second, alt_threads=alt_threads,
+                          flow_dyn=flow_dyn)

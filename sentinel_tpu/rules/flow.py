@@ -630,6 +630,12 @@ def flow_check_scalar(
     # costs performance, never correctness.
     rules_bk: Optional[jnp.ndarray] = None,   # pre-gathered [B, K] rule
     # ids (the pipeline's joint flow+degrade gather); None = gather here
+    occupy_base: bool = False,        # STATIC: live occupy bookings may
+    # exist → fold LANDED bookings into the per-rule QPS admission base
+    # (one [NF+1, S] gather — negligible). The batch itself must still
+    # carry no prioritized events (this path never books); it only has
+    # to SEE bookings committed by prioritized traffic dispatched around
+    # it (runtime._decide_split_nowait's scalar side).
 ) -> Tuple[FlowDynState, jnp.ndarray, jnp.ndarray]:
     """Scalar-path flow check → (dyn', allow bool[B], wait_ms int32[B]).
 
@@ -639,7 +645,8 @@ def flow_check_scalar(
     * the batch carries no origin/chain rows and no origins (every
       ``use_alt`` selection in the general path resolves to padding →
       SEL_ORIGIN/SEL_CHAIN rules pass trivially);
-    * no prioritized events and no live occupy bookings (occupy off);
+    * no prioritized events (live bookings are fine with
+      ``occupy_base=True`` — this path reads them, never writes them);
     * no per-event ``cluster_fallback`` bits (cluster rules are simply
       inapplicable locally);
     * ``acquire`` is uniform across valid events with value >= 1.
@@ -673,6 +680,13 @@ def flow_check_scalar(
     sel_row = jnp.minimum(table.sync_row, R - 1)
     base_pass = window_sum_rows(spec, main_second, sel_row, ev.PASS,
                                 now_idx_s).astype(jnp.float32)
+    if occupy_base:
+        # landed bookings count toward the rolling QPS sum exactly as in
+        # flow_check; a valid pair's selected row IS its rule's sync_row,
+        # so the per-pair landed sum is a per-rule column here (same
+        # float operands + association → bit-exact)
+        base_pass = base_pass + _landed_per_rule(
+            dyn, sel_row, spec, now_idx_s)
     base_thr = main_threads[sel_row].astype(jnp.float32)
     base = jnp.where(table.grade == GRADE_QPS, base_pass, base_thr)
 
@@ -776,6 +790,20 @@ def flow_check_scalar(
     return dyn, allow, wait_ms
 
 
+def _landed_per_rule(dyn: FlowDynState, sel_row: jnp.ndarray,
+                     spec: WindowSpec, now_idx_s: jnp.ndarray) -> jnp.ndarray:
+    """LANDED occupy bookings per rule → float32[NF+1]: sum of bookings on
+    the rule's selected main row whose target window has been reached and
+    is still inside the rolling interval (age in [0, B)). The per-rule
+    form of ``flow_check``'s ``landed_bk`` — identical numeric values for
+    every valid main-row pair, since such a pair's ``sel_main_row`` equals
+    its rule's ``sync_row``."""
+    occ_age = now_idx_s - dyn.occupied_window[sel_row]      # [NF+1, S]
+    return jnp.sum(
+        jnp.where((occ_age >= 0) & (occ_age < spec.buckets),
+                  dyn.occupied_count[sel_row], 0.0), axis=1)
+
+
 def flow_check_fast(
     table: FlowRuleTable,
     dyn: FlowDynState,
@@ -829,6 +857,82 @@ def flow_check_fast(
     * the rate limiter collapses to the same bounded per-rule rank budget
       ``max_k`` as the scalar path (RateLimiterController.java:30-90).
     """
+    dyn, allow, wait_ms, _ = _flow_check_fast_impl(
+        table, dyn, rule_idx, spec, main_second, alt_second, main_threads,
+        alt_threads, batch, now_idx_s, rel_now_ms, minute_spec, main_minute,
+        now_idx_m, has_rate_limiter, has_thread_rules, rules_bk,
+        enable_occupy=False, in_win_ms=None, occupy_timeout_ms=0)
+    return dyn, allow, wait_ms
+
+
+def flow_check_fast_occupy(
+    table: FlowRuleTable,
+    dyn: FlowDynState,
+    rule_idx: jnp.ndarray,
+    spec: WindowSpec,
+    main_second: WindowState,
+    alt_second: WindowState,
+    main_threads: jnp.ndarray,
+    alt_threads: jnp.ndarray,
+    batch: FlowBatchView,
+    now_idx_s: jnp.ndarray,
+    rel_now_ms: jnp.ndarray,
+    minute_spec: Optional[WindowSpec] = None,
+    main_minute: Optional[WindowState] = None,
+    now_idx_m: Optional[jnp.ndarray] = None,
+    in_win_ms: Optional[jnp.ndarray] = None,
+    occupy_timeout_ms: int = 500,
+    has_rate_limiter: bool = True,    # STATIC: see flow_check_fast
+    has_thread_rules: bool = True,    # STATIC: see flow_check
+    rules_bk: Optional[jnp.ndarray] = None,   # [B, K] pre-gathered rule ids
+) -> Tuple[FlowDynState, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Occupy-capable fast general path → (dyn', allow, wait_ms, occupied).
+
+    :func:`flow_check_fast` plus the PRIORITIZED admission path
+    (``DefaultController.canPass`` prioritized=true → ``tryOccupyNext``,
+    DefaultController.java:77-97) — no composite-key sort, no greedy fixed
+    point. Same host-verified preconditions as the plain fast path
+    (uniform acquire >= 1, key fits int32); prioritized events and live
+    bookings are allowed — that is the point.
+
+    Why it stays bit-exact with :func:`flow_check` (enable_occupy=True):
+
+    * LANDED bookings fold into the admission base per RULE: occupy is
+      main-row-only and a valid main-row pair's ``sel_main_row`` is its
+      rule's ``sync_row``, so ``landed_bk`` is a [NF+1] column riding the
+      packed verdict gather (alt-row pairs never see bookings in either
+      path);
+    * the occupy attempt's ``greedy_admit`` runs over the same segments
+      with amounts only on ELIGIBLE pairs — with uniform acquire its
+      fixed point is the rank prefix AMONG ELIGIBLE PAIRS, so one extra
+      per-slot rank pass over an eligibility-masked key reproduces it:
+      admitted iff ``(surviving + next_window + rank_elig*a) + a <=
+      limit`` (same operand association as the cumsum form);
+    * the event-level gate (every failing pair must itself be
+      occupy-admitted) and the one-booking-per-event scatter commit are
+      the general path's own event-indexed code, verbatim — they never
+      needed the sort.
+
+    The attempt (ranks + booking scatter) runs under
+    ``lax.cond(any(prioritized))``: a batch routed here only because
+    bookings were still live pays one [NF+1, S] fold and nothing else.
+    """
+    assert in_win_ms is not None, \
+        "flow_check_fast_occupy needs in_win_ms (occupy wait math)"
+    return _flow_check_fast_impl(
+        table, dyn, rule_idx, spec, main_second, alt_second, main_threads,
+        alt_threads, batch, now_idx_s, rel_now_ms, minute_spec, main_minute,
+        now_idx_m, has_rate_limiter, has_thread_rules, rules_bk,
+        enable_occupy=True, in_win_ms=in_win_ms,
+        occupy_timeout_ms=occupy_timeout_ms)
+
+
+def _flow_check_fast_impl(
+    table, dyn, rule_idx, spec, main_second, alt_second, main_threads,
+    alt_threads, batch, now_idx_s, rel_now_ms, minute_spec, main_minute,
+    now_idx_m, has_rate_limiter, has_thread_rules, rules_bk,
+    enable_occupy, in_win_ms, occupy_timeout_ms,
+) -> Tuple[FlowDynState, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     B = batch.rows.shape[0]
     K = rule_idx.shape[1]
     NF = table.active.shape[0] - 1
@@ -885,6 +989,13 @@ def flow_check_fast(
     srow_sel = jnp.minimum(table.sync_row, R - 1)
     row_pass = window_sum_rows(spec, main_second, srow_sel, ev.PASS,
                                now_idx_s).astype(jnp.float32)
+    if enable_occupy:
+        # fold LANDED bookings into the per-rule QPS base (flow_check's
+        # `cur_pass + landed_bk`, same operands + association); alt-row
+        # pairs read the alt columns and stay booking-free, matching the
+        # general path's `no_book` mask
+        row_pass = row_pass + _landed_per_rule(dyn, srow_sel, spec,
+                                               now_idx_s)
 
     # ---- ONE packed per-rule gather [NF+1, C] → [B, K, C]. Column count
     # is STATIC per ruleset: the RL block (4 columns + closed forms) only
@@ -909,6 +1020,14 @@ def flow_check_fast(
         i_thr, i_grade = ncol, ncol + 1
         row_thr = main_threads[srow_sel].astype(jnp.float32)
         cols += [lax.bitcast_convert_type(row_thr, jnp.int32), table.grade]
+        ncol += 2
+    if enable_occupy:
+        # per-rule occupy eligibility: only DefaultController-grade rules
+        # (QPS + DEFAULT behavior) have a prioritized path
+        i_occ = ncol
+        cols += [((table.grade == GRADE_QPS)
+                  & (table.behavior == BEHAVIOR_DEFAULT)).astype(jnp.int32)]
+        ncol += 1
     vt = jnp.stack(cols, axis=1)
     g = vt[rules_bk]                                         # [B, K, C]
 
@@ -969,14 +1088,104 @@ def flow_check_fast(
         wait_pair = jnp.maximum(
             g[..., i_bt] + (safe_rank + 1) * g[..., i_cost] - rel_now_ms,
             0)
-        pair_pass = jnp.where(rl_p, pass_rl, pass_default) | ~valid_pair
+
+    # ---- occupy attempt (tryOccupyNext; see flow_check_fast_occupy) ----
+    if enable_occupy and in_win_ms is not None and occupy_timeout_ms > 0:
+        wait_next = (jnp.int32(spec.win_ms) - in_win_ms).astype(jnp.int32)
+        occ_cnt = dyn.occupied_count             # [R, S]
+        occ_win = dyn.occupied_window            # [R, S]
+
+        def _occupy_attempt(_):
+            can_time = wait_next <= occupy_timeout_ms
+            # per-rule: passes SURVIVING into window now+1 (flow_check's
+            # survive_mask, over the rule's selected row) + bookings
+            # still live in the next window — eligible pairs are always
+            # main-row, where sel_main_row == sync_row
+            srow_stamps = main_second.stamps[srow_sel]       # [NF+1, B]
+            sdelta = now_idx_s - srow_stamps
+            survive_mask = (sdelta >= 0) & (sdelta <= spec.buckets - 2)
+            surviving = jnp.sum(
+                jnp.where(survive_mask,
+                          main_second.counters[srow_sel, :, ev.PASS], 0),
+                axis=1).astype(jnp.float32)
+            occ_age = now_idx_s - occ_win[srow_sel]          # [NF+1, S]
+            nextw = jnp.sum(
+                jnp.where((occ_age >= -1) & (occ_age < spec.buckets - 1),
+                          occ_cnt[srow_sel], 0.0), axis=1)
+            occ_base_p = (surviving + nextw)[rules_bk]       # [B, K]
+            eligible = (batch.prioritized[:, None] & (g[..., i_occ] != 0)
+                        & ~pass_default & valid_pair & ~use_alt & can_time)
+            # ranks among ELIGIBLE pairs only: the general path's greedy
+            # fixed point gives ineligible pairs zero amounts, so its
+            # admitted set is exactly the eligible-rank prefix under the
+            # uniform acquire — one extra per-slot rank pass, no sort
+            key_occ = jnp.where(eligible, key, NF * (RA + 1))
+            rank_occ = seg.ranks_per_slot(key_occ).astype(jnp.float32)
+            occ_adm = (((occ_base_p + rank_occ * a_f) + a_f <= limit_pair)
+                       & eligible)
+
+            # event-level gate BEFORE committing bookings: every failing
+            # pair of the event must itself be occupy-admitted
+            if has_rate_limiter:
+                pair_ok = (jnp.where(rl_p, pass_rl, pass_default | occ_adm)
+                           | ~valid_pair)
+            else:
+                pair_ok = (pass_default | occ_adm) | ~valid_pair
+            event_ok = jnp.all(pair_ok, axis=1)
+            event_occ = (jnp.any(occ_adm, axis=1) & event_ok
+                         & batch.valid)                      # [B]
+
+            # one booking per admitted event on its resource row, slot
+            # ring keyed by window now+1 (flow_check's commit, verbatim)
+            slots_n = occ_cnt.shape[1]
+            slot = (now_idx_s + 1) % slots_n
+            grants = jnp.zeros(occ_cnt.shape[0], jnp.float32).at[
+                jnp.where(event_occ, batch.rows, occ_cnt.shape[0])].add(
+                jnp.where(event_occ, batch.acquire, 0).astype(jnp.float32),
+                mode="drop")
+            granted_row = grants > 0
+            slot_keep = occ_win[:, slot] == now_idx_s + 1
+            new_cnt = jnp.where(granted_row,
+                                jnp.where(slot_keep, occ_cnt[:, slot], 0.0)
+                                + grants,
+                                occ_cnt[:, slot])
+            new_win = jnp.where(granted_row, now_idx_s + 1,
+                                occ_win[:, slot])
+            return (occ_cnt.at[:, slot].set(new_cnt),
+                    occ_win.at[:, slot].set(new_win),
+                    occ_adm & event_occ[:, None])
+
+        def _no_occupy(_):
+            return occ_cnt, occ_win, jnp.zeros_like(pass_default)
+
+        # real control flow, like flow_check: a batch routed here only
+        # because bookings were live (no prioritized events) skips the
+        # whole attempt — it pays the landed fold and nothing else
+        new_occ_cnt, new_occ_win, occ_adm_p = jax.lax.cond(
+            jnp.any(batch.prioritized), _occupy_attempt, _no_occupy, None)
+        dyn = dyn._replace(occupied_count=new_occ_cnt,
+                           occupied_window=new_occ_win)
+    else:
+        occ_adm_p = jnp.zeros_like(pass_default)
+        wait_next = jnp.int32(0)
+
+    if has_rate_limiter:
+        pair_pass = (jnp.where(rl_p, pass_rl, pass_default | occ_adm_p)
+                     | ~valid_pair)
         pair_wait = jnp.where(rl_p & pair_pass & valid_pair, wait_pair, 0)
+        if enable_occupy:
+            pair_wait = jnp.maximum(pair_wait,
+                                    jnp.where(occ_adm_p, wait_next, 0))
         wait_ms = jnp.max(pair_wait, axis=1)
     else:
-        pair_pass = pass_default | ~valid_pair
-        wait_ms = jnp.zeros((B,), jnp.int32)
+        pair_pass = (pass_default | occ_adm_p) | ~valid_pair
+        if enable_occupy:
+            wait_ms = jnp.max(jnp.where(occ_adm_p, wait_next, 0), axis=1)
+        else:
+            wait_ms = jnp.zeros((B,), jnp.int32)
 
     allow = jnp.all(pair_pass, axis=1)
+    occupied = jnp.any(occ_adm_p, axis=1) & allow & batch.valid
 
     # ---- pacing-clock update (per rule; RL segments are per-rule) ----
     if has_rate_limiter:
@@ -994,7 +1203,7 @@ def flow_check_fast(
             latest_passed_ms=jnp.maximum(dyn.latest_passed_ms, new_latest))
 
     allow = allow | ~batch.valid
-    return dyn, allow, wait_ms.astype(jnp.int32)
+    return dyn, allow, wait_ms.astype(jnp.int32), occupied
 
 
 def _rl_closed_form(table: FlowRuleTable, dyn: FlowDynState,

@@ -137,6 +137,13 @@ def _jit_uncount_reserved(spec: EngineSpec):
 def _jit_bucket_snapshot(spec: WindowSpec):
     return jax.jit(functools.partial(bucket_snapshot, spec))
 
+
+@functools.lru_cache(maxsize=None)
+def _jit_settle_occupied(spec: WindowSpec):
+    from sentinel_tpu.stats.window import settle_occupied
+    return jax.jit(functools.partial(settle_occupied, spec,
+                                     event=ev.PASS))
+
 _H1 = 0x9E3779B1
 _H2 = 0x85EBCA6B
 _MASK = 0xFFFFFFFF
@@ -390,6 +397,9 @@ class Sentinel:
          self._jit_exit, self._jit_exit_noalt,
          self._jit_invalidate, self._jit_record_blocks) = \
             _jitted_steps(self.spec, shardings=self._mesh_shardings)
+        # (variant, geometry, statics) combos whose program fetch was
+        # already guarded — see _warm_first_fetch_locked
+        self._fetched_programs: set = set()
         self._token_service = None          # cluster TokenService (client or
         # embedded server facade); set via set_token_service
         self._cluster_rules_by_row: dict = {}
@@ -597,11 +607,26 @@ class Sentinel:
             self._flow = compiled
             self._cluster_rules_by_row = cluster_map
             self._ruleset = self._build_ruleset()
-            # fresh shaping state for the new tables (reference rebuilds raters)
-            self._state = self._state._replace(
-                flow_dyn=flow_mod.init_flow_dyn(cfg.max_flow_rules,
-                                                self.spec.second.buckets,
-                                                self.spec.rows))
+            # fresh shaping state for the new tables (reference rebuilds
+            # raters) — but occupy bookings are ROW-keyed promises already
+            # granted to callers (the PriorityWait admission happened), so
+            # they must survive the reload: LANDED bookings settle into
+            # the second window as PASS (every rolling sum then reads the
+            # same total it read from the booking ring) and PENDING ones
+            # carry into the fresh ring (tests/test_occupy.py pins both)
+            old_dyn = self._state.flow_dyn
+            now_idx = self.spec.second.index_of(self.clock.now_ms())
+            second, pend_cnt, pend_win = _jit_settle_occupied(
+                self.spec.second)(
+                self._state.second, old_dyn.occupied_count,
+                old_dyn.occupied_window, jnp.int32(now_idx))
+            fresh = flow_mod.init_flow_dyn(cfg.max_flow_rules,
+                                           self.spec.second.buckets,
+                                           self.spec.rows)
+            fresh = fresh._replace(occupied_count=pend_cnt,
+                                   occupied_window=pend_win)
+            self._state = self._state._replace(second=second,
+                                               flow_dyn=fresh)
             self._pin_state_locked()
             self._rebuild_fastpath()
 
@@ -1415,6 +1440,20 @@ class Sentinel:
     def _pad(self, n: int) -> int:
         return pad_pow2(n)
 
+    def intern_resources(self, resources: Sequence[str]) -> np.ndarray:
+        """Pre-stage a batch's resource rows: intern every name once and
+        return the int32 row array. Serving loops that dispatch the same
+        resource set step after step pass the returned array straight to
+        :meth:`entry_batch` / :meth:`entry_batch_nowait` as ``resources``,
+        moving the string-encode + intern cost out of the per-step path
+        (one FFI call here instead of one per step)."""
+        batch_intern = getattr(self.resources, "get_or_create_batch", None)
+        if batch_intern is not None:
+            return np.asarray(batch_intern(resources), np.int32)
+        return np.fromiter(
+            (self.resources.get_or_create(r) for r in resources),
+            np.int32, count=len(resources))
+
     def entry_batch(self, resources: Sequence[str], *,
                     origins: Optional[Sequence[str]] = None,
                     contexts: Optional[Sequence[str]] = None,
@@ -1446,15 +1485,36 @@ class Sentinel:
 
         ``args_list`` may be a 2D numpy integer array (one row per event) —
         the fastest form: single-rule integer-key workloads then resolve
-        fully vectorized with one intern per distinct key."""
+        fully vectorized with one intern per distinct key.
+
+        ``resources`` may be a numpy INTEGER array of pre-interned rows
+        (from :meth:`intern_resources`) — serving loops that re-dispatch
+        the same resource set every step then skip the per-step string
+        intern entirely (the config-4 host-prep hotspot: encoding B
+        strings per step dwarfed the device time at large batches).
+        Names are recovered lazily (registry reverse lookup) only where a
+        denial log or cluster/gate tier actually needs them. Rows evicted
+        by registry pressure after interning resolve to row-recycled
+        verdicts — same class of skew as any stale name→row cache."""
         n = len(resources)
-        batch_intern = getattr(self.resources, "get_or_create_batch", None)
-        if batch_intern is not None:      # native table: one FFI call, no GIL
-            rows = batch_intern(resources)
+        if isinstance(resources, np.ndarray) and resources.dtype.kind in "iu":
+            rows = np.ascontiguousarray(resources, np.int32)
+            resources = None
         else:
-            rows = np.fromiter(
-                (self.resources.get_or_create(r) for r in resources),
-                np.int32, count=n)
+            batch_intern = getattr(self.resources, "get_or_create_batch",
+                                   None)
+            if batch_intern is not None:  # native table: one FFI call, no GIL
+                rows = batch_intern(resources)
+            else:
+                rows = np.fromiter(
+                    (self.resources.get_or_create(r) for r in resources),
+                    np.int32, count=n)
+        if resources is None and (self._host_gates
+                                  or self._cluster_rules_by_row
+                                  or self._cluster_param_rules_by_row):
+            # gates and cluster delegation are name-keyed SPI surfaces;
+            # materialize names once for the whole batch (rare combination)
+            resources = [self.resources.name_of(int(r)) or "" for r in rows]
         param_rules = param_keys = None
         param_gen = -1
         with self._lock:
@@ -1594,7 +1654,9 @@ class Sentinel:
                 for i in denied.tolist():
                     if cl_blocked is not None and cl_blocked[i]:
                         continue
-                    key = (resources[i], int(reasons[i]),
+                    res_i = (resources[i] if resources is not None
+                             else self.resources.name_of(int(rows[i])) or "")
+                    key = (res_i, int(reasons[i]),
                            (origins[i] if origins is not None
                             and origins[i] else ""))
                     grouped[key] = grouped.get(key, 0) + 1
@@ -1820,12 +1882,15 @@ class Sentinel:
 
         Path selection (host-verified; see rules/flow.py for the variants):
 
-        * all events scalar-eligible → scalar admission path;
-        * origin-bearing events present, uniform acquire, occupy off →
-          the fast general path (whole batch), or a PER-EVENT SPLIT when
-          the batch mixes both kinds — one origin event no longer demotes
-          the entire batch to the sorted path;
-        * otherwise (non-uniform acquire, occupy live) → general path.
+        * all events scalar-eligible → scalar admission path (with live
+          occupy bookings: the occupy-base scalar variant — bookings are
+          read into the QPS base, never written);
+        * origin-bearing or PRIORITIZED events present, uniform acquire →
+          the fast general path (whole batch; prioritized traffic takes
+          the occupy-capable variant), or a PER-EVENT SPLIT when the
+          batch mixes kinds — one origin or prioritized event no longer
+          demotes the entire batch to the sorted path;
+        * otherwise (non-uniform acquire, oversized key) → general path.
         """
         n = rows.shape[0]
         pad_a = self.spec.alt_rows
@@ -1853,20 +1918,27 @@ class Sentinel:
         any_prio = bool(np.asarray(prioritized).any())
         now = self.clock.now_ms() if at_ms is None else at_ms
 
-        # ---- per-event split (optimistic occupy check; re-verified
-        # under the lock by _decide_split_nowait). The dominant pure-
-        # scalar batch short-circuits on the aggregate checks above and
-        # never materializes the per-event mask (hot dispatch path).
+        # ---- per-event split (occupy state re-verified under the lock
+        # by _decide_split_nowait). The dominant pure-scalar batch
+        # short-circuits on the aggregate checks above and never
+        # materializes the per-event mask (hot dispatch path). Neither
+        # prioritized events nor live bookings disable the split any
+        # more: prioritized events ride the general side's occupy-capable
+        # fast variant, and the scalar side folds live bookings into its
+        # admission base (occupy_base) — the pre-r6 whole-batch demotion
+        # to the sorted path was a 16x cliff (BASELINE.md).
         pure_scalar = (no_origin_ids and no_alt_rows
                        and cluster_fallback is None)
-        if (not pure_scalar and acq_uniform and key_fits and not any_prio
-                and now >= self._occupy_live_until_ms):
+        if (not pure_scalar or any_prio) and acq_uniform and key_fits:
             # per-event scalar eligibility: no origin id (origin-limited
             # RELATE rules match on the ID, not the row), no real alt
-            # rows, no cluster-fallback bits; invalid lanes scalar-safe
+            # rows, no cluster-fallback bits, not prioritized (only the
+            # general side may book); invalid lanes scalar-safe
+            prio_np = np.asarray(prioritized)
             ev_scalar = ((oid_np == 0)
                          & (np.asarray(origin_rows) >= pad_a)
-                         & (np.asarray(chain_rows) >= pad_a))
+                         & (np.asarray(chain_rows) >= pad_a)
+                         & ~prio_np)
             if cluster_fallback is not None:
                 ev_scalar = ev_scalar & (np.asarray(cluster_fallback) == 0)
             ev_scalar = ev_scalar | ~vfull
@@ -1876,6 +1948,7 @@ class Sentinel:
                 return self._decide_split_nowait(
                     rows, origin_ids, origin_rows, context_ids, chain_rows,
                     acquire, is_in, ev_scalar, vfull,
+                    prioritized=prio_np, any_prio=any_prio,
                     param_rules=param_rules, param_keys=param_keys,
                     param_gen=param_gen, cluster_fallback=cluster_fallback,
                     count_thread=count_thread, record_block=record_block,
@@ -1918,18 +1991,25 @@ class Sentinel:
             flags = {"skip_auth": self._skip_auth,
                      "skip_sys": self._skip_sys,
                      "skip_threads": self._skip_threads}
-            if (no_alt_rows and no_origin_ids and not use_occ
+            if (no_alt_rows and no_origin_ids and not any_prio
                     and cluster_fallback is None and acq_uniform):
                 # scalar admission path (rules/flow.flow_check_scalar);
                 # requires the row-based no_alt (the step variant must be
-                # record_alt=False for the scalar assertion)
+                # record_alt=False for the scalar assertion). Live occupy
+                # bookings are fine: the occupy step variant folds them
+                # into the QPS base (occupy_base) — this path never books
                 flags["scalar_flow"] = True
                 flags["scalar_has_rl"] = self._scalar_has_rl
-            elif acq_uniform and key_fits and not use_occ:
+            elif acq_uniform and key_fits:
                 # fast general path: origins/alt rows/fallback bits live,
-                # rank closed-form admission (rules/flow.flow_check_fast)
+                # rank closed-form admission (rules/flow.flow_check_fast);
+                # with prioritized events or live bookings the occupy-
+                # capable variant runs (flow_check_fast_occupy) — no more
+                # whole-batch demotion to the sorted path
                 flags["fast_flow"] = True
                 flags["scalar_has_rl"] = self._scalar_has_rl
+            self._warm_first_fetch_locked(decide, batch, times, sys_scalars,
+                                          flags)
             state, verdicts = decide(
                 self._ruleset, self._state, batch, times, sys_scalars,
                 **flags)
@@ -1955,6 +2035,45 @@ class Sentinel:
             return out
 
         return PendingVerdicts(_read)
+
+    def _warm_first_fetch_locked(self, dec, batch, times, sys_scalars,
+                                 flags) -> None:
+        """Cap the cold-start tail on remote-attached backends: the FIRST
+        dispatch of each (step variant, batch geometry, statics) combo
+        pays the program fetch (persistent-cache load + transfer), and
+        one measured warm start in three rode a ~50 s transport stall on
+        a single load (docs/OPERATIONS.md "Cold start"). Before the real
+        dispatch, force the exact same program through an idempotent
+        throwaway execution — fresh state (the step donates its state
+        argument) and an all-invalid copy of the real batch, so shapes
+        and statics match and admission state is untouched — under
+        ``core.compile_cache.guarded_first_fetch``'s timeout + bounded
+        retry (a warning logs every retry). Disabled on the CPU backend
+        by default: program loads there are local file reads. Knobs:
+        ``SENTINEL_FIRST_LOAD_TIMEOUT_S`` / ``SENTINEL_FIRST_LOAD_RETRIES``."""
+        from sentinel_tpu.core.compile_cache import (
+            first_fetch_policy, guarded_first_fetch)
+        timeout_s, retries = first_fetch_policy()
+        if timeout_s <= 0:
+            return
+        key = (id(dec), int(batch.rows.shape[0]),
+               tuple(sorted(flags.items())))
+        if key in self._fetched_programs:
+            return
+
+        def _attempt():
+            throwaway = init_state(self.spec, self.cfg.max_flow_rules,
+                                   self.cfg.max_degrade_rules)
+            warm = batch._replace(
+                valid=np.zeros(int(batch.valid.shape[0]), np.bool_))
+            return jax.block_until_ready(
+                dec(self._ruleset, throwaway, warm, times, sys_scalars,
+                    **flags))
+
+        guarded_first_fetch(
+            _attempt, f"decide step (B={int(batch.rows.shape[0])})",
+            timeout_s, retries)
+        self._fetched_programs.add(key)
 
     def _build_entry_batch(self, rows, origin_ids, origin_rows, context_ids,
                            chain_rows, acquire, is_in, prioritized, vfull,
@@ -1989,12 +2108,14 @@ class Sentinel:
 
     def _decide_split_nowait(self, rows, origin_ids, origin_rows,
                              context_ids, chain_rows, acquire, is_in,
-                             ev_scalar, vfull, *, param_rules, param_keys,
+                             ev_scalar, vfull, *, prioritized, any_prio,
+                             param_rules, param_keys,
                              param_gen, cluster_fallback, count_thread,
                              record_block, now) -> "PendingVerdicts":
         """Mixed-batch dispatch: scalar-eligible events take the scalar
-        step, origin-bearing ones the fast general step — one origin
-        event no longer demotes the whole batch off the fast paths.
+        step, origin-bearing AND prioritized ones the fast general step —
+        one origin or prioritized event no longer demotes the whole batch
+        off the fast paths.
 
         The two sub-steps run scalar-first under one dispatch-lock hold.
         That is a legitimate serialization of the batch: intra-batch
@@ -2002,8 +2123,11 @@ class Sentinel:
         concurrent callers race the same way), and each sub-step is
         bit-exact with the general path over its own events
         (tests/test_split_dispatch.py pins split == sequential).
-        Callers never pass `prioritized` here (any_prio disables the
-        split), so both sub-batches are occupy-free by construction."""
+        Prioritized events are routed to the GENERAL side by the caller's
+        ``ev_scalar`` mask: only the general sub-step may commit occupy
+        bookings (flow_check_fast_occupy); the scalar sub-step runs first
+        and — when bookings may be live — folds them into its admission
+        base (occupy_base) without ever writing them."""
         n = rows.shape[0]
         idx_s = np.nonzero(ev_scalar)[0]
         idx_g = np.nonzero(~ev_scalar)[0]
@@ -2022,10 +2146,11 @@ class Sentinel:
             None, take(count_thread, idx_s), take(record_block, idx_s))
         orow_g = take(origin_rows, idx_g)
         crow_g = take(chain_rows, idx_g)
+        prio_g = (take(prioritized, idx_g) if any_prio else zeros_g)
         bg = self._build_entry_batch(
             take(rows, idx_g), take(origin_ids, idx_g), orow_g,
             take(context_ids, idx_g), crow_g, take(acquire, idx_g),
-            take(is_in, idx_g), zeros_g, vfull[idx_g],
+            take(is_in, idx_g), prio_g, vfull[idx_g],
             take(param_rules, idx_g), take(param_keys, idx_g),
             take(cluster_fallback, idx_g), take(count_thread, idx_g),
             take(record_block, idx_g))
@@ -2043,23 +2168,33 @@ class Sentinel:
             flags = {"skip_auth": self._skip_auth,
                      "skip_sys": self._skip_sys,
                      "skip_threads": self._skip_threads}
-            # re-verify the optimistic occupy check: a concurrent
-            # prioritized batch may have gone live since — then both
-            # sides must take the occupy-aware general step (bookings
-            # count toward admission sums for every event)
-            if now < self._occupy_live_until_ms:
-                dec_s, fl_s = self._jit_decide_prio_noalt, flags
+            # occupy re-verify under the lock: this batch's prioritized
+            # events, or a concurrent prioritized batch since the
+            # optimistic host check, keep occupy live — both sides then
+            # take their occupy-AWARE fast variants (scalar reads live
+            # bookings via occupy_base, general may book via
+            # flow_check_fast_occupy); neither demotes to the sorted path
+            if any_prio:
+                self._occupy_live_until_ms = now + (
+                    (self.spec.second.buckets + 1)
+                    * self.spec.second.win_ms)
+            use_occ = any_prio or now < self._occupy_live_until_ms
+            fl_s = dict(flags, scalar_flow=True,
+                        scalar_has_rl=self._scalar_has_rl)
+            fl_g = dict(flags, fast_flow=True,
+                        scalar_has_rl=self._scalar_has_rl)
+            if use_occ:
+                dec_s = self._jit_decide_prio_noalt
                 dec_g = (self._jit_decide_prio_noalt if no_alt_g
                          else self._jit_decide_prio)
-                fl_g = flags
             else:
                 dec_s = self._jit_decide_noalt
-                fl_s = dict(flags, scalar_flow=True,
-                            scalar_has_rl=self._scalar_has_rl)
                 dec_g = (self._jit_decide_noalt if no_alt_g
                          else self._jit_decide)
-                fl_g = dict(flags, fast_flow=True,
-                            scalar_has_rl=self._scalar_has_rl)
+            self._warm_first_fetch_locked(dec_s, bs, times, sys_scalars,
+                                          fl_s)
+            self._warm_first_fetch_locked(dec_g, bg, times, sys_scalars,
+                                          fl_g)
             state, v1 = dec_s(self._ruleset, self._state, bs, times,
                               sys_scalars, **fl_s)
             state, v2 = dec_g(self._ruleset, state, bg, times,

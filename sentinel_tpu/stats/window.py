@@ -370,6 +370,60 @@ def invalidate_rows(spec: WindowSpec, state: WindowState, rows: jnp.ndarray) -> 
     return state._replace(stamps=stamps)
 
 
+def settle_occupied(spec: WindowSpec, state: WindowState,
+                    occ_cnt: jnp.ndarray, occ_win: jnp.ndarray,
+                    now_idx: jnp.ndarray, event: int):
+    """Materialize occupy bookings into the window so the booking ring can
+    be reset (rule reload rebuilds ``FlowDynState``) without forgetting
+    admissions already granted.
+
+    A LANDED booking (target window reached, still inside the rolling
+    interval: ``0 <= now - w < buckets``) is credited as ``event`` counts
+    into its target bucket ``w % buckets`` — every rolling sum over a span
+    containing ``w`` then reads the identical total it read from the
+    booking ring, so post-reload admission math is unchanged. A dead or
+    rotated target bucket is fully reset (all lanes + rt) and restamped to
+    ``w`` first, exactly as ``refresh_rows`` would on a write. A PENDING
+    booking (``now - w == -1``: target window not reached yet) cannot land
+    in a bucket that does not exist — it is returned for carry into the
+    fresh booking ring instead. Anything older is expired and dropped.
+
+    Returns ``(state', pend_cnt, pend_win)`` with the pending arrays
+    shaped like the booking ring (zero / NEVER where not pending).
+    """
+    R = state.stamps.shape[0]
+    B = spec.buckets
+    rr = jnp.arange(R)
+    counters, stamps = state.counters, state.stamps
+    rt_sum, min_rt = state.rt_sum, state.min_rt
+    pend_cnt = jnp.zeros_like(occ_cnt)
+    pend_win = jnp.full_like(occ_win, NEVER)
+    for s in range(occ_cnt.shape[1]):       # S = buckets + 1, static
+        w = occ_win[:, s]
+        c = occ_cnt[:, s]
+        age = now_idx - w
+        landed = (age >= 0) & (age < B) & (c > 0)
+        pending = (age == -1) & (c > 0)
+        k = jnp.where(landed, w % B, 0)
+        live = stamps[rr, k] == w
+        bsel = jnp.arange(B)[None, :] == k[:, None]          # [R, B]
+        reset_rb = (landed & ~live)[:, None] & bsel
+        counters = jnp.where(reset_rb[:, :, None], 0, counters)
+        if spec.track_rt:
+            rt_sum = jnp.where(reset_rb, 0, rt_sum)
+            min_rt = jnp.where(reset_rb, INT32_MAX, min_rt)
+        stamps = jnp.where(landed[:, None] & bsel, w[:, None], stamps)
+        add_rb = jnp.where(landed[:, None] & bsel,
+                           c.astype(jnp.int32)[:, None], 0)
+        counters = counters.at[:, :, event].add(add_rb)
+        pend_cnt = pend_cnt.at[:, s].set(jnp.where(pending, c, 0.0))
+        pend_win = pend_win.at[:, s].set(jnp.where(pending, w, NEVER))
+    state = state._replace(counters=counters, stamps=stamps)
+    if spec.track_rt:
+        state = state._replace(rt_sum=rt_sum, min_rt=min_rt)
+    return state, pend_cnt, pend_win
+
+
 def bucket_snapshot(spec: WindowSpec, state: WindowState, idx: jnp.ndarray):
     """All rows' counters (+ rt sum) for the bucket at window index ``idx`` —
     zeros where that bucket is dead. The per-second aggregation read the

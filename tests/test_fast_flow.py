@@ -362,3 +362,115 @@ def test_rl_elision_parity(clk):
     s3, v3 = sca(sph._ruleset, s2, b, times, sysv)
     assert np.array_equal(np.asarray(v1.allow), np.asarray(v3.allow))
     assert np.array_equal(np.asarray(v1.wait_ms), np.asarray(v3.wait_ms))
+
+
+def test_fast_occupy_parity_mixed_prio(clk):
+    """flow_check_fast_occupy vs the sorted general path on mixed batches
+    with prioritized events: verdicts, wait_ms, reasons AND every state
+    leaf (including the FlowDynState occupy ring) bit-equal across 20
+    steps of origin-bearing traffic with live bookings rolling through
+    window rotations (the r6 tentpole: prioritized no longer demotes)."""
+    sph = make_sentinel(clk)
+    sph.load_flow_rules(_rules())
+    sph.load_degrade_rules(DEG_RULES)
+    origin_ids = np.array([sph.origins.pin("app-a"),
+                           sph.origins.pin("app-b")], np.int32)
+    ctx_ids = np.array([sph.contexts.pin("some_ctx")], np.int32)
+    rng = np.random.default_rng(7)
+    spec = sph.spec
+    gen = jax.jit(functools.partial(decide_entries, spec,
+                                    enable_occupy=True, record_alt=True))
+    fast = jax.jit(functools.partial(decide_entries, spec,
+                                     enable_occupy=True, record_alt=True,
+                                     fast_flow=True))
+    s1 = s2 = sph._state
+    sysv = jnp.asarray(np.array([0.1, 0.1], np.float32))
+    saw_booking = False
+    for step in range(20):
+        b = _origin_batch(sph, rng, 64, RESOURCES, origin_ids, ctx_ids,
+                          fallback=(step % 3 == 0))
+        b = b._replace(prioritized=jnp.asarray(rng.random(64) < 0.3))
+        times = sph._time_scalars(clk.now_ms())
+        s1, v1 = gen(sph._ruleset, s1, b, times, sysv)
+        s2, v2 = fast(sph._ruleset, s2, b, times, sysv)
+        assert np.array_equal(np.asarray(v1.allow),
+                              np.asarray(v2.allow)), f"allow step {step}"
+        assert np.array_equal(np.asarray(v1.wait_ms),
+                              np.asarray(v2.wait_ms)), f"wait step {step}"
+        assert np.array_equal(np.asarray(v1.reason),
+                              np.asarray(v2.reason)), f"reason step {step}"
+        _assert_state_equal(s1, s2)
+        saw_booking = saw_booking or bool(
+            (np.asarray(s1.flow_dyn.occupied_count) > 0).any())
+        clk.advance_ms(int(rng.integers(20, 400)))
+    assert saw_booking, "no occupy booking exercised — weak test"
+
+
+def test_scalar_occupy_base_parity_live_bookings(clk):
+    """flow_check_scalar with occupy_base folds live bookings into its
+    admission base: a non-prioritized batch decided right after a
+    prioritized one (which booked next-window budget through the general
+    path) must see identical verdicts and flow-relevant state. Alt tables
+    are re-synced each round: record_alt=False never touches them (the
+    split dispatch routes alt-bearing events to the general side)."""
+    sph = make_sentinel(clk)
+    sph.load_flow_rules(_rules())
+    sph.load_degrade_rules(DEG_RULES)
+    rng = np.random.default_rng(9)
+    spec = sph.spec
+    gen = jax.jit(functools.partial(decide_entries, spec,
+                                    enable_occupy=True, record_alt=True))
+    sca = jax.jit(functools.partial(decide_entries, spec,
+                                    enable_occupy=True, record_alt=False,
+                                    scalar_flow=True))
+
+    def freebatch(n, prio_frac):
+        names = [RESOURCES[i] for i in rng.integers(0, len(RESOURCES), n)]
+        rows = np.array([sph.resources.get_or_create(r) for r in names],
+                        np.int32)
+        return EntryBatch(
+            rows=jnp.asarray(rows),
+            origin_ids=jnp.zeros(n, jnp.int32),
+            origin_rows=jnp.full(n, spec.alt_rows, jnp.int32),
+            context_ids=jnp.zeros(n, jnp.int32),
+            chain_rows=jnp.full(n, spec.alt_rows, jnp.int32),
+            acquire=jnp.ones(n, jnp.int32),
+            is_in=jnp.ones(n, jnp.bool_),
+            prioritized=jnp.asarray(rng.random(n) < prio_frac),
+            valid=jnp.asarray(rng.random(n) > 0.1))
+
+    def eq_flow(s1, s2, tag):
+        for name in ("flow_dyn", "second", "minute", "threads", "breakers"):
+            for i, (x, y) in enumerate(zip(
+                    jax.tree.leaves(getattr(s1, name)),
+                    jax.tree.leaves(getattr(s2, name)))):
+                assert np.array_equal(np.asarray(x), np.asarray(y)), \
+                    f"{tag}: {name} leaf {i}"
+
+    s1 = s2 = sph._state
+    sysv = jnp.asarray(np.array([0.1, 0.1], np.float32))
+    saw_booking = False
+    for step in range(16):
+        # prioritized batch through GENERAL on both states (creates live
+        # bookings), then a non-prio batch gen-vs-scalar: scalar must SEE
+        # the bookings through occupy_base without ever writing them
+        times = sph._time_scalars(clk.now_ms())
+        bp = freebatch(64, 0.4)
+        s1, _ = gen(sph._ruleset, s1, bp, times, sysv)
+        s2, _ = gen(sph._ruleset, s2, bp, times, sysv)
+        saw_booking = saw_booking or bool(
+            (np.asarray(s1.flow_dyn.occupied_count) > 0).any())
+        clk.advance_ms(int(rng.integers(20, 300)))
+        times = sph._time_scalars(clk.now_ms())
+        bn = freebatch(64, 0.0)
+        s1, v1 = gen(sph._ruleset, s1, bn, times, sysv)
+        s2, v2 = sca(sph._ruleset, s2, bn, times, sysv)
+        assert np.array_equal(np.asarray(v1.allow),
+                              np.asarray(v2.allow)), f"allow step {step}"
+        assert np.array_equal(np.asarray(v1.wait_ms),
+                              np.asarray(v2.wait_ms)), f"wait step {step}"
+        eq_flow(s1, s2, f"step {step}")
+        s2 = s2._replace(alt_second=s1.alt_second,
+                         alt_threads=s1.alt_threads)
+        clk.advance_ms(int(rng.integers(20, 300)))
+    assert saw_booking, "no occupy booking exercised — weak test"

@@ -135,3 +135,90 @@ def test_non_default_behavior_never_occupies(clk):
     assert "block" in res
     with pytest.raises(stpu.BlockException):
         sph.entry("wu", prioritized=True)
+
+
+def _book_pending(sph):
+    """Fill bucket W, move to W+1, book one unit of window W+2 via the
+    batch tier (which returns wait_ms instead of sleeping — the booking
+    is committed but the clock stays in W+1: a PENDING booking)."""
+    import numpy as np
+    drain(sph, "svc", 2)
+    sph.clock.advance_ms(500)
+    v = sph.entry_batch(["svc"], prioritized=[True])
+    assert bool(v.allow[0]) and int(v.wait_ms[0]) > 0
+    return np.asarray(sph._state.flow_dyn.occupied_count).sum()
+
+
+def test_pending_booking_survives_rule_reload(clk):
+    """A booking whose target window has not opened yet (committed via
+    the batch tier, no sleep) must survive ``load_flow_rules``: bookings
+    are ROW-keyed, so the settle pass carries pending ones into the
+    fresh FlowDynState. Admissions after the reload match an engine that
+    never reloaded."""
+    import numpy as np
+    A = make(clk)
+    B = make(ManualClock(start_ms=T0))
+    rules = [stpu.FlowRule(resource="svc", count=2)]
+    for e in (A, B):
+        e.load_flow_rules(rules)
+    booked_a = _book_pending(A)
+    booked_b = _book_pending(B)
+    assert booked_a == booked_b > 0
+    A.load_flow_rules(rules)          # reload: settle + carry
+    assert np.asarray(A._state.flow_dyn.occupied_count).sum() == booked_a, \
+        "pending booking lost across reload"
+    for e in (A, B):
+        e.clock.advance_ms(500)       # into the booked window W+2
+    # the booking consumed 1 of the 2: identical on both engines
+    assert drain(A, "svc", 3) == drain(B, "svc", 3) \
+        == ["pass", "block", "block"]
+
+
+def test_landed_booking_settles_on_rule_reload(clk):
+    """A booking whose target window is ALREADY open settles into the
+    second-window state as a PASS on reload (the rolling totals are
+    identical either way), and the fresh dyn starts without it.
+    Admissions after the reload match an engine that never reloaded."""
+    import numpy as np
+    A = make(clk)
+    B = make(ManualClock(start_ms=T0))
+    rules = [stpu.FlowRule(resource="svc", count=2)]
+    for e in (A, B):
+        e.load_flow_rules(rules)
+        _book_pending(e)
+        e.clock.advance_ms(500)       # booked window opens: LANDED
+    A.load_flow_rules(rules)
+    assert np.asarray(A._state.flow_dyn.occupied_count).sum() == 0, \
+        "landed booking should settle into window state, not carry"
+    assert drain(A, "svc", 3) == drain(B, "svc", 3) \
+        == ["pass", "block", "block"]
+
+
+def test_row_eviction_clears_bookings(clk):
+    """A recycled resource row must not inherit the evicted resource's
+    live bookings: pipeline.invalidate_resource_rows zeroes the occupy
+    ring alongside the window state."""
+    import numpy as np
+    # tiny registry so eviction is easy to force: row 0 = entry node
+    # host_fast_path off: the rule-free probe entry below must take a
+    # device decide (that is what drains the eviction queue)
+    cfg = stpu.load_config(max_resources=4, max_flow_rules=16,
+                           max_degrade_rules=16, max_authority_rules=16,
+                           host_fast_path=False)
+    sph = stpu.Sentinel(config=cfg, clock=clk)
+    sph.load_flow_rules([stpu.FlowRule(resource="svc", count=2)])
+    row = sph.resources.get_or_create("svc")
+    _book_pending(sph)
+    assert np.asarray(sph._state.flow_dyn.occupied_count)[row].sum() > 0
+    # drop the rule, release the compile-time pin (rule pins are sticky —
+    # a pinned row never recycles, so the booking-clear is defense in
+    # depth for exactly this unpinned-under-pressure path), then overflow
+    # the registry so the booked row is recycled for new resources
+    sph.load_flow_rules([])
+    sph.resources.unpin("svc")
+    for i in range(4):
+        sph.resources.get_or_create(f"fresh-{i}")
+    v = sph.entry_batch(["fresh-0"])      # any decide drains evictions
+    assert bool(v.allow[0])
+    assert np.asarray(sph._state.flow_dyn.occupied_count)[row].sum() == 0, \
+        "evicted row's bookings must be cleared"

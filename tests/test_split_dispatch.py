@@ -197,3 +197,79 @@ def test_split_preserves_breaker_observer_events(clk):
                        np.zeros(n, bool))
     assert not v.allow.any()
     assert len(seen) == n_seen      # no transition, no spurious event
+
+
+def test_split_with_prio_and_live_bookings_equals_sequential(clk):
+    """Mixed batches carrying prioritized events + live occupy bookings:
+    the split path (scalar side folds bookings via occupy_base, general
+    side books via flow_check_fast_occupy) stays bit-exact with two
+    sequential decide_raw calls on the same partition — across steps, so
+    step k's bookings shape step k+1's admissions. Also pins the r6
+    tentpole: prioritized events must NOT disable the split (the pre-r6
+    whole-batch demotion was a 16x cliff)."""
+    A = make_sentinel(clk)
+    B = make_sentinel(clk)
+    for e in (A, B):
+        e.load_flow_rules(RULES)
+        e.load_degrade_rules(DEG)
+    oids = np.array([A.origins.pin("app-a"), A.origins.pin("app-b")],
+                    np.int32)
+    assert np.array_equal(
+        oids, np.array([B.origins.pin("app-a"), B.origins.pin("app-b")],
+                       np.int32))
+    for r in ["api", "paced", "rel", "free"]:
+        A.resources.get_or_create(r)
+        B.resources.get_or_create(r)
+
+    rng = np.random.default_rng(31)
+    n = 8192
+    split_calls = []
+    orig = A._decide_split_nowait
+
+    def spy(*a, **k):
+        split_calls.append(1)
+        return orig(*a, **k)
+
+    A._decide_split_nowait = spy
+    pad_a = A.spec.alt_rows
+    saw_booking = False
+    for step in range(5):
+        raw = _mixed_raw(A, rng, n, oids, origin_frac=0.2)
+        raw["prioritized"] = rng.random(n) < 0.05
+        now = clk.now_ms()
+        vA = A.decide_raw(raw["rows"], raw["origin_ids"],
+                          raw["origin_rows"], raw["context_ids"],
+                          raw["chain_rows"], raw["acquire"], raw["is_in"],
+                          raw["prioritized"], valid=raw["valid"],
+                          at_ms=now)
+        assert len(split_calls) == step + 1, \
+            "prioritized events demoted the batch off the split path"
+        # B: the exact sub-batches the split forms (prioritized events
+        # ride the general side), as two sequential calls
+        ev_scalar = (((raw["origin_ids"] == 0)
+                      & (raw["origin_rows"] >= pad_a)
+                      & (raw["chain_rows"] >= pad_a)
+                      & ~raw["prioritized"]) | ~raw["valid"])
+        outs = {}
+        for name, idx in (("s", np.nonzero(ev_scalar)[0]),
+                          ("g", np.nonzero(~ev_scalar)[0])):
+            outs[name] = B.decide_raw(
+                raw["rows"][idx], raw["origin_ids"][idx],
+                raw["origin_rows"][idx], raw["context_ids"][idx],
+                raw["chain_rows"][idx], raw["acquire"][idx],
+                raw["is_in"][idx], raw["prioritized"][idx],
+                valid=raw["valid"][idx], at_ms=now)
+        idx_s = np.nonzero(ev_scalar)[0]
+        idx_g = np.nonzero(~ev_scalar)[0]
+        for field in ("allow", "wait_ms", "reason"):
+            assert np.array_equal(getattr(vA, field)[idx_s],
+                                  getattr(outs["s"], field)), \
+                f"scalar-side {field} diverged step {step}"
+            assert np.array_equal(getattr(vA, field)[idx_g],
+                                  getattr(outs["g"], field)), \
+                f"general-side {field} diverged step {step}"
+        _state_leaves_equal(A._state, B._state)
+        saw_booking = saw_booking or bool(
+            (np.asarray(A._state.flow_dyn.occupied_count) > 0).any())
+        clk.advance_ms(int(rng.integers(100, 400)))
+    assert saw_booking, "no occupy booking exercised — weak test"
