@@ -37,6 +37,13 @@ demotion drags the ratio to ~1.0; (ii) a binary routing probe through the
 runtime itself: a mixed 1%-prio batch must still take
 ``_decide_split_nowait`` (general_bench pre-stages its sub-batches, so only
 this probe sees the runtime's routing decision).
+
+Gate (d) — the observability-overhead gate (portable): the obs/ telemetry
+layer rides the batch hot path behind ``if obs.enabled`` checks; this gate
+times the SAME split-firing workload through two runtimes — obs enabled vs
+``SENTINEL_OBS_DISABLE=1`` — interleaved best-of-N, and bands the
+instrumented/uninstrumented step-time ratio at ``OBS_OVERHEAD_MAX`` (1.02,
+the ISSUE's ≤2% budget). Machine speed cancels in the ratio.
 """
 
 from __future__ import annotations
@@ -240,12 +247,87 @@ def check_prio_split_routing():
     return None
 
 
+# instrumented/uninstrumented wall-time band for the observability layer
+# (obs/): the spans + counters + histograms riding the batch hot path must
+# stay within 2% of SENTINEL_OBS_DISABLE=1. Measured best-of-N interleaved
+# THROUGH the runtime (entry_batch_nowait with a split-firing mixed batch)
+# — general_bench.measure() pre-stages sub-batches and drives the jitted
+# step directly, so it never executes a single instrumented line.
+OBS_OVERHEAD_MAX = 1.02
+
+
+def measure_obs_overhead() -> dict:
+    """Ratio of best entry-batch step time with obs enabled over obs
+    disabled (two otherwise-identical runtimes, the disabled one built
+    under SENTINEL_OBS_DISABLE=1). Mixed 10%-origin batches above the
+    4096-row threshold so the split path — the most-instrumented route —
+    is the one being timed."""
+    import time as _time
+
+    import numpy as np
+
+    sys.path.insert(0, str(HERE.parent))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import sentinel_tpu as stpu
+    from sentinel_tpu.obs import OBS_DISABLE_ENV
+
+    def build(disable: bool):
+        prev = os.environ.get(OBS_DISABLE_ENV)
+        if disable:
+            os.environ[OBS_DISABLE_ENV] = "1"
+        else:
+            os.environ.pop(OBS_DISABLE_ENV, None)
+        try:
+            sph = stpu.Sentinel(stpu.load_config(
+                max_resources=64, max_origins=32, max_flow_rules=32,
+                max_degrade_rules=16, max_authority_rules=16,
+                host_fast_path=False))
+        finally:
+            if prev is None:
+                os.environ.pop(OBS_DISABLE_ENV, None)
+            else:
+                os.environ[OBS_DISABLE_ENV] = prev
+        sph.load_flow_rules([
+            stpu.FlowRule(resource="api", count=1e9),
+            stpu.FlowRule(resource="api", count=1e9, limit_app="app-a"),
+        ])
+        return sph
+
+    B, STEPS, REPEATS = 8192, 6, 8
+    rng = np.random.default_rng(11)
+    resources = ["api"] * B
+    origins = ["app-a" if x else "" for x in (rng.random(B) < 0.1)]
+    pair = [("on", build(False)), ("off", build(True))]
+    assert pair[0][1].obs.enabled and not pair[1][1].obs.enabled
+    best = {}
+    for _key, sph in pair:                  # warm compiles + caches
+        for _ in range(2):
+            sph.entry_batch_nowait(resources, origins=origins).result()
+    for rep in range(REPEATS):
+        # interleaved AND order-alternated: slow drift and the
+        # first-measured-runs-warmer bias both cancel in the ratio
+        for key, sph in (pair if rep % 2 == 0 else pair[::-1]):
+            t0 = _time.perf_counter()
+            for _ in range(STEPS):
+                sph.entry_batch_nowait(resources,
+                                       origins=origins).result()
+            dt = (_time.perf_counter() - t0) / STEPS
+            best[key] = min(best.get(key, dt), dt)
+    for _key, sph in pair:
+        sph.close()
+    return {"obs_on_s_per_step": best["on"],
+            "obs_off_s_per_step": best["off"],
+            "obs_overhead_ratio": best["on"] / best["off"]}
+
+
 def main() -> int:
     best = max(measure_once() for _ in range(3))
     cal = calibrate()
     prep = measure_host_prep()
     prio = measure_prio_cliff()
     routing_err = check_prio_split_routing()
+    obs = measure_obs_overhead()
     ratios = {k.replace("_s_per_step", "_ratio"): v / cal
               for k, v in prep.items()}
     if "--update" in sys.argv:
@@ -254,9 +336,11 @@ def main() -> int:
              "measured_at_update": best,
              "machine": fingerprint(),
              "host_prep_ratios": ratios,
-             # informational: the prio band itself is fixed
-             # (PRIO_RATIO_BAND), not re-baselined per machine
+             # informational: the prio band and the obs-overhead band are
+             # fixed (PRIO_RATIO_BAND / OBS_OVERHEAD_MAX), not
+             # re-baselined per machine
              "prio_cliff": {k: round(v, 4) for k, v in prio.items()},
+             "obs_overhead": {k: round(v, 4) for k, v in obs.items()},
              "calibration_s": cal}, indent=1))
         print(f"baseline updated: floor={best / 2:.0f} (measured {best:.0f}) "
               f"on {fingerprint()}; host-prep ratios "
@@ -275,9 +359,18 @@ def main() -> int:
         "host_prep_ratios": {k: round(v, 4) for k, v in ratios.items()},
         "prio_cliff": {k: round(v, 4) for k, v in prio.items()},
         "prio_split_routing": "ok" if routing_err is None else "DEMOTED",
+        "obs_overhead": {k: round(v, 4) for k, v in obs.items()},
     }
     print(json.dumps(out))
     rc = 0
+    oratio = obs["obs_overhead_ratio"]
+    if oratio > OBS_OVERHEAD_MAX:
+        print(f"OBS-OVERHEAD REGRESSION: instrumented/uninstrumented "
+              f"step-time ratio {oratio:.4f} > {OBS_OVERHEAD_MAX} — the "
+              f"observability layer (obs/) is no longer ~free on the hot "
+              f"path; look for per-event work, device syncs, or lock "
+              f"contention added under `if obs.enabled`", file=sys.stderr)
+        rc = 1
     lo, hi = PRIO_RATIO_BAND
     pr = prio["prio_vs_general_ratio"]
     if not lo <= pr <= hi:

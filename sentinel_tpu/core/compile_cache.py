@@ -108,6 +108,15 @@ def active_cache_dir() -> Optional[str]:
 _log = logging.getLogger("sentinel_tpu.coldstart")
 
 
+def _fire_retry(on_retry) -> None:
+    if on_retry is None:
+        return
+    try:
+        on_retry()
+    except Exception:   # telemetry must never mask the fetch itself
+        _log.debug("first-fetch on_retry callback failed", exc_info=True)
+
+
 def first_fetch_policy() -> Tuple[float, int]:
     """→ ``(timeout_s, retries)`` for :func:`guarded_first_fetch`.
 
@@ -138,10 +147,14 @@ def first_fetch_policy() -> Tuple[float, int]:
     return 20.0, retries
 
 
-def guarded_first_fetch(fn, what: str, timeout_s: float, retries: int):
+def guarded_first_fetch(fn, what: str, timeout_s: float, retries: int,
+                        on_retry=None):
     """Run ``fn`` — an IDEMPOTENT first program fetch/execution — with a
     wall-clock timeout and a bounded retry budget; → the first attempt's
-    result to complete. A warning is logged every time a retry fires.
+    result to complete. A warning is logged every time a retry fires,
+    and ``on_retry`` (when given) is invoked once per fired retry — the
+    runtime hooks its ``compile_cache.first_fetch_retry`` counter here
+    (obs/counters.py); callback failures never mask the fetch.
 
     ``fn`` MUST be safe to run concurrently with a stalled copy of
     itself (throwaway inputs, no shared mutable state): a timed-out
@@ -174,6 +187,7 @@ def guarded_first_fetch(fn, what: str, timeout_s: float, retries: int):
                 "(attempt %d/%d) — retrying; a persistent-cache load or "
                 "program transfer is likely riding a transport stall",
                 what, timeout_s, attempt + 1, retries + 1)
+            _fire_retry(on_retry)
             continue
         if err is None:
             return out
@@ -184,6 +198,7 @@ def guarded_first_fetch(fn, what: str, timeout_s: float, retries: int):
             "first program fetch of %s failed (%s: %s) on attempt %d/%d "
             "— retrying", what, type(err).__name__, err, attempt + 1,
             retries + 1)
+        _fire_retry(on_retry)
     # every attempt timed out and the final blocking get was interrupted
     # by a straggler's error — surface it rather than hanging
     if last_err is not None:  # pragma: no cover - straggler-error race

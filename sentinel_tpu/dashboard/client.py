@@ -105,6 +105,17 @@ class SentinelApiClient:
     def fetch_system_status(self, ip: str, port: int) -> Dict[str, Any]:
         return json.loads(self._get(ip, port, "systemStatus") or "{}")
 
+    def fetch_obs(self, ip: str, port: int,
+                  spans: int = 128, events: int = 64,
+                  trace: str = "") -> Dict[str, Any]:
+        """Runtime self-telemetry snapshot (``obs`` command): counters,
+        latency histograms, recent spans/block events; optionally one
+        trace's full span chain."""
+        params = {"spans": str(spans), "events": str(events)}
+        if trace:
+            params["trace"] = trace
+        return json.loads(self._get(ip, port, "obs", params) or "{}")
+
     def get_cluster_mode(self, ip: str, port: int) -> Dict[str, Any]:
         return json.loads(self._get(ip, port, "getClusterMode") or "{}")
 

@@ -27,6 +27,7 @@ class MachineInfo:
     version: str = ""               # agent framework version
     heartbeat_version: int = 0      # agent-side timestamp from the beat
     last_heartbeat_ms: int = 0      # dashboard-side receive time
+    exporter_port: int = 0          # Prometheus scrape port; 0 = none
 
     def key(self) -> str:
         return f"{self.ip}:{self.port}"
@@ -43,6 +44,7 @@ class MachineInfo:
             "version": self.version,
             "heartbeatVersion": self.heartbeat_version,
             "lastHeartbeat": self.last_heartbeat_ms,
+            "exporterPort": self.exporter_port,
             "healthy": self.healthy(now_ms),
         }
 

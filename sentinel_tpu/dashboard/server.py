@@ -108,7 +108,8 @@ class Dashboard:
             app_type=int(params.get("app_type", "0") or 0),
             version=params.get("v", ""),
             heartbeat_version=int(params.get("version", "0") or 0),
-            last_heartbeat_ms=self._now_ms())
+            last_heartbeat_ms=self._now_ms(),
+            exporter_port=int(params.get("exporterPort", "0") or 0))
         self.apps.register(m)
         return _ok("success")
 
@@ -487,6 +488,16 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 self._json(_ok(d.client.fetch_system_status(
                     q.get("ip", ""), int(q.get("port", "0") or 0))))
+            except AgentUnreachable as exc:
+                self._json(_fail(str(exc)))
+            return
+        if method == "GET" and path == "/obs/telemetry.json":
+            try:
+                self._json(_ok(d.client.fetch_obs(
+                    q.get("ip", ""), int(q.get("port", "0") or 0),
+                    spans=int(q.get("spans", "128") or 128),
+                    events=int(q.get("events", "64") or 64),
+                    trace=q.get("trace", ""))))
             except AgentUnreachable as exc:
                 self._json(_fail(str(exc)))
             return

@@ -83,7 +83,7 @@ const RULE_VIEWS = ["flow", "degrade", "paramFlow", "system", "authority",
 const VIEW_TITLES = {
   metrics: "Realtime Metrics", resources: "Resource View",
   machines: "Machine List", cluster: "Cluster Management",
-  tree: "Node Tree",
+  tree: "Node Tree", telemetry: "Runtime Telemetry",
   flow: "Flow Rules", degrade: "Degrade Rules", paramFlow: "Param Flow Rules",
   system: "System Rules", authority: "Authority Rules",
   gatewayFlow: "Gateway Flow Rules", gatewayApi: "API Definitions",
@@ -127,7 +127,7 @@ function renderSidebar() {
     return;
   }
   const menu = [["metrics", "Realtime Metrics"], ["resources", "Resource View"],
-                ["tree", "Node Tree"],
+                ["tree", "Node Tree"], ["telemetry", "Telemetry"],
                 ["machines", "Machine List"], ["cluster", "Cluster"]];
   navEl.appendChild(h("h4", {}, "Monitor"));
   for (const [v, label] of menu) {
@@ -155,6 +155,7 @@ function render() {
   if (S.view === "machines") return viewMachines(c);
   if (S.view === "cluster") return viewCluster(c);
   if (S.view === "tree") return viewTree(c);
+  if (S.view === "telemetry") return viewTelemetry(c);
   return viewRules(c, S.view);
 }
 
@@ -385,6 +386,139 @@ async function viewMachines(c) {
       tbody.appendChild(h("tr", {}, h("td", { colspan: 6, class: "dim" },
         "no machines")));
     }
+  }
+  await refresh();
+  setRefresh(refresh, 5000);
+}
+
+// ------------------------------------------------------------------ telemetry
+// Runtime self-telemetry (agent `obs` command → /obs/telemetry.json):
+// decision counters, latency histograms, recent spans + block events
+// (docs/OBSERVABILITY.md).
+async function viewTelemetry(c) {
+  await loadMachines();
+  const sel = machineSelector(() => refresh());
+  const body = h("div", {});
+  c.appendChild(h("div", { class: "card" }, [
+    h("h3", {}, [h("span", {}, `Runtime Telemetry — ${S.app}`),
+                 h("span", { class: "toolbar" }, [
+                   h("span", { class: "sub" }, "machine"), sel])]),
+    body,
+  ]));
+  const fmtMs = (v) => v == null ? "—" : Number(v).toFixed(3);
+  const fmtUs = (ns) => (ns / 1000).toFixed(1) + " µs";
+  function histRows(label, s) {
+    if (!s) return null;
+    return h("tr", {}, [
+      h("td", {}, label),
+      h("td", { class: "num" }, String(s.count ?? 0)),
+      h("td", { class: "num" }, fmtMs(s.p50_ms)),
+      h("td", { class: "num" }, fmtMs(s.p95_ms)),
+      h("td", { class: "num" }, fmtMs(s.p99_ms)),
+      h("td", { class: "num" },
+        s.max_ns != null ? fmtMs(s.max_ns / 1e6) : "—"),
+    ]);
+  }
+  function counterTable(title, sub, rows) {
+    return h("div", { class: "card" }, [
+      h("h3", {}, [h("span", {}, title),
+                   h("span", { class: "sub" }, sub)]),
+      rows.length
+        ? h("table", {}, [h("thead", {}, h("tr", {},
+            ["counter", "count"].map(t => h("th", {}, t)))),
+            h("tbody", {}, rows.map(([k, v]) => h("tr", {}, [
+              h("td", {}, k),
+              h("td", { class: "num" }, String(v))])))])
+        : h("span", { class: "dim" }, "no events yet"),
+    ]);
+  }
+  async function refresh() {
+    if (!S.machineSel) {
+      body.innerHTML = "";
+      body.appendChild(h("span", { class: "dim" }, "no healthy machine"));
+      return;
+    }
+    const [ip, port] = S.machineSel.split(":");
+    const j = await api(`/obs/telemetry.json?ip=${ip}&port=${port}`);
+    body.innerHTML = "";
+    if (!j || !j.success) {
+      body.appendChild(h("span", { class: "bad" }, j ? j.msg : "error"));
+      return;
+    }
+    const d = j.data || {};
+    if (!d.enabled) {
+      body.appendChild(h("span", { class: "dim" },
+        "observability disabled on this agent (SENTINEL_OBS_DISABLE)"));
+      return;
+    }
+    body.appendChild(h("span", { class: "sub" },
+      `sampling 1/${Math.max(1, Math.round(1 / (d.sample || 1)))} · ` +
+      `host threads elided: ${d.threadsElided ? "yes" : "no"}`));
+    const hist = d.hist || {};
+    body.appendChild(h("div", { class: "card" }, [
+      h("h3", {}, [h("span", {}, "Latency"),
+        h("span", { class: "sub" },
+          "log-bucketed histograms (obs/hist.py) — ms")]),
+      h("table", {}, [h("thead", {}, h("tr", {},
+        ["stage", "count", "p50", "p95", "p99", "max"].map(t =>
+          h("th", {}, t)))),
+        h("tbody", {}, [
+          histRows("entry → verdict", hist.entry_to_verdict),
+          histRows("dispatch device time", hist.dispatch_device),
+        ])]),
+    ]));
+    const counts = d.counters || {};
+    const groups = { "split_route.": [], "compile_cache.": [],
+                     "occupy.": [], "block_reason.": [] };
+    for (const k of Object.keys(counts).sort()) {
+      for (const p of Object.keys(groups)) {
+        if (k.startsWith(p)) groups[p].push([k.slice(p.length), counts[k]]);
+      }
+    }
+    body.appendChild(counterTable("Split routing",
+      "dispatch-path decisions per batch", groups["split_route."]));
+    body.appendChild(counterTable("Compile cache",
+      "decide-program fetch hits/misses/retries", groups["compile_cache."]));
+    body.appendChild(counterTable("Occupy bookings",
+      "priority occupy lifecycle", groups["occupy."]));
+    body.appendChild(counterTable("Block reasons",
+      "denials by verdict code name", groups["block_reason."]));
+    const spans = d.spans || [];
+    body.appendChild(h("div", { class: "card" }, [
+      h("h3", {}, [h("span", {}, "Recent spans"),
+        h("span", { class: "sub" },
+          "sampled batch-lifecycle traces (newest last)")]),
+      spans.length
+        ? h("table", {}, [h("thead", {}, h("tr", {},
+            ["trace", "span", "duration", "rows", "note"].map(t =>
+              h("th", {}, t)))),
+            h("tbody", {}, spans.slice(-40).map(s => h("tr", {}, [
+              h("td", { class: "num" }, String(s.trace)),
+              h("td", {}, s.name),
+              h("td", { class: "num" }, fmtUs(s.dur_ns)),
+              h("td", { class: "num" }, String(s.n || "")),
+              h("td", { class: "dim" }, s.note || ""),
+            ])))])
+        : h("span", { class: "dim" }, "no sampled spans yet"),
+    ]));
+    const evs = d.block_events || [];
+    body.appendChild(h("div", { class: "card" }, [
+      h("h3", {}, [h("span", {}, "Recent block events"),
+        h("span", { class: "sub" },
+          "sampled denial records (obs/eventlog.py)")]),
+      evs.length
+        ? h("table", {}, [h("thead", {}, h("tr", {},
+            ["time", "resource", "origin", "reason", "count"].map(t =>
+              h("th", {}, t)))),
+            h("tbody", {}, evs.map(e => h("tr", {}, [
+              h("td", {}, new Date(e.ms).toTimeString().slice(0, 8)),
+              h("td", {}, e.resource),
+              h("td", {}, e.origin || "—"),
+              h("td", {}, e.reason_name || String(e.reason)),
+              h("td", { class: "num" }, String(e.count)),
+            ])))])
+        : h("span", { class: "dim" }, "no sampled block events yet"),
+    ]));
   }
   await refresh();
   setRefresh(refresh, 5000);

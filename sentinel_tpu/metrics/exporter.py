@@ -14,6 +14,18 @@ thread, no per-resource device round-trips. Exposes::
     sentinel_avg_rt_ms{resource=...}
     sentinel_concurrency{resource=...}     live thread/inflight count
     sentinel_breaker_state{resource=...}   0 closed / 1 open / 2 half-open
+
+Self-telemetry families (from ``Sentinel.obs`` — obs/; absent while
+``SENTINEL_OBS_DISABLE`` is set)::
+
+    sentinel_rt_p99_ms                     entry→verdict p99 (batch tier)
+    sentinel_rt_quantile_ms{quantile=...}  p50 / p95 / p99 of the same
+    sentinel_split_route_total{route=...}  dispatch-path decisions
+    sentinel_compile_cache_hits_total      program-fetch cache hits
+    sentinel_compile_cache_misses_total
+    sentinel_compile_cache_first_fetch_retries_total
+    sentinel_block_reason_total{reason=...} denials by verdict code name
+    sentinel_occupy_bookings_total{event=...} granted/carried/settled/evicted
 """
 
 from __future__ import annotations
@@ -21,7 +33,7 @@ from __future__ import annotations
 from typing import Optional
 
 from prometheus_client import start_http_server
-from prometheus_client.core import GaugeMetricFamily
+from prometheus_client.core import CounterMetricFamily, GaugeMetricFamily
 from prometheus_client.registry import REGISTRY
 
 
@@ -53,6 +65,66 @@ class SentinelCollector:
             f"{ns}_breaker_state",
             "Circuit state: 0 closed, 1 open, 2 half-open",
             labels=["resource"])
+        yield from self._obs_families(describe_only=True)
+
+    def _obs_families(self, describe_only: bool = False):
+        """Self-telemetry families (host-side reads only — no device
+        work, so scrapes stay cheap even under SENTINEL_OBS_DISABLE)."""
+        ns = self.namespace
+        obs = getattr(self.sentinel, "obs", None)
+        p99 = GaugeMetricFamily(
+            f"{ns}_rt_p99_ms",
+            "p99 entry→verdict latency over the batch tier (ms)")
+        quant = GaugeMetricFamily(
+            f"{ns}_rt_quantile_ms",
+            "entry→verdict latency quantiles (ms)", labels=["quantile"])
+        route = CounterMetricFamily(
+            f"{ns}_split_route",
+            "Dispatch-path decisions by route", labels=["route"])
+        hits = CounterMetricFamily(
+            f"{ns}_compile_cache_hits",
+            "Decide-program fetch cache hits")
+        misses = CounterMetricFamily(
+            f"{ns}_compile_cache_misses",
+            "Decide-program fetch cache misses (first dispatches)")
+        retries = CounterMetricFamily(
+            f"{ns}_compile_cache_first_fetch_retries",
+            "Guarded first-fetch stall retries")
+        blocks = CounterMetricFamily(
+            f"{ns}_block_reason",
+            "Denials by verdict reason name", labels=["reason"])
+        occupy = CounterMetricFamily(
+            f"{ns}_occupy_bookings",
+            "Priority occupy booking lifecycle events", labels=["event"])
+        if not describe_only and obs is not None and obs.enabled:
+            from sentinel_tpu.obs import counters as ck
+            counts = obs.counters.snapshot()
+            v99 = obs.hist_entry.percentile_ms(0.99)
+            if v99 is not None:
+                p99.add_metric([], v99)
+            for q in (0.50, 0.95, 0.99):
+                v = obs.hist_entry.percentile_ms(q)
+                if v is not None:
+                    quant.add_metric([f"{q:g}"], v)
+            for key, fam_key in ((ck.ROUTE_SCALAR, "scalar"),
+                                 (ck.ROUTE_FAST, "fast"),
+                                 (ck.ROUTE_FAST_OCCUPY, "fast_occupy"),
+                                 (ck.ROUTE_GENERAL, "general_sorted"),
+                                 (ck.ROUTE_SPLIT, "split_fired")):
+                route.add_metric([fam_key], counts.get(key, 0))
+            hits.add_metric([], counts.get(ck.CACHE_HIT, 0))
+            misses.add_metric([], counts.get(ck.CACHE_MISS, 0))
+            retries.add_metric([], counts.get(ck.CACHE_RETRY, 0))
+            for key, v in sorted(counts.items()):
+                if key.startswith(ck.BLOCK_PREFIX):
+                    blocks.add_metric([key[len(ck.BLOCK_PREFIX):]], v)
+            for key, ev in ((ck.OCCUPY_GRANTED, "granted"),
+                            (ck.OCCUPY_CARRIED, "carried"),
+                            (ck.OCCUPY_SETTLED, "settled"),
+                            (ck.OCCUPY_EVICTED, "evicted")):
+                occupy.add_metric([ev], counts.get(key, 0))
+        yield from (p99, quant, route, hits, misses, retries, blocks,
+                    occupy)
 
     def collect(self):
         ns = self.namespace
@@ -81,6 +153,7 @@ class SentinelCollector:
             breaker.add_metric([res], float(state))
         yield from gauges.values()
         yield breaker
+        yield from self._obs_families()
 
 
 class PrometheusExporter:
@@ -93,6 +166,11 @@ class PrometheusExporter:
         self.registry = registry
         self._server = None
         registry.register(self.collector)
+        # Sentinel.close() then unregisters the collector and releases
+        # the listener — no leaked registration across open/close cycles
+        reg = getattr(sentinel, "register_shutdown", None)
+        if reg is not None:
+            reg(self)
 
     def serve(self, port: int = 9464, addr: str = "0.0.0.0") -> None:
         self._server, _ = start_http_server(

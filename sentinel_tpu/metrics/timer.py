@@ -31,6 +31,11 @@ class MetricTimerListener:
         self._last_written_sec = sentinel.clock.now_ms() // 1000 - 1
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Sentinel.close() stops this daemon (idempotently — stop() is
+        # re-callable): no metric-timer thread leak across open/close
+        reg = getattr(sentinel, "register_shutdown", None)
+        if reg is not None:
+            reg(self)
 
     def tick(self) -> int:
         """Aggregate every completed-but-unwritten second up to now; → number
@@ -51,6 +56,11 @@ class MetricTimerListener:
         check = getattr(self._sentinel, "check_breaker_transitions", None)
         if check is not None:
             check()
+        # ... and the block-event log flush (obs/eventlog.py buffers
+        # sampled denial records; this is their 1 s drain to disk)
+        obs = getattr(self._sentinel, "obs", None)
+        if obs is not None:
+            obs.flush()
         return written
 
     def start(self) -> None:

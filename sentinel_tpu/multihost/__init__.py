@@ -16,7 +16,9 @@ Pieces:
 * :mod:`~sentinel_tpu.multihost.ingest` — host-local batch ingestion
   driving :meth:`ClusterEngine.step_routed` collectively;
 * :mod:`~sentinel_tpu.multihost.launch` — N-process CPU-mesh spawner so
-  all of the above is testable in CI without TPUs.
+  all of the above is testable in CI without TPUs;
+* :mod:`~sentinel_tpu.multihost.obs_agg` — collective allgather + sum of
+  each process's telemetry counters (obs/) at the coordinator.
 """
 
 from sentinel_tpu.multihost.bootstrap import (
@@ -25,9 +27,12 @@ from sentinel_tpu.multihost.bootstrap import (
 from sentinel_tpu.multihost.ingest import MultihostIngest
 from sentinel_tpu.multihost.launch import LaunchError, free_port, launch
 from sentinel_tpu.multihost import mesh
+from sentinel_tpu.multihost.obs_agg import (
+    aggregate_counters, coordinator_report,
+)
 
 __all__ = [
     "MultihostConfig", "MultihostRuntime", "MultihostIngest",
-    "LaunchError", "active_runtime", "free_port", "initialize", "launch",
-    "mesh",
+    "LaunchError", "active_runtime", "aggregate_counters",
+    "coordinator_report", "free_port", "initialize", "launch", "mesh",
 ]

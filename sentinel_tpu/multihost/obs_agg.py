@@ -1,0 +1,80 @@
+"""Cluster-wide aggregation of per-process telemetry counters.
+
+Each process owns its own ``Sentinel.obs`` (obs/ — per-process
+:class:`~sentinel_tpu.obs.counters.CounterSet`, spans, histograms); only
+the COUNTERS have a fleet-meaningful sum, and summing them is a pure
+reduction over a fixed-order integer vector
+(:func:`~sentinel_tpu.obs.counters.catalog_vector`: the append-only
+``CATALOG`` wire format, so processes on different code revisions still
+line up on the shared prefix). The collective is one
+``process_allgather`` of that ``int64[len(CATALOG)]`` vector — every
+process learns every other process's counts, the coordinator (or anyone)
+renders totals. With one process (tests, reference jobs) the allgather
+degenerates to an identity reshape, so the same code path runs 1-process
+and N-process unchanged.
+
+This is a COLLECTIVE: every process in the mesh must call
+:func:`aggregate_counters` the same number of times, in the same order
+relative to other collectives (the multihost SPMD rule — see
+multihost/ingest.py). Never call it from only the coordinator.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from sentinel_tpu.obs import counters as obs_counters
+
+
+def local_counter_vector(sentinel) -> np.ndarray:
+    """This process's counters in the fixed ``CATALOG`` order
+    (``int64[len(CATALOG)]``)."""
+    obs = getattr(sentinel, "obs", None)
+    counts = {} if obs is None else obs.counters.snapshot()
+    return obs_counters.catalog_vector(counts)
+
+
+def aggregate_counters(sentinel) -> Dict[str, object]:
+    """Allgather + sum every process's counter vector (collective —
+    call on ALL processes).
+
+    Returns ``{"process_count", "process_index", "per_process":
+    [counts...], "total": counts}`` where each ``counts`` is a
+    ``{catalog key: int}`` dict (zero entries elided, matching
+    ``CounterSet.snapshot``).
+    """
+    import jax
+
+    local = local_counter_vector(sentinel)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        gathered = np.asarray(
+            multihost_utils.process_allgather(local, tiled=False))
+    else:
+        gathered = local[None, :]
+    gathered = gathered.reshape(-1, local.shape[0])
+    per_process: List[Dict[str, int]] = [
+        obs_counters.vector_counts(row) for row in gathered]
+    total = obs_counters.vector_counts(gathered.sum(axis=0))
+    return {
+        "process_count": int(gathered.shape[0]),
+        "process_index": int(jax.process_index()),
+        "per_process": per_process,
+        "total": total,
+    }
+
+
+def coordinator_report(sentinel, runtime=None) -> Optional[Dict[str, object]]:
+    """:func:`aggregate_counters` (still collective — every process calls
+    this), but only the coordinator gets the report back; workers get
+    ``None``. ``runtime`` is an optional
+    :class:`~sentinel_tpu.multihost.bootstrap.MultihostRuntime` — without
+    it, coordinator-ness falls back to ``jax.process_index() == 0``."""
+    agg = aggregate_counters(sentinel)
+    if runtime is not None:
+        is_coord = runtime.is_coordinator
+    else:
+        is_coord = agg["process_index"] == 0
+    return agg if is_coord else None

@@ -1,0 +1,138 @@
+"""Runtime self-telemetry: spans, decision counters, latency histograms,
+block-event log (docs/OBSERVABILITY.md).
+
+The runtime owns one :class:`RuntimeObs` (``Sentinel.obs``) and guards
+every instrumentation site with its single ``enabled`` flag, so the hot
+path pays one attribute check when observability is off and stays within
+2% of the uninstrumented headline when it is on (the ``obs_overhead``
+gate in benchmarks/ci_gate.py). Everything here is host-side: no device
+work, no background threads — :meth:`RuntimeObs.close` is a pure state
+transition and is called by ``Sentinel.close()``.
+
+Env knobs (read at ``RuntimeObs`` construction):
+
+* ``SENTINEL_OBS_DISABLE`` — ``1``/``true`` turns all self-telemetry
+  off (spans, counters, histograms, block events);
+* ``SENTINEL_TRACE_SAMPLE`` — span/block-event sampling rate in
+  ``[0, 1]`` (default 1.0 = every dispatch eligible; rendered as a
+  deterministic stride, see obs/spans.py).
+
+Surfaces: the Prometheus collector (metrics/exporter.py), the ``obs``
+transport command (transport/handlers.py), the dashboard
+``/obs/telemetry.json`` endpoint + panel, and —  multihost — the
+coordinator-side counter aggregation in multihost/obs_agg.py.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Dict, Optional
+
+from sentinel_tpu.obs import counters as counters_mod
+from sentinel_tpu.obs.counters import CounterSet
+from sentinel_tpu.obs.eventlog import BlockEventLog
+from sentinel_tpu.obs.hist import LogHistogram, bucket_bounds_ns
+from sentinel_tpu.obs.spans import SpanRecorder
+
+OBS_DISABLE_ENV = "SENTINEL_OBS_DISABLE"
+TRACE_SAMPLE_ENV = "SENTINEL_TRACE_SAMPLE"
+
+_NULL_CTX = contextlib.nullcontext()
+
+
+def obs_disabled() -> bool:
+    return os.environ.get(OBS_DISABLE_ENV, "").lower() in (
+        "1", "true", "on", "yes")
+
+
+def trace_sample_rate() -> float:
+    raw = os.environ.get(TRACE_SAMPLE_ENV, "")
+    if not raw:
+        return 1.0
+    try:
+        return min(1.0, max(0.0, float(raw)))
+    except ValueError:
+        return 1.0
+
+
+def trace_annotation(name: str):
+    """A ``jax.profiler.TraceAnnotation`` context (names the enclosed
+    dispatch in profiler/XProf timelines), or a no-op context when the
+    profiler surface is unavailable."""
+    try:
+        from jax.profiler import TraceAnnotation
+        return TraceAnnotation(name)
+    except Exception:   # pragma: no cover - profiler-less jax build
+        return _NULL_CTX
+
+
+class RuntimeObs:
+    """The per-``Sentinel`` telemetry bundle.
+
+    Attributes the runtime's instrumentation sites touch directly:
+    ``enabled`` (the one hot-path guard), ``spans``, ``counters``,
+    ``hist_entry`` (entry→verdict ns), ``hist_dispatch``
+    (dispatch→verdict-ready device ns), ``block_events``."""
+
+    def __init__(self, clock=None, enabled: Optional[bool] = None,
+                 sample: Optional[float] = None) -> None:
+        if sample is None:
+            sample = trace_sample_rate()
+        self.enabled = (not obs_disabled()) if enabled is None else enabled
+        self.sample = sample
+        self.counters = CounterSet()
+        self.spans = SpanRecorder.for_clock(clock, sample=sample)
+        self.hist_entry = LogHistogram()
+        self.hist_dispatch = LogHistogram()
+        self.block_events = BlockEventLog(sample=sample)
+        self._closed = False
+
+    # ---- hot-path helpers -------------------------------------------
+
+    def annotate(self, name: str):
+        """Profiler trace annotation for a jitted step — a shared no-op
+        context when disabled (one truthiness check, no allocation)."""
+        if not self.enabled:
+            return _NULL_CTX
+        return trace_annotation(name)
+
+    # ---- export surface ---------------------------------------------
+
+    def payload(self, span_limit: int = 256,
+                event_limit: int = 64) -> Dict:
+        """The ``obs`` transport command / dashboard JSON body."""
+        return {
+            "enabled": self.enabled,
+            "sample": self.sample,
+            "counters": self.counters.snapshot(),
+            "hist": {
+                "entry_to_verdict": self.hist_entry.snapshot(),
+                "dispatch_device": self.hist_dispatch.snapshot(),
+                "bucket_bounds_ns": bucket_bounds_ns(),
+            },
+            "spans": self.spans.snapshot(limit=span_limit),
+            "block_events": self.block_events.snapshot(limit=event_limit),
+        }
+
+    def flush(self) -> int:
+        """Flush buffered block events to their writer (ridden by the
+        metric timer's tick and by close)."""
+        return self.block_events.flush()
+
+    def close(self) -> None:
+        """Idempotent teardown: disable, drop span rings, flush + close
+        the block-event writer. Safe across repeated open/close."""
+        if self._closed:
+            return
+        self._closed = True
+        self.enabled = False
+        self.spans.close()
+        self.block_events.close()
+
+
+__all__ = [
+    "OBS_DISABLE_ENV", "TRACE_SAMPLE_ENV", "RuntimeObs", "CounterSet",
+    "LogHistogram", "SpanRecorder", "BlockEventLog", "obs_disabled",
+    "trace_sample_rate", "trace_annotation", "counters_mod",
+]

@@ -1,0 +1,113 @@
+"""Decision counters — the runtime's own "why did that happen" tallies.
+
+One flat monotonically-increasing integer per named decision outcome,
+mutated per BATCH (not per event) on the hot path so the instrumented
+dispatch stays within the 2% observability budget (benchmarks/ci_gate.py
+``obs_overhead`` gate). Families:
+
+* ``split_route.*`` — which dispatch path a batch took
+  (:meth:`~sentinel_tpu.runtime.Sentinel.decide_raw_nowait` path
+  selection): ``scalar`` / ``fast`` / ``fast_occupy`` /
+  ``general_sorted``, plus ``split_fired`` when a mixed batch was
+  per-event split (``_decide_split_nowait``).
+* ``compile_cache.*`` — first-dispatch program accounting per (variant,
+  geometry, statics) combo: ``hit`` / ``miss`` /
+  ``first_fetch_retry`` (the guarded-fetch stall retries).
+* ``occupy.*`` — priority booking lifecycle: ``granted`` (PriorityWait
+  admissions), ``carried`` / ``settled`` (bookings surviving /
+  landing at rule reload), ``evicted`` (cleared by row eviction).
+* ``block_reason.<ExceptionName>`` — per-reason denial breakdown keyed
+  by the int8 verdict codes (``exception_name_for`` /
+  ``slot_name_for_code`` for custom slots).
+
+:data:`CATALOG` is the fixed, ordered multihost-aggregatable key set:
+every process packs its snapshot into one int64 vector
+(:func:`catalog_vector`) for a single ``process_allgather``
+(multihost/obs_agg.py) — dynamic keys (custom-slot block reasons)
+aggregate only through the transport surface.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Mapping
+
+ROUTE_SCALAR = "split_route.scalar"
+ROUTE_FAST = "split_route.fast"
+ROUTE_FAST_OCCUPY = "split_route.fast_occupy"
+ROUTE_GENERAL = "split_route.general_sorted"
+ROUTE_SPLIT = "split_route.split_fired"
+
+CACHE_HIT = "compile_cache.hit"
+CACHE_MISS = "compile_cache.miss"
+CACHE_RETRY = "compile_cache.first_fetch_retry"
+
+OCCUPY_GRANTED = "occupy.granted"
+OCCUPY_CARRIED = "occupy.carried"
+OCCUPY_SETTLED = "occupy.settled"
+OCCUPY_EVICTED = "occupy.evicted"
+
+BLOCK_PREFIX = "block_reason."
+
+#: Fixed aggregation catalog (order is the wire format of the multihost
+#: counter vector — append only, never reorder).
+CATALOG = (
+    ROUTE_SCALAR, ROUTE_FAST, ROUTE_FAST_OCCUPY, ROUTE_GENERAL, ROUTE_SPLIT,
+    CACHE_HIT, CACHE_MISS, CACHE_RETRY,
+    OCCUPY_GRANTED, OCCUPY_CARRIED, OCCUPY_SETTLED, OCCUPY_EVICTED,
+    BLOCK_PREFIX + "FlowException",
+    BLOCK_PREFIX + "DegradeException",
+    BLOCK_PREFIX + "SystemBlockException",
+    BLOCK_PREFIX + "AuthorityException",
+    BLOCK_PREFIX + "ParamFlowException",
+)
+
+
+class CounterSet:
+    """Locked flat dict of monotonic counters.
+
+    One uncontended ``lock + dict.get + add`` per increment; increments
+    happen once per batch on the dispatch path, so the cost is amortized
+    over thousands of events."""
+
+    __slots__ = ("_lock", "_c")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._c: Dict[str, int] = {}
+
+    def add(self, key: str, n: int = 1) -> None:
+        if n == 0:
+            return
+        with self._lock:
+            self._c[key] = self._c.get(key, 0) + int(n)
+
+    def get(self, key: str) -> int:
+        with self._lock:
+            return self._c.get(key, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._c)
+
+    def merge(self, counts: Mapping[str, int]) -> None:
+        """Fold another snapshot in (multihost coordinator aggregation)."""
+        with self._lock:
+            for k, v in counts.items():
+                self._c[k] = self._c.get(k, 0) + int(v)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._c.clear()
+
+
+def catalog_vector(counts: Mapping[str, int]):
+    """Snapshot → int64 vector over :data:`CATALOG` (allgather payload)."""
+    import numpy as np
+    return np.asarray([int(counts.get(k, 0)) for k in CATALOG], np.int64)
+
+
+def vector_counts(vec) -> Dict[str, int]:
+    """Inverse of :func:`catalog_vector` (tolerates longer vectors from a
+    newer peer — extra trailing entries are unknown keys and dropped)."""
+    return {k: int(vec[i]) for i, k in enumerate(CATALOG) if i < len(vec)}

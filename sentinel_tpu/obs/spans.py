@@ -1,0 +1,155 @@
+"""Lock-free per-thread ring-buffer span recorder (Dapper-style sampled
+tracing of the batch lifecycle).
+
+Every dispatching thread appends finished spans into its OWN fixed-size
+ring — appends are plain ``list.append`` / index stores (GIL-atomic), no
+lock is ever taken on the record path; the registry lock is touched once
+per thread lifetime when its ring is created. ``snapshot()`` merges the
+rings from any thread; a concurrently-wrapping ring can tear a snapshot
+by one span, which is the documented price of lock-freedom.
+
+Sampling is deterministic: rate ``p`` becomes a stride ``round(1/p)`` and
+every stride-th ``maybe_trace()`` call opens a trace (trace id > 0); the
+runtime threads that id through the batch's lifecycle so a sampled batch
+records its FULL chain (entry → host gates → split decision →
+compile-cache lookup → device dispatch → settle/exit) and an unsampled
+batch records nothing. With the recorder disabled the runtime's
+instrumentation sites reduce to one attribute check.
+
+Timestamps are integer nanoseconds. Under a real clock they come from
+``time.perf_counter_ns``; under the test suite's manual/virtual clock
+(anything exposing ``set_ms`` — core/clock.ManualClock) they derive from
+``clock.now_ms() * 1e6`` so span durations follow virtual time exactly
+(:func:`SpanRecorder.for_clock`).
+
+Span schema (``snapshot()`` dicts — docs/OBSERVABILITY.md):
+``trace`` (sampled trace id), ``name``, ``start_ns``, ``end_ns``,
+``dur_ns``, ``thread`` (ident), ``n`` (event count the span covered),
+``note`` (free-form: route taken, sub-batch sizes, ...).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional
+
+DEFAULT_CAPACITY = 2048
+
+
+class _Ring:
+    __slots__ = ("buf", "idx")
+
+    def __init__(self) -> None:
+        self.buf: list = []
+        self.idx = 0
+
+
+class SpanRecorder:
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 sample: float = 1.0, time_ns=None) -> None:
+        self.capacity = max(16, int(capacity))
+        # rate → stride: 1.0 records every trace, 0.01 every 100th, ≤0 none
+        self._stride = 0 if sample <= 0 else max(1, round(1.0 / sample))
+        self.sample = 0.0 if sample <= 0 else 1.0 / self._stride
+        self._time_ns = time_ns or time.perf_counter_ns
+        self._dispatch_seq = itertools.count()   # sampling stride counter
+        self._trace_seq = itertools.count(1)     # issued trace ids
+        self._tls = threading.local()
+        self._rings: List[_Ring] = []
+        self._rings_lock = threading.Lock()
+        self.enabled = True
+
+    @staticmethod
+    def for_clock(clock, capacity: int = DEFAULT_CAPACITY,
+                  sample: float = 1.0) -> "SpanRecorder":
+        """Recorder whose ns timestamps ride a manual/virtual clock when
+        one is installed (tests), the monotonic clock otherwise."""
+        tfn = None
+        if clock is not None and hasattr(clock, "set_ms"):
+            tfn = lambda: int(clock.now_ms()) * 1_000_000   # noqa: E731
+        return SpanRecorder(capacity=capacity, sample=sample, time_ns=tfn)
+
+    # ---- hot path ----------------------------------------------------
+
+    def now_ns(self) -> int:
+        return self._time_ns()
+
+    def maybe_trace(self) -> int:
+        """→ a fresh trace id when this dispatch is sampled, else 0."""
+        if not self.enabled or self._stride == 0:
+            return 0
+        if next(self._dispatch_seq) % self._stride:
+            return 0
+        return next(self._trace_seq)
+
+    def record(self, trace_id: int, name: str, start_ns: int, end_ns: int,
+               n: int = 0, note: str = "") -> None:
+        if not trace_id or not self.enabled:
+            return
+        try:
+            ring = self._tls.ring
+        except AttributeError:
+            ring = _Ring()
+            self._tls.ring = ring
+            with self._rings_lock:
+                self._rings.append(ring)
+        entry = (trace_id, name, int(start_ns), int(end_ns),
+                 threading.get_ident(), int(n), note)
+        if len(ring.buf) < self.capacity:
+            ring.buf.append(entry)
+        else:
+            ring.buf[ring.idx % self.capacity] = entry
+        ring.idx += 1
+
+    # ---- read side ---------------------------------------------------
+
+    def snapshot(self, limit: Optional[int] = None,
+                 trace_id: Optional[int] = None) -> List[Dict]:
+        with self._rings_lock:
+            rings = list(self._rings)
+        spans = []
+        for ring in rings:
+            spans.extend(list(ring.buf))   # atomic-enough copy (see module)
+        if trace_id is not None:
+            spans = [s for s in spans if s[0] == trace_id]
+        spans.sort(key=lambda s: (s[0], s[2]))
+        if limit is not None and len(spans) > limit:
+            spans = spans[-limit:]
+        return [{"trace": s[0], "name": s[1], "start_ns": s[2],
+                 "end_ns": s[3], "dur_ns": s[3] - s[2], "thread": s[4],
+                 "n": s[5], "note": s[6]} for s in spans]
+
+    def chain(self, trace_id: int) -> List[Dict]:
+        """All spans of one sampled trace, start-ordered (the demo's
+        "full span chain" view)."""
+        return self.snapshot(trace_id=trace_id)
+
+    def last_trace_id(self) -> int:
+        """Highest trace id with at least one recorded span (0 if none)."""
+        with self._rings_lock:
+            rings = list(self._rings)
+        best = 0
+        for ring in rings:
+            for s in list(ring.buf):
+                if s[0] > best:
+                    best = s[0]
+        return best
+
+    def clear(self) -> None:
+        with self._rings_lock:
+            rings = list(self._rings)
+            self._rings = []
+        for ring in rings:
+            ring.buf = []
+            ring.idx = 0
+        # threads still holding a cleared ring re-register on next record
+        self._tls = threading.local()
+
+    def close(self) -> None:
+        """Idempotent: disable recording and drop the rings. The recorder
+        owns no thread, so close is purely a state transition (safe to
+        call from Sentinel.close() repeatedly)."""
+        self.enabled = False
+        self.clear()

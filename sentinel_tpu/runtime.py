@@ -61,6 +61,8 @@ from sentinel_tpu.rules import param_flow as pf_mod
 from sentinel_tpu.rules import system as sys_mod
 from sentinel_tpu.core.callbacks import StatisticCallbackRegistry
 from sentinel_tpu.core.logs import BlockStatLogger
+from sentinel_tpu.obs import RuntimeObs
+from sentinel_tpu.obs import counters as obs_keys
 from sentinel_tpu.stats import events as ev
 from sentinel_tpu.stats.window import (
     MINUTE_SPEC, SECOND_SPEC, WindowSpec, bucket_snapshot, init_window,
@@ -371,6 +373,15 @@ class Sentinel:
         self.resource_types: dict = {}
         # per-second rolled-up block log (LogSlot → EagleEyeLogUtil analog)
         self.block_log = BlockStatLogger(self.clock)
+        # self-telemetry bundle (obs/): spans + decision counters +
+        # latency histograms + sampled block-event log. Every hot-path
+        # instrumentation site below guards on the single `obs.enabled`
+        # flag (SENTINEL_OBS_DISABLE); sampling via SENTINEL_TRACE_SAMPLE.
+        self.obs = RuntimeObs(clock=self.clock)
+        # services registered for Sentinel.close() (metric timer,
+        # exporter, ...): stopped once, LIFO, idempotently
+        self._shutdown_hooks: List = []
+        self._closed = False
         self.callbacks = StatisticCallbackRegistry()
         # circuit-breaker transition observers (EventObserverRegistry).
         # Event-driven: every decide/exit step that can move breaker state
@@ -620,6 +631,16 @@ class Sentinel:
                 self.spec.second)(
                 self._state.second, old_dyn.occupied_count,
                 old_dyn.occupied_window, jnp.int32(now_idx))
+            if self.obs.enabled:
+                # booking lifecycle at reload: pending bookings carry into
+                # the fresh ring, landed ones settled as PASS — a cold
+                # path, so the two device reads are acceptable here
+                prev = int(np.asarray(
+                    jax.device_get(old_dyn.occupied_count)).sum())
+                carried = int(np.asarray(jax.device_get(pend_cnt)).sum())
+                self.obs.counters.add(obs_keys.OCCUPY_CARRIED, carried)
+                self.obs.counters.add(obs_keys.OCCUPY_SETTLED,
+                                      max(0, prev - carried))
             fresh = flow_mod.init_flow_dyn(cfg.max_flow_rules,
                                            self.spec.second.buckets,
                                            self.spec.rows)
@@ -941,6 +962,53 @@ class Sentinel:
         return bool(getattr(self, "_skip_threads", False))
 
     # ------------------------------------------------------------------
+    # Lifecycle (shutdown registry + close)
+    # ------------------------------------------------------------------
+
+    def register_shutdown(self, service) -> None:
+        """Register a service for :meth:`close` — anything with a
+        ``stop()`` or ``close()`` method (``MetricTimerListener`` and
+        ``PrometheusExporter`` self-register at construction). Stopped
+        LIFO, each at most once; double registration is deduplicated so
+        re-wiring a service across restarts can't double-stop it."""
+        if not any(service is s for s in self._shutdown_hooks):
+            self._shutdown_hooks.append(service)
+
+    def close(self) -> None:
+        """Idempotent runtime teardown: flush buffered fast-path stats,
+        stop every registered service (daemon threads joined — no thread
+        leak across repeated open/close), close self-telemetry and the
+        block log. The engine object stays readable (snapshots work) but
+        should not dispatch after close."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._flush_fast()
+        except Exception:       # closing must not depend on device health
+            pass
+        hooks, self._shutdown_hooks = self._shutdown_hooks, []
+        for svc in reversed(hooks):
+            fn = getattr(svc, "stop", None) or getattr(svc, "close", None)
+            if fn is None:
+                continue
+            try:
+                fn()
+            except Exception:   # one bad service must not leak the rest
+                pass
+        self.obs.close()
+        try:
+            self.block_log.close()
+        except Exception:       # pragma: no cover - appender already gone
+            pass
+
+    def __enter__(self) -> "Sentinel":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+    # ------------------------------------------------------------------
     # Time helpers
     # ------------------------------------------------------------------
 
@@ -1047,6 +1115,8 @@ class Sentinel:
                 # LogSlot: block events roll into sentinel-block.log
                 self.block_log.log(resource, type(exc).__name__,
                                    origin=use_origin or "")
+                if self.obs.enabled:
+                    self._obs_block(resource, rcode, use_origin or "", 1)
                 if not self.callbacks.empty:   # StatisticSlot onBlocked
                     self.callbacks.fire_blocked(resource, use_origin or "",
                                                 acquire, exc)
@@ -1497,6 +1567,16 @@ class Sentinel:
         by registry pressure after interning resolve to row-recycled
         verdicts — same class of skew as any stale name→row cache."""
         n = len(resources)
+        # self-telemetry: one flag check when off; when on, the
+        # entry→verdict histogram records per batch and a sampled batch
+        # (obs.spans stride) carries a trace id through its whole
+        # lifecycle — entry prep → host gates → cluster precheck →
+        # split decision → compile-cache lookup → device dispatch →
+        # settle (docs/OBSERVABILITY.md span schema)
+        obs = self.obs
+        obs_on = obs.enabled
+        tr = obs.spans.maybe_trace() if obs_on else 0
+        t0 = obs.spans.now_ns() if obs_on else 0
         if isinstance(resources, np.ndarray) and resources.dtype.kind in "iu":
             rows = np.ascontiguousarray(resources, np.int32)
             resources = None
@@ -1542,6 +1622,8 @@ class Sentinel:
             if entry_types is not None else np.ones(n, np.bool_)
         prio = np.asarray(prioritized, np.bool_) if prioritized is not None \
             else np.zeros(n, np.bool_)
+        if tr:
+            obs.spans.record(tr, "entry.prep", t0, obs.spans.now_ns(), n=n)
 
         # user host gates veto first (slot-chain SPI tier 1); denials are
         # logged in the gate runner and device-recorded batched below.
@@ -1549,8 +1631,12 @@ class Sentinel:
         # leak pins (a custom check_batch raising propagates to the caller)
         gate_blocked = gate_reasons = None
         if self._host_gates:
+            t_g = obs.spans.now_ns() if tr else 0
             gate_blocked, gate_reasons = self._run_host_gates_batch(
                 resources, origins, acq, args_list, is_in, n)
+            if tr:
+                obs.spans.record(tr, "entry.host_gates", t_g,
+                                 obs.spans.now_ns(), n=n)
             if not gate_blocked.any():
                 gate_blocked = gate_reasons = None
 
@@ -1573,9 +1659,13 @@ class Sentinel:
         # surfaced as FLOW/PARAM_FLOW denials in the returned verdicts.
         cl = None
         if self._cluster_rules_by_row or self._cluster_param_rules_by_row:
+            t_c = obs.spans.now_ns() if tr else 0
             cl = self._cluster_precheck_batch(
                 resources, origins, rows, origin_rows, chain_rows,
                 acq, is_in, prio, args_list, n, skip=gate_blocked)
+            if tr:
+                obs.spans.record(tr, "entry.cluster_precheck", t_c,
+                                 obs.spans.now_ns(), n=n)
         cl_blocked = cl_waits = cl_reasons = None
         cluster_fb_arr = valid_mask = None
         if cl is not None:
@@ -1619,9 +1709,10 @@ class Sentinel:
             rows, origin_ids, origin_rows, context_ids, chain_rows, acq,
             is_in, prio, param_rules=param_rules, param_keys=param_keys,
             param_gen=param_gen, cluster_fallback=cluster_fb_arr,
-            valid=valid_mask)
+            valid=valid_mask, trace_id=tr)
 
         def _finalize() -> Verdicts:
+            t_s = obs.spans.now_ns() if tr else 0
             verdicts = pending.result()
             if cl_blocked is not None and cl_blocked.any():
                 allow = np.array(verdicts.allow, copy=True)
@@ -1664,6 +1755,14 @@ class Sentinel:
                     self.block_log.log(
                         res, err_mod.exception_name_for(rcode),
                         origin=origin, count=cnt)
+                    if obs_on:
+                        self._obs_block(res, rcode, origin, cnt)
+            if obs_on:
+                t_end = obs.spans.now_ns()
+                obs.hist_entry.record(t_end - t0)
+                if tr:
+                    obs.spans.record(tr, "entry.settle", t_s, t_end, n=n)
+                    obs.spans.record(tr, "entry.total", t0, t_end, n=n)
             return verdicts
 
         return PendingVerdicts(_finalize)
@@ -1683,9 +1782,25 @@ class Sentinel:
             exc = block_exception_for(reason, resource, origin=origin,
                                       slot_name=slot_name)
         self.block_log.log(resource, type(exc).__name__, origin=origin)
+        if self.obs.enabled:
+            self._obs_block(resource, reason, origin, 1)
         if not self.callbacks.empty:
             self.callbacks.fire_blocked(resource, origin, acquire, exc)
         return exc
+
+    def _obs_block(self, resource: str, rcode: int, origin: str,
+                   count: int, now_ms: Optional[int] = None) -> None:
+        """Per-reason denial counter + sampled structured block-event
+        record (obs/eventlog.py), keyed by the int8 verdict code —
+        custom-slot codes resolve through :meth:`slot_name_for_code`."""
+        label = (self.slot_name_for_code(rcode)
+                 if rcode >= BlockReason.CUSTOM_BASE
+                 else err_mod.exception_name_for(rcode))
+        obs = self.obs
+        obs.counters.add(obs_keys.BLOCK_PREFIX + label, count)
+        obs.block_events.log(
+            self.clock.now_ms() if now_ms is None else now_ms,
+            resource, rcode, reason_name=label, origin=origin, count=count)
 
     def _cluster_precheck_batch(self, resources, origins, rows, origin_rows,
                                 chain_rows, acq, is_in, prio, args_list,
@@ -1874,7 +1989,8 @@ class Sentinel:
                           param_gen: int = -1, cluster_fallback=None,
                           valid=None, count_thread=None,
                           record_block=None,
-                          at_ms: Optional[int] = None) -> "PendingVerdicts":
+                          at_ms: Optional[int] = None,
+                          trace_id: int = 0) -> "PendingVerdicts":
         """:meth:`decide_raw` with the verdict readback deferred: the step
         is dispatched (state already advanced in order under the lock) and
         the device→host verdict copy started async; ``.result()``
@@ -1891,8 +2007,17 @@ class Sentinel:
           batch mixes kinds — one origin or prioritized event no longer
           demotes the entire batch to the sorted path;
         * otherwise (non-uniform acquire, oversized key) → general path.
+
+        ``trace_id`` threads a sampled batch's span chain through from
+        ``entry_batch_nowait``; direct callers get their own sampling
+        decision. Every dispatch lands one ``split_route.*`` counter.
         """
         n = rows.shape[0]
+        obs = self.obs
+        obs_on = obs.enabled
+        tr = trace_id if trace_id else (obs.spans.maybe_trace()
+                                        if obs_on else 0)
+        t_d0 = obs.spans.now_ns() if obs_on else 0
         pad_a = self.spec.alt_rows
         # ---- host-side eligibility (numpy, before any padding) ----
         # Only lanes the caller marked valid count: arbitrary values on
@@ -1945,6 +2070,14 @@ class Sentinel:
             n_general_v = int(np.count_nonzero(~ev_scalar & vfull))
             n_scalar_v = int(np.count_nonzero(ev_scalar & vfull))
             if n_general_v > 0 and n_scalar_v >= 4096:
+                if obs_on:
+                    obs.counters.add(obs_keys.ROUTE_SPLIT)
+                    if tr:
+                        obs.spans.record(
+                            tr, "decide.split_decision", t_d0,
+                            obs.spans.now_ns(), n=n,
+                            note=f"scalar={n_scalar_v} "
+                                 f"general={n_general_v}")
                 return self._decide_split_nowait(
                     rows, origin_ids, origin_rows, context_ids, chain_rows,
                     acquire, is_in, ev_scalar, vfull,
@@ -1952,7 +2085,7 @@ class Sentinel:
                     param_rules=param_rules, param_keys=param_keys,
                     param_gen=param_gen, cluster_fallback=cluster_fallback,
                     count_thread=count_thread, record_block=record_block,
-                    now=now)
+                    now=now, trace_id=tr)
 
         batch = self._build_entry_batch(
             rows, origin_ids, origin_rows, context_ids, chain_rows,
@@ -2009,10 +2142,11 @@ class Sentinel:
                 flags["fast_flow"] = True
                 flags["scalar_has_rl"] = self._scalar_has_rl
             self._warm_first_fetch_locked(decide, batch, times, sys_scalars,
-                                          flags)
-            state, verdicts = decide(
-                self._ruleset, self._state, batch, times, sys_scalars,
-                **flags)
+                                          flags, trace_id=tr)
+            with obs.annotate("sentinel_tpu.decide"):
+                state, verdicts = decide(
+                    self._ruleset, self._state, batch, times, sys_scalars,
+                    **flags)
             self._state = state
             # breaker observers: ride the existing readback (seq taken
             # under the dispatch lock so diffs land in dispatch order)
@@ -2023,11 +2157,40 @@ class Sentinel:
                        state.breakers.state)
         start_host_copy((verdicts.allow, verdicts.reason, verdicts.wait_ms)
                         + ((brk[2],) if brk else ()))
+        t_disp = 0
+        if obs_on:
+            # which path this whole batch took (flags/use_occ were fixed
+            # under the dispatch lock)
+            if "scalar_flow" in flags:
+                route = obs_keys.ROUTE_SCALAR
+            elif "fast_flow" in flags:
+                route = (obs_keys.ROUTE_FAST_OCCUPY if use_occ
+                         else obs_keys.ROUTE_FAST)
+            else:
+                route = obs_keys.ROUTE_GENERAL
+            obs.counters.add(route)
+            t_disp = obs.spans.now_ns()
+            if tr:
+                obs.spans.record(tr, "decide.dispatch", t_d0, t_disp, n=n,
+                                 note=route.split(".", 1)[1])
+        prio_np_full = np.asarray(prioritized) if any_prio else None
 
         def _read() -> Verdicts:
             out = Verdicts(allow=np.asarray(verdicts.allow)[:n],
                            reason=np.asarray(verdicts.reason)[:n],
                            wait_ms=np.asarray(verdicts.wait_ms)[:n])
+            if obs_on:
+                t_end = obs.spans.now_ns()
+                obs.hist_dispatch.record(t_end - t_disp)
+                if tr:
+                    obs.spans.record(tr, "decide.device", t_disp, t_end,
+                                     n=n)
+                if prio_np_full is not None:
+                    granted = int(np.count_nonzero(
+                        out.allow & (out.wait_ms > 0)
+                        & prio_np_full[:n]))
+                    if granted:
+                        obs.counters.add(obs_keys.OCCUPY_GRANTED, granted)
             if brk is not None:
                 self._diff_and_fire_breakers(
                     brk[0], brk[1],
@@ -2037,7 +2200,7 @@ class Sentinel:
         return PendingVerdicts(_read)
 
     def _warm_first_fetch_locked(self, dec, batch, times, sys_scalars,
-                                 flags) -> None:
+                                 flags, trace_id: int = 0) -> None:
         """Cap the cold-start tail on remote-attached backends: the FIRST
         dispatch of each (step variant, batch geometry, statics) combo
         pays the program fetch (persistent-cache load + transfer), and
@@ -2050,15 +2213,29 @@ class Sentinel:
         ``core.compile_cache.guarded_first_fetch``'s timeout + bounded
         retry (a warning logs every retry). Disabled on the CPU backend
         by default: program loads there are local file reads. Knobs:
-        ``SENTINEL_FIRST_LOAD_TIMEOUT_S`` / ``SENTINEL_FIRST_LOAD_RETRIES``."""
+        ``SENTINEL_FIRST_LOAD_TIMEOUT_S`` / ``SENTINEL_FIRST_LOAD_RETRIES``.
+
+        Self-telemetry rides the same membership check on every backend:
+        ``compile_cache.hit`` / ``compile_cache.miss`` count first-vs-
+        repeat dispatches of each combo, ``compile_cache.
+        first_fetch_retry`` each guarded-fetch stall retry, and a traced
+        batch records the fetch as a ``decide.first_fetch`` span."""
+        obs = self.obs
+        key = (id(dec), int(batch.rows.shape[0]),
+               tuple(sorted(flags.items())))
+        hit = key in self._fetched_programs
+        if obs.enabled:
+            obs.counters.add(obs_keys.CACHE_HIT if hit
+                             else obs_keys.CACHE_MISS)
+        if hit:
+            return
         from sentinel_tpu.core.compile_cache import (
             first_fetch_policy, guarded_first_fetch)
         timeout_s, retries = first_fetch_policy()
         if timeout_s <= 0:
-            return
-        key = (id(dec), int(batch.rows.shape[0]),
-               tuple(sorted(flags.items())))
-        if key in self._fetched_programs:
+            # guard off (CPU default): no throwaway execution, but the
+            # combo still counts as fetched for hit/miss accounting
+            self._fetched_programs.add(key)
             return
 
         def _attempt():
@@ -2070,9 +2247,16 @@ class Sentinel:
                 dec(self._ruleset, throwaway, warm, times, sys_scalars,
                     **flags))
 
+        t0 = obs.spans.now_ns() if trace_id else 0
         guarded_first_fetch(
             _attempt, f"decide step (B={int(batch.rows.shape[0])})",
-            timeout_s, retries)
+            timeout_s, retries,
+            on_retry=((lambda: obs.counters.add(obs_keys.CACHE_RETRY))
+                      if obs.enabled else None))
+        if trace_id:
+            obs.spans.record(trace_id, "decide.first_fetch", t0,
+                             obs.spans.now_ns(),
+                             n=int(batch.rows.shape[0]))
         self._fetched_programs.add(key)
 
     def _build_entry_batch(self, rows, origin_ids, origin_rows, context_ids,
@@ -2111,7 +2295,8 @@ class Sentinel:
                              ev_scalar, vfull, *, prioritized, any_prio,
                              param_rules, param_keys,
                              param_gen, cluster_fallback, count_thread,
-                             record_block, now) -> "PendingVerdicts":
+                             record_block, now,
+                             trace_id: int = 0) -> "PendingVerdicts":
         """Mixed-batch dispatch: scalar-eligible events take the scalar
         step, origin-bearing AND prioritized ones the fast general step —
         one origin or prioritized event no longer demotes the whole batch
@@ -2129,6 +2314,10 @@ class Sentinel:
         and — when bookings may be live — folds them into its admission
         base (occupy_base) without ever writing them."""
         n = rows.shape[0]
+        obs = self.obs
+        obs_on = obs.enabled
+        tr = trace_id
+        t_d0 = obs.spans.now_ns() if obs_on else 0
         idx_s = np.nonzero(ev_scalar)[0]
         idx_g = np.nonzero(~ev_scalar)[0]
 
@@ -2192,13 +2381,14 @@ class Sentinel:
                 dec_g = (self._jit_decide_noalt if no_alt_g
                          else self._jit_decide)
             self._warm_first_fetch_locked(dec_s, bs, times, sys_scalars,
-                                          fl_s)
+                                          fl_s, trace_id=tr)
             self._warm_first_fetch_locked(dec_g, bg, times, sys_scalars,
-                                          fl_g)
-            state, v1 = dec_s(self._ruleset, self._state, bs, times,
-                              sys_scalars, **fl_s)
-            state, v2 = dec_g(self._ruleset, state, bg, times,
-                              sys_scalars, **fl_g)
+                                          fl_g, trace_id=tr)
+            with obs.annotate("sentinel_tpu.decide_split"):
+                state, v1 = dec_s(self._ruleset, self._state, bs, times,
+                                  sys_scalars, **fl_s)
+                state, v2 = dec_g(self._ruleset, state, bg, times,
+                                  sys_scalars, **fl_g)
             self._state = state
             brk = None
             if self._breaker_observers:
@@ -2210,6 +2400,13 @@ class Sentinel:
                         + ((brk[2],) if brk else ()))
         n_s = idx_s.shape[0]
         n_g = idx_g.shape[0]
+        t_disp = 0
+        if obs_on:
+            t_disp = obs.spans.now_ns()
+            if tr:
+                obs.spans.record(tr, "split.dispatch", t_d0, t_disp, n=n,
+                                 note=f"scalar={n_s} general={n_g} "
+                                      f"occ={int(use_occ)}")
 
         def _read() -> Verdicts:
             allow = np.empty(n, np.bool_)
@@ -2221,6 +2418,18 @@ class Sentinel:
             allow[idx_g] = np.asarray(v2.allow)[:n_g]
             reason[idx_g] = np.asarray(v2.reason)[:n_g]
             wait[idx_g] = np.asarray(v2.wait_ms)[:n_g]
+            if obs_on:
+                t_end = obs.spans.now_ns()
+                obs.hist_dispatch.record(t_end - t_disp)
+                if tr:
+                    obs.spans.record(tr, "split.device", t_disp, t_end,
+                                     n=n)
+                if any_prio:
+                    granted = int(np.count_nonzero(
+                        allow[idx_g] & (wait[idx_g] > 0)
+                        & np.asarray(prio_g)))
+                    if granted:
+                        obs.counters.add(obs_keys.OCCUPY_GRANTED, granted)
             if brk is not None:
                 self._diff_and_fire_breakers(
                     brk[0], brk[1],
@@ -2234,6 +2443,9 @@ class Sentinel:
                    param_gen: int = -1, count_thread=None,
                    at_ms: Optional[int] = None) -> None:
         n = rows.shape[0]
+        obs = self.obs
+        tr = obs.spans.maybe_trace() if obs.enabled else 0
+        t0 = obs.spans.now_ns() if tr else 0
         b = self._pad(n)
         batch = ExitBatch(
             rows=_pad_to(rows, b, self.spec.rows, np.int32),
@@ -2267,9 +2479,10 @@ class Sentinel:
             exit_step = (self._jit_exit_noalt
                          if self._batch_has_no_alt(origin_rows, chain_rows)
                          else self._jit_exit)
-            self._state = exit_step(self._ruleset, self._state, batch,
-                                    times,
-                                    skip_threads=self._skip_threads)
+            with self.obs.annotate("sentinel_tpu.exit"):
+                self._state = exit_step(self._ruleset, self._state, batch,
+                                        times,
+                                        skip_threads=self._skip_threads)
             # exit feeds resolve probes / trip breakers: with observers
             # registered, this call pays one small state read so the
             # observer fires within the exit call that caused the arc
@@ -2282,6 +2495,9 @@ class Sentinel:
         # pin discipline: resolve→pin, decide, exit-decrement→unpin)
         if unpin is not None:
             unpin[0].unpin_rows(unpin[1])
+        if tr:
+            obs.spans.record(tr, "exit.dispatch", t0, obs.spans.now_ns(),
+                             n=n)
         if brk is not None:
             self._diff_and_fire_breakers(
                 brk[0], brk[1], [int(s) for s in np.asarray(brk[2][:-1])])
@@ -2307,6 +2523,11 @@ class Sentinel:
                     self._state.param_dyn, rows, vals))
         evicted = self.resources.drain_evicted()
         if evicted:
+            if self.obs.enabled:
+                # rows recycled by registry pressure: their stats AND any
+                # live occupy bookings are invalidated below
+                self.obs.counters.add(obs_keys.OCCUPY_EVICTED,
+                                      len(evicted))
             alt: List[int] = []
             for row in evicted:
                 alt.extend(self._alt_rows_by_row.pop(row, ()))

@@ -44,7 +44,8 @@ def start_transport(sentinel, *, host: str = "0.0.0.0", port: int = 8719,
                     heartbeat_interval_ms: int = 10_000,
                     metric_log: bool = True,
                     gateway_manager=None, api_definition_manager=None,
-                    clock=None, async_server: bool = False) -> TransportRuntime:
+                    clock=None, async_server: bool = False,
+                    exporter_port: Optional[int] = None) -> TransportRuntime:
     """Start the HTTP command center (with port auto-increment) and, when a
     dashboard address is given, a heartbeat loop advertising the port that
     was actually bound.
@@ -69,6 +70,12 @@ def start_transport(sentinel, *, host: str = "0.0.0.0", port: int = 8719,
         metric_searcher = MetricSearcher(
             sentinel.cfg.metric_dir(),
             form_metric_file_name(sentinel.cfg.app_name))
+        # attach the sampled block-event log (obs/eventlog.py) to the same
+        # metric directory — its 1 s drain rides metric_timer.tick()
+        obs = getattr(sentinel, "obs", None)
+        if obs is not None:
+            obs.block_events.configure(sentinel.cfg.metric_dir(),
+                                       sentinel.cfg.app_name)
     cstate = register_default_handlers(
         center, sentinel, metric_searcher=metric_searcher,
         extra_info=extra, writable_registry=writable_registry,
@@ -85,6 +92,8 @@ def start_transport(sentinel, *, host: str = "0.0.0.0", port: int = 8719,
         http = SimpleHttpCommandCenter(center, host=host, port=port)
     bound = http.start()
     extra["apiPort"] = bound          # basicInfo reflects the bound port
+    if exporter_port:
+        extra["exporterPort"] = exporter_port
 
     hb = None
     if dashboard_addr:
@@ -92,7 +101,8 @@ def start_transport(sentinel, *, host: str = "0.0.0.0", port: int = 8719,
             dashboard_addr, app_name=sentinel.cfg.app_name,
             app_type=sentinel.cfg.app_type, api_port=bound,
             interval_ms=heartbeat_interval_ms,
-            clock=clock if clock is not None else sentinel.clock)
+            clock=clock if clock is not None else sentinel.clock,
+            exporter_port=exporter_port)
         hb.start()
     return TransportRuntime(center=center, http=http, heartbeat=hb,
                             cluster_state=cstate, port=bound,

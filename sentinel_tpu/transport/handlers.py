@@ -251,6 +251,34 @@ def register_default_handlers(
     def cmd_system_status(req: CommandRequest) -> CommandResponse:
         return CommandResponse.of_success(json.dumps(s.system_status()))
 
+    # ---- self-telemetry (obs/ — docs/OBSERVABILITY.md) -------------------
+
+    def cmd_obs(req: CommandRequest) -> CommandResponse:
+        """Runtime self-telemetry snapshot: decision counters, latency
+        histograms (p50/p95/p99), recent sampled spans and block events.
+        Params: ``spans`` (max spans, default 128), ``events`` (max block
+        events, default 64), ``trace`` (a trace id → that trace's full
+        span chain under ``"trace"``)."""
+        obs = getattr(s, "obs", None)
+        if obs is None:
+            return CommandResponse.of_failure("observability unavailable",
+                                              404)
+        try:
+            span_limit = int(req.param("spans", "128") or 128)
+            event_limit = int(req.param("events", "64") or 64)
+        except ValueError:
+            return CommandResponse.of_failure("invalid limit", 400)
+        payload = obs.payload(span_limit=span_limit,
+                              event_limit=event_limit)
+        payload["threadsElided"] = s.threads_elided
+        trace = req.param("trace", "")
+        if trace:
+            try:
+                payload["trace"] = obs.spans.chain(int(trace))
+            except ValueError:
+                return CommandResponse.of_failure("invalid trace id", 400)
+        return CommandResponse.of_success(json.dumps(payload))
+
     # ---- cluster mode ----------------------------------------------------
 
     def cmd_get_cluster_mode(req: CommandRequest) -> CommandResponse:
@@ -364,6 +392,7 @@ def register_default_handlers(
         ("tree", "node tree (text)", cmd_tree),
         ("jsonTree", "node tree (json)", cmd_json_tree),
         ("systemStatus", "system adaptive status", cmd_system_status),
+        ("obs", "runtime self-telemetry snapshot", cmd_obs),
         ("getClusterMode", "get cluster mode", cmd_get_cluster_mode),
         ("setClusterMode", "set cluster mode", cmd_set_cluster_mode),
         ("getClusterClientConfig", "get cluster client config",

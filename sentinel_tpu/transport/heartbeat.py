@@ -39,12 +39,16 @@ class HeartbeatSender:
     def __init__(self, dashboard_addr: str, *, app_name: str,
                  app_type: int = 0, api_port: int = 8719,
                  interval_ms: int = DEFAULT_INTERVAL_MS,
-                 clock=None):
-        """``dashboard_addr`` is ``host:port`` (csp.sentinel.dashboard.server)."""
+                 clock=None, exporter_port: Optional[int] = None):
+        """``dashboard_addr`` is ``host:port`` (csp.sentinel.dashboard.server).
+        ``exporter_port`` — when the app serves Prometheus ``/metrics``
+        (metrics/exporter.py), advertise that port too so scrape targets
+        can be discovered from dashboard machine discovery."""
         self.dashboard_addr = dashboard_addr
         self.app_name = app_name
         self.app_type = app_type
         self.api_port = api_port
+        self.exporter_port = exporter_port
         self.interval_ms = interval_ms
         self._clock = clock
         self._stop = threading.Event()
@@ -56,7 +60,7 @@ class HeartbeatSender:
         import time
         now = (self._clock.now_ms() if self._clock is not None
                else int(time.time() * 1000))
-        return {
+        msg = {
             "hostname": socket.gethostname(),
             "ip": _local_ip(),
             "port": str(self.api_port),
@@ -65,6 +69,9 @@ class HeartbeatSender:
             "v": __version__,                    # heartbeat client version
             "version": str(now),
         }
+        if self.exporter_port:
+            msg["exporterPort"] = str(self.exporter_port)
+        return msg
 
     def send_once(self, timeout: float = 3.0) -> bool:
         url = f"http://{self.dashboard_addr}{HEARTBEAT_PATH}"
