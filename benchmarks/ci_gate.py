@@ -44,6 +44,15 @@ times the SAME split-firing workload through two runtimes — obs enabled vs
 ``SENTINEL_OBS_DISABLE=1`` — interleaved best-of-N, and bands the
 instrumented/uninstrumented step-time ratio at ``OBS_OVERHEAD_MAX`` (1.02,
 the ISSUE's ≤2% budget). Machine speed cancels in the ratio.
+
+Gate (e) — the dispatch-pipeline gate (r6, portable): the fused
+decide+exit program must actually save its dispatch (fused/two-call
+step-time ratio ≤ ``FUSED_MAX``), the depth-2 ``DispatchPipeline``
+overlay must cost nothing material over the bare sync loop
+(≤ ``PIPELINE_OVERHEAD_MAX``), and the ``pipeline.depth`` counter must
+prove batches genuinely overlapped in flight. The comment block above
+``measure_dispatch_pipeline`` explains why the overlay's latency WIN is
+carried by the BENCH artifacts rather than gated on the CPU backend.
 """
 
 from __future__ import annotations
@@ -134,10 +143,23 @@ def measure_host_prep() -> dict:
     )
 
     B, STEPS = 4096, 12
-    sph = stpu.Sentinel(stpu.load_config(
-        max_resources=256, max_flow_rules=16, max_degrade_rules=16,
-        max_authority_rules=16, max_param_rules=16,
-        param_table_slots=1 << 12))
+    # donation off for THIS runtime: the CPU PJRT client acquires donated
+    # buffers synchronously, which folds device step time into the
+    # dispatch call — this gate pins the HOST marshalling code, so it
+    # must time an undonated dispatch (the donated fast path is covered
+    # by gate (e) and the parity tests)
+    prev_donate = os.environ.get("SENTINEL_DONATE")
+    os.environ["SENTINEL_DONATE"] = "0"
+    try:
+        sph = stpu.Sentinel(stpu.load_config(
+            max_resources=256, max_flow_rules=16, max_degrade_rules=16,
+            max_authority_rules=16, max_param_rules=16,
+            param_table_slots=1 << 12))
+    finally:
+        if prev_donate is None:
+            os.environ.pop("SENTINEL_DONATE", None)
+        else:
+            os.environ["SENTINEL_DONATE"] = prev_donate
     sph.load_param_flow_rules([stpu.ParamFlowRule(
         resource="hot", param_idx=0, count=1e9)])
     rng = np.random.default_rng(0)
@@ -321,6 +343,169 @@ def measure_obs_overhead() -> dict:
             "obs_overhead_ratio": best["on"] / best["off"]}
 
 
+# Gate (e) — the dispatch-pipeline gate (r6, portable). Ratios, so machine
+# speed cancels:
+#   fused:    the allow-then-exit serving loop through
+#             decide_and_exit_raw_nowait (ONE dispatch/step) vs the
+#             decide+exit two-call form — pure dispatch-count reduction,
+#             backend-independent (measured ~0.91-0.97 on CPU; the whole
+#             win at the tunneled TPU's 2.37 ms/dispatch floor). Must be
+#             ≤ FUSED_MAX of two-call: this is the gated "pipelined
+#             dispatch beats the synchronous loop" number.
+#   overlay:  DispatchPipeline(depth=2) vs the sync loop through
+#             entry_batch_nowait. On THIS backend the window is ~
+#             breakeven — the CPU PJRT client acquires donated buffers
+#             synchronously at dispatch and chained steps serialize on
+#             device anyway — so the CPU pin is "adds no material
+#             overhead" (≤ PIPELINE_OVERHEAD_MAX), while the depth/stall
+#             counters prove batches genuinely overlapped in flight. The
+#             latency WIN of the window is an accelerator-backend effect,
+#             carried by the BENCH artifacts (bench.py "serving" +
+#             dispatch_floor_*_ms keys), not gateable on CPU.
+#   floor:    tiny-op per-dispatch readback vs a depth-2 deferred-readback
+#             window — recorded for the artifact trail but NOT gated: the
+#             CPU round trip is ~35 µs, so the window's deque overhead is
+#             the same order as the savings and the ratio is noise there.
+FUSED_MAX = 0.985
+PIPELINE_OVERHEAD_MAX = 1.10
+
+
+def measure_dispatch_pipeline() -> dict:
+    import time as _time
+
+    import numpy as np
+
+    sys.path.insert(0, str(HERE.parent))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    import sentinel_tpu as stpu
+    from sentinel_tpu.obs import counters as obs_keys
+
+    # --- floor pin: per-dispatch readback vs depth-2 deferred window ---
+    import collections
+    tiny = jax.jit(lambda x: x + 1)
+    x0 = jnp.zeros((8,), jnp.int32)
+    _ = np.asarray(tiny(x0)[:1])
+    N = 200
+
+    def floor_sync() -> float:
+        t0 = _time.perf_counter()
+        for _ in range(N):
+            _ = np.asarray(tiny(x0)[:1])
+        return (_time.perf_counter() - t0) / N
+
+    def floor_pipe() -> float:
+        window: "collections.deque" = collections.deque()
+        t0 = _time.perf_counter()
+        for _ in range(N):
+            window.append(tiny(x0))
+            if len(window) > 2:
+                _ = np.asarray(window.popleft()[:1])
+        while window:
+            _ = np.asarray(window.popleft()[:1])
+        return (_time.perf_counter() - t0) / N
+
+    fbest = {}
+    for rep in range(8):
+        for key, fn in ([("s", floor_sync), ("p", floor_pipe)]
+                        if rep % 2 == 0 else
+                        [("p", floor_pipe), ("s", floor_sync)]):
+            dt = fn()
+            fbest[key] = min(fbest.get(key, dt), dt)
+
+    # --- runtime fixture shared by the fused and overlay pins ---
+    B, STEPS, REPEATS = 8192, 6, 8
+    sph = stpu.Sentinel(stpu.load_config(
+        max_resources=1024, max_flow_rules=64, max_degrade_rules=16,
+        max_authority_rules=16))
+    sph.load_flow_rules([stpu.FlowRule(resource=f"s{i}", count=1e9)
+                         for i in range(64)])
+    rng = np.random.default_rng(13)
+    rows = sph.intern_resources(
+        [f"s{int(i)}" for i in rng.integers(0, 512, B)])
+    pad_a = sph.spec.alt_rows
+    orow = np.full(B, pad_a, np.int32)
+    ctx0 = np.zeros(B, np.int32)
+    ones = np.ones(B, np.int32)
+    is_in = np.ones(B, np.bool_)
+    noprio = np.zeros(B, np.bool_)
+    rt = np.full(B, 5, np.int32)
+    err = np.zeros(B, np.bool_)
+
+    def run_two_call() -> float:
+        t0 = _time.perf_counter()
+        for _ in range(STEPS):
+            h = sph.decide_raw_nowait(rows, ctx0, orow, ctx0, orow, ones,
+                                      is_in, noprio)
+            sph.exit_batch(rows=rows, origin_rows=orow, chain_rows=orow,
+                           acquire=ones, rt_ms=rt, error=err, is_in=is_in)
+            h.result()
+        return (_time.perf_counter() - t0) / STEPS
+
+    def run_fused() -> float:
+        t0 = _time.perf_counter()
+        for _ in range(STEPS):
+            sph.decide_and_exit_raw_nowait(
+                rows, ctx0, orow, ctx0, orow, ones, is_in, noprio,
+                exit_rows=rows, exit_origin_rows=orow,
+                exit_chain_rows=orow, exit_acquire=ones, exit_rt_ms=rt,
+                exit_error=err, exit_is_in=is_in).result()
+        return (_time.perf_counter() - t0) / STEPS
+
+    def run_sync() -> float:
+        t0 = _time.perf_counter()
+        for _ in range(STEPS):
+            sph.entry_batch_nowait(rows).result()
+        return (_time.perf_counter() - t0) / STEPS
+
+    def run_pipelined() -> float:
+        pipe = stpu.DispatchPipeline(sph, depth=2)
+        tickets: "collections.deque" = collections.deque()
+        t0 = _time.perf_counter()
+        for _ in range(STEPS):
+            tickets.append(pipe.submit(rows))
+            if len(tickets) > pipe.depth:
+                tickets.popleft().result()
+        while tickets:
+            tickets.popleft().result()
+        return (_time.perf_counter() - t0) / STEPS
+
+    best = {}
+    pairs = [("two_call", run_two_call), ("fused", run_fused),
+             ("sync", run_sync), ("pipelined", run_pipelined)]
+    for _key, fn in pairs:                       # warm compiles + caches
+        fn()
+    for rep in range(REPEATS):
+        for key, fn in (pairs if rep % 2 == 0 else pairs[::-1]):
+            dt = fn()
+            best[key] = min(best.get(key, dt), dt)
+
+    # mechanism probe: the overlay numbers only mean something if batches
+    # actually were in flight together
+    depth_sum = sph.obs.counters.get(obs_keys.PIPE_DEPTH)
+    stalls = sph.obs.counters.get(obs_keys.PIPE_STALL)
+    # run_pipelined executed once to warm + once per repeat; average
+    # in-flight depth > 1 ⟺ depth_sum > enqueues
+    enqueues = (REPEATS + 1) * STEPS
+    fused_routes = sph.obs.counters.get(obs_keys.ROUTE_FUSED)
+    sph.close()
+    return {
+        "floor_sync_s": fbest["s"], "floor_pipelined_s": fbest["p"],
+        "floor_ratio": fbest["p"] / fbest["s"],
+        "two_call_s_per_step": best["two_call"],
+        "fused_s_per_step": best["fused"],
+        "fused_ratio": best["fused"] / best["two_call"],
+        "sync_s_per_step": best["sync"],
+        "pipelined_s_per_step": best["pipelined"],
+        "pipeline_overhead_ratio": best["pipelined"] / best["sync"],
+        "pipelined_depth_reached": depth_sum > enqueues,
+        "pipeline_stalls": stalls,
+        "fused_dispatches": fused_routes,
+    }
+
+
 def main() -> int:
     best = max(measure_once() for _ in range(3))
     cal = calibrate()
@@ -328,6 +513,7 @@ def main() -> int:
     prio = measure_prio_cliff()
     routing_err = check_prio_split_routing()
     obs = measure_obs_overhead()
+    disp = measure_dispatch_pipeline()
     ratios = {k.replace("_s_per_step", "_ratio"): v / cal
               for k, v in prep.items()}
     if "--update" in sys.argv:
@@ -341,6 +527,9 @@ def main() -> int:
              # re-baselined per machine
              "prio_cliff": {k: round(v, 4) for k, v in prio.items()},
              "obs_overhead": {k: round(v, 4) for k, v in obs.items()},
+             "dispatch_pipeline": {
+                 k: (round(v, 6) if isinstance(v, float) else v)
+                 for k, v in disp.items()},
              "calibration_s": cal}, indent=1))
         print(f"baseline updated: floor={best / 2:.0f} (measured {best:.0f}) "
               f"on {fingerprint()}; host-prep ratios "
@@ -360,9 +549,33 @@ def main() -> int:
         "prio_cliff": {k: round(v, 4) for k, v in prio.items()},
         "prio_split_routing": "ok" if routing_err is None else "DEMOTED",
         "obs_overhead": {k: round(v, 4) for k, v in obs.items()},
+        "dispatch_pipeline": {
+            k: (round(v, 6) if isinstance(v, float) else v)
+            for k, v in disp.items()},
     }
     print(json.dumps(out))
     rc = 0
+    fu = disp["fused_ratio"]
+    if fu > FUSED_MAX:
+        print(f"FUSED-DISPATCH REGRESSION: fused/two-call step-time ratio "
+              f"{fu:.4f} > {FUSED_MAX} — decide_and_exit_raw_nowait no "
+              f"longer saves its dispatch (it must cost ONE dispatch, "
+              f"not two)", file=sys.stderr)
+        rc = 1
+    po = disp["pipeline_overhead_ratio"]
+    if po > PIPELINE_OVERHEAD_MAX:
+        print(f"PIPELINE-OVERHEAD REGRESSION: pipelined/sync step-time "
+              f"ratio {po:.4f} > {PIPELINE_OVERHEAD_MAX} — the "
+              f"DispatchPipeline layer costs material time over the bare "
+              f"nowait loop (lock contention, per-submit device syncs, or "
+              f"settle-order bookkeeping growth)", file=sys.stderr)
+        rc = 1
+    if not disp["pipelined_depth_reached"]:
+        print("PIPELINE-MECHANISM REGRESSION: pipeline.depth counter shows "
+              "batches never overlapped in flight (depth window collapsed "
+              "to 1) — the overlay timing above proved nothing",
+              file=sys.stderr)
+        rc = 1
     oratio = obs["obs_overhead_ratio"]
     if oratio > OBS_OVERHEAD_MAX:
         print(f"OBS-OVERHEAD REGRESSION: instrumented/uninstrumented "

@@ -69,7 +69,10 @@ from sentinel_tpu.rules.param_flow import (
     ParamFlowRule,
 )
 from sentinel_tpu.rules.system import SystemRule
-from sentinel_tpu.runtime import ENTRY_TYPE_IN, ENTRY_TYPE_OUT, Entry, Sentinel
+from sentinel_tpu.runtime import (
+    ENTRY_TYPE_IN, ENTRY_TYPE_OUT, Entry, Sentinel, pipeline_depth,
+)
+from sentinel_tpu.serving import DispatchPipeline, PipelinedVerdicts
 
 __version__ = "0.1.0"
 
@@ -91,4 +94,5 @@ __all__ = [
     "ContextScope", "enter_context", "exit_context",
     "snapshot_context", "restore_context",
     "SentinelConfig", "load_config",
+    "DispatchPipeline", "PipelinedVerdicts", "pipeline_depth",
 ]

@@ -32,7 +32,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
-from typing import Optional, Tuple
+from typing import Mapping, Optional, Tuple
 
 _lock = threading.Lock()
 _enabled_dir: Optional[str] = None
@@ -91,6 +91,21 @@ def enable_persistent_cache(path: Optional[str] = None) -> Optional[str]:
 
 def active_cache_dir() -> Optional[str]:
     return _enabled_dir
+
+
+def program_key(kind: str, step_id: int, geometry, statics: Mapping) -> tuple:
+    """Hashable identity of one compiled program variant for first-fetch
+    bookkeeping (``Sentinel._fetched_programs`` / ``compile_cache.hit`` /
+    ``.miss`` counters).
+
+    ``kind`` names the program family (``"decide"``, ``"fused"``);
+    ``step_id`` is ``id()`` of the jitted callable, so rebuilt jits
+    (rule reload, geometry change) key fresh; ``geometry`` is the padded
+    batch-shape tuple (one entry for decide, ``(b_entry, b_exit)`` for
+    the fused decide+exit program); ``statics`` the static-arg flags the
+    variant was specialized on."""
+    return (kind, int(step_id), tuple(geometry),
+            tuple(sorted(statics.items())))
 
 
 # ---------------------------------------------------------------------------

@@ -12,23 +12,41 @@ the next batch's host prep.
 from __future__ import annotations
 
 
+class _Cell:
+    """Shared settle state for a :class:`PendingResult`.
+
+    Split out of the handle so a GC finalizer can settle a leaked handle
+    without resurrecting it: the finalizer closes over the cell, and a
+    handle whose cell was already settled by the finalizer still returns
+    the cached result from :meth:`settle`.
+    """
+
+    __slots__ = ("fn", "done", "res")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.done = False
+        self.res = None
+
+    def settle(self):
+        if not self.done:
+            self.res = self.fn()
+            self.done = True
+            self.fn = None
+        return self.res
+
+
 class PendingResult:
     """Memoizing one-shot handle: ``result()`` runs the deferred
     materialization exactly once and returns the cached value after."""
 
-    __slots__ = ("_fn", "_done", "_res")
+    __slots__ = ("_cell", "__weakref__")
 
     def __init__(self, fn):
-        self._fn = fn
-        self._done = False
-        self._res = None
+        self._cell = _Cell(fn)
 
     def result(self):
-        if not self._done:
-            self._res = self._fn()
-            self._done = True
-            self._fn = None
-        return self._res
+        return self._cell.settle()
 
 
 def start_host_copy(arrays) -> None:

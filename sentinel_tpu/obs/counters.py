@@ -16,6 +16,12 @@ dispatch stays within the 2% observability budget (benchmarks/ci_gate.py
 * ``occupy.*`` — priority booking lifecycle: ``granted`` (PriorityWait
   admissions), ``carried`` / ``settled`` (bookings surviving /
   landing at rule reload), ``evicted`` (cleared by row eviction).
+* ``pipeline.*`` — dispatch-pipeline health (sentinel_tpu/serving.py):
+  ``depth`` (sum of in-flight handles observed at each enqueue — divide
+  by enqueue count for the achieved average depth), ``stall`` (submits
+  that had to settle the oldest in-flight batch first), and
+  ``leaked_handles`` (PendingVerdicts settled by the GC finalizer
+  because ``.result()`` was never called).
 * ``block_reason.<ExceptionName>`` — per-reason denial breakdown keyed
   by the int8 verdict codes (``exception_name_for`` /
   ``slot_name_for_code`` for custom slots).
@@ -47,6 +53,12 @@ OCCUPY_CARRIED = "occupy.carried"
 OCCUPY_SETTLED = "occupy.settled"
 OCCUPY_EVICTED = "occupy.evicted"
 
+ROUTE_FUSED = "split_route.fused_exit"
+
+PIPE_DEPTH = "pipeline.depth"
+PIPE_STALL = "pipeline.stall"
+PIPE_LEAKED = "pipeline.leaked_handles"
+
 BLOCK_PREFIX = "block_reason."
 
 #: Fixed aggregation catalog (order is the wire format of the multihost
@@ -60,6 +72,8 @@ CATALOG = (
     BLOCK_PREFIX + "SystemBlockException",
     BLOCK_PREFIX + "AuthorityException",
     BLOCK_PREFIX + "ParamFlowException",
+    ROUTE_FUSED,
+    PIPE_DEPTH, PIPE_STALL, PIPE_LEAKED,
 )
 
 

@@ -25,15 +25,19 @@ from __future__ import annotations
 
 import collections
 import functools
+import logging
 import os
 import threading
+import weakref
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
-from sentinel_tpu.core.batching import pad_pow2, pad_to as _pad_to
+from sentinel_tpu.core.batching import (
+    pad_into as _pad_into, pad_pow2, pad_to as _pad_to,
+)
 from sentinel_tpu.core.clock import Clock, global_clock
 from sentinel_tpu.core.pending import PendingResult, start_host_copy
 from sentinel_tpu.core.config import SentinelConfig, load_config
@@ -50,8 +54,8 @@ from sentinel_tpu.core.registry import (
 )
 from sentinel_tpu.engine.pipeline import (
     EngineSpec, EntryBatch, ExitBatch, RuleSet, SentinelState, Verdicts,
-    decide_entries, init_state, invalidate_resource_rows, record_blocks,
-    record_exits,
+    decide_and_record_exits, decide_entries, init_state,
+    invalidate_resource_rows, record_blocks, record_exits,
 )
 from sentinel_tpu.engine import fastpath as fp_mod
 from sentinel_tpu.rules import authority as auth_mod
@@ -72,11 +76,56 @@ from sentinel_tpu.stats.window import (
 ENTRY_TYPE_OUT = 0
 ENTRY_TYPE_IN = 1
 
+_log = logging.getLogger("sentinel_tpu.runtime")
 
-def _build_steps(spec: EngineSpec, custom_slots: tuple, shardings=None):
+#: Depth of the serving dispatch pipeline (sentinel_tpu/serving.py) — how
+#: many batches may be in flight before a submit settles the oldest.
+PIPELINE_DEPTH_ENV = "SENTINEL_PIPELINE_DEPTH"
+
+
+def _env_on(name: str, default: bool = True) -> bool:
+    v = os.environ.get(name, "")
+    if not v:
+        return default
+    return v.lower() not in ("0", "off", "false", "disable", "disabled")
+
+
+def donation_enabled() -> bool:
+    """Buffer donation on the jitted steps: the engine-state argument's
+    device buffers are reused for the output state, halving the step's
+    peak state footprint and letting XLA update the window tensors in
+    place. Every runtime call site threads ``state_in → state_out``
+    under the dispatch lock, so the consumed input is never read again;
+    ``SENTINEL_DONATE=0`` is the escape hatch (e.g. for external code
+    that calls the ``_jit_*`` steps directly and re-reads its input)."""
+    return _env_on("SENTINEL_DONATE")
+
+
+def host_staging_enabled() -> bool:
+    """Reuse preallocated host staging buffers for the per-step batch
+    columns instead of fresh numpy allocations (``_StagingRing``);
+    ``SENTINEL_HOST_STAGING=0`` disables."""
+    return _env_on("SENTINEL_HOST_STAGING")
+
+
+def pipeline_depth(default: int = 2) -> int:
+    """The ``SENTINEL_PIPELINE_DEPTH`` knob, clamped to [1, 64]."""
+    raw = os.environ.get(PIPELINE_DEPTH_ENV, "")
+    try:
+        d = int(raw) if raw else default
+    except ValueError:
+        return default
+    return max(1, min(d, 64))
+
+
+def _build_steps(spec: EngineSpec, custom_slots: tuple, shardings=None,
+                 donate: bool = True):
     """``shardings`` = (state_shardings, verdict_shardings) pins every
     step's state output to the mesh layout (parallel/local_shard.py) so
-    sharded state can never silently decay to replicated across steps."""
+    sharded state can never silently decay to replicated across steps.
+
+    ``donate`` donates each step's engine-state argument (the output
+    state reuses its buffers — see :func:`donation_enabled`)."""
     if shardings is None:
         st_out = vd_out = None
         kw_sv = kw_s = {}
@@ -84,13 +133,28 @@ def _build_steps(spec: EngineSpec, custom_slots: tuple, shardings=None):
         st_out, vd_out = shardings
         kw_sv = {"out_shardings": (st_out, vd_out)}
         kw_s = {"out_shardings": st_out}
+    # state is positional arg 1 of the partials below (rules, state, ...)
+    # except invalidate/record_blocks where it leads
+    kw_d1 = {"donate_argnums": (1,)} if donate else {}
+    kw_d0 = {"donate_argnums": (0,)} if donate else {}
     def dec(occ, alt):
         return jax.jit(functools.partial(
             decide_entries, spec, enable_occupy=occ,
             custom_slots=custom_slots, record_alt=alt),
             static_argnames=("scalar_flow", "fast_flow", "skip_auth",
                              "skip_sys", "scalar_has_rl",
-                             "skip_threads"), **kw_sv)
+                             "skip_threads"), **kw_sv, **kw_d1)
+
+    def fused(occ, alt):
+        # decide+exit in ONE program (engine/pipeline.py
+        # decide_and_record_exits): the allow-then-exit serving pattern
+        # pays one dispatch where the two-call form pays two
+        return jax.jit(functools.partial(
+            decide_and_record_exits, spec, enable_occupy=occ,
+            custom_slots=custom_slots, record_alt=alt),
+            static_argnames=("scalar_flow", "fast_flow", "skip_auth",
+                             "skip_sys", "scalar_has_rl",
+                             "skip_threads"), **kw_sv, **kw_d1)
 
     # jit objects are lazy (tracing happens on first call), so building all
     # variants is free; the *_noalt ones compile away the origin/chain
@@ -99,34 +163,44 @@ def _build_steps(spec: EngineSpec, custom_slots: tuple, shardings=None):
     return (dec(False, True), dec(True, True),
             dec(False, False), dec(True, False),
             jax.jit(functools.partial(record_exits, spec),
-                    static_argnames=("skip_threads",), **kw_s),
+                    static_argnames=("skip_threads",), **kw_s, **kw_d1),
             jax.jit(functools.partial(record_exits, spec,
                                       record_alt=False),
-                    static_argnames=("skip_threads",), **kw_s),
-            jax.jit(functools.partial(invalidate_resource_rows, spec), **kw_s),
-            jax.jit(functools.partial(record_blocks, spec), **kw_s))
+                    static_argnames=("skip_threads",), **kw_s, **kw_d1),
+            jax.jit(functools.partial(invalidate_resource_rows, spec),
+                    **kw_s, **kw_d0),
+            jax.jit(functools.partial(record_blocks, spec),
+                    **kw_s, **kw_d0),
+            (fused(False, True), fused(True, True),
+             fused(False, False), fused(True, False)))
 
 
 @functools.lru_cache(maxsize=None)
-def _jitted_steps_cached(spec: EngineSpec):
-    return _build_steps(spec, ())
+def _jitted_steps_cached(spec: EngineSpec, donate: bool = True):
+    return _build_steps(spec, (), donate=donate)
 
 
-def _jitted_steps(spec: EngineSpec, custom_slots: tuple = (), shardings=None):
+def _jitted_steps(spec: EngineSpec, custom_slots: tuple = (), shardings=None,
+                  donate: Optional[bool] = None):
     """Compiled steps shared across Sentinel instances with the same geometry
     (EngineSpec is a frozen, hashable dataclass). Variants WITH custom
     DeviceSlots or mesh shardings are deliberately NOT cached globally: the
     owning Sentinel holds the only reference, so stale compilations (and the
     slot objects / mesh) are garbage-collected on every register/unregister
     instead of pinned forever by an unbounded cache key."""
+    if donate is None:
+        donate = donation_enabled()
     if custom_slots or shardings is not None:
-        return _build_steps(spec, custom_slots, shardings)
-    return _jitted_steps_cached(spec)
+        return _build_steps(spec, custom_slots, shardings, donate)
+    return _jitted_steps_cached(spec, donate)
 
 # jitted once at import; shapes are padded to powers of two so the trace
 # cache stays small (calling jax.jit(...) per drain would re-trace every time)
 _jit_invalidate_param_keys = jax.jit(pf_mod.invalidate_param_keys)
 _jit_apply_overrides = jax.jit(pf_mod.apply_overrides)
+# small device-side copy used to hand breaker observers a column that
+# survives the next step's donation of the state it was read from
+_jit_copy_column = jax.jit(jnp.copy)
 
 
 @functools.lru_cache(maxsize=None)
@@ -258,12 +332,84 @@ class Entry:
         return False
 
 
+def _settle_leaked(cell, on_leak) -> None:
+    """GC finalizer for a :class:`PendingVerdicts` dropped without
+    ``.result()``: the deferred host bookkeeping (blocked-pin release,
+    block log, breaker diffs) must not be lost with the handle. Runs the
+    leak callback (counter + warning) first so a settle failure — e.g.
+    the owning Sentinel was closed — still leaves the leak visible."""
+    if cell.done:
+        return
+    try:
+        on_leak()
+    except Exception:   # telemetry must never mask the settle
+        pass
+    try:
+        cell.settle()
+    except Exception:
+        _log.debug("leaked PendingVerdicts settle failed", exc_info=True)
+
+
 class PendingVerdicts(PendingResult):
     """Handle for an in-flight batch decide: ``result()`` materializes the
     :class:`Verdicts` and performs the deferred host-side bookkeeping
-    (blocked-pin release, block log) — it MUST be called for every handle."""
+    (blocked-pin release, block log) — it MUST be called for every handle.
 
-    __slots__ = ()
+    A handle the caller drops anyway is settled by a GC finalizer (see
+    :func:`_settle_leaked`) and counted in ``pipeline.leaked_handles`` —
+    correctness is preserved, but the settle then runs at an arbitrary
+    point on the GC's thread, so a leak is still a caller bug."""
+
+    __slots__ = ("_leak_finalizer",)
+
+    def attach_leak_guard(self, on_leak) -> None:
+        f = weakref.finalize(self, _settle_leaked, self._cell, on_leak)
+        # never settle during interpreter shutdown: the backend may
+        # already be torn down, and the process exiting is not a leak
+        f.atexit = False
+        self._leak_finalizer = f
+
+    def result(self):
+        fin = getattr(self, "_leak_finalizer", None)
+        if fin is not None:
+            fin.detach()
+        return self._cell.settle()
+
+
+class _StagingRing:
+    """Preallocated host staging for the always-present entry-batch columns
+    of one padded size: ``_build_entry_batch`` fills the next slot in place
+    (``pad_into``) instead of allocating ~9 fresh numpy arrays per step —
+    the ``entry.prep`` cost a serving loop re-pays every dispatch.
+
+    A slot must not be rewritten while a dispatch built from it could
+    still read it. jax's jit call copies host operands to the device
+    synchronously, but the split path builds TWO batches (possibly the
+    same padded size) before dispatching either and a depth-k pipeline
+    keeps k submits in flight, so the ring holds ``2k + 2`` slots (min 4).
+    ``next()`` is lock-guarded; concurrent large-batch dispatchers beyond
+    the ring depth should disable staging (``SENTINEL_HOST_STAGING=0`` —
+    see docs/OPERATIONS.md "Pipelined dispatch")."""
+
+    __slots__ = ("slots", "_i", "_lock")
+
+    _INT_COLS = ("rows", "origin_ids", "origin_rows", "context_ids",
+                 "chain_rows", "acquire")
+    _BOOL_COLS = ("is_in", "prioritized", "valid")
+
+    def __init__(self, b: int, depth: int):
+        self._i = 0
+        self._lock = threading.Lock()
+        self.slots = [
+            {**{c: np.empty(b, np.int32) for c in self._INT_COLS},
+             **{c: np.empty(b, np.bool_) for c in self._BOOL_COLS}}
+            for _ in range(depth)]
+
+    def next(self) -> dict:
+        with self._lock:
+            s = self.slots[self._i]
+            self._i = (self._i + 1) % len(self.slots)
+            return s
 
 
 class Sentinel:
@@ -403,11 +549,22 @@ class Sentinel:
         self._breaker_fire_q: "collections.deque" = collections.deque()
         self._breaker_firing = False
 
+        # dispatch-cost knobs (read once at construction): buffer donation
+        # on the jitted steps and host staging reuse for batch columns
+        self._donate = donation_enabled()
+        self._staging_on = host_staging_enabled()
+        # padded batch size → _StagingRing; ring depth covers the deepest
+        # supported dispatch pipeline plus the split path's two builds
+        self._staging: dict = {}
+        self._staging_depth = max(4, 2 * pipeline_depth() + 2)
+
         (self._jit_decide, self._jit_decide_prio,
          self._jit_decide_noalt, self._jit_decide_prio_noalt,
          self._jit_exit, self._jit_exit_noalt,
-         self._jit_invalidate, self._jit_record_blocks) = \
-            _jitted_steps(self.spec, shardings=self._mesh_shardings)
+         self._jit_invalidate, self._jit_record_blocks,
+         self._jit_fused_steps) = \
+            _jitted_steps(self.spec, shardings=self._mesh_shardings,
+                          donate=self._donate)
         # (variant, geometry, statics) combos whose program fetch was
         # already guarded — see _warm_first_fetch_locked
         self._fetched_programs: set = set()
@@ -763,9 +920,10 @@ class Sentinel:
         (self._jit_decide, self._jit_decide_prio,
          self._jit_decide_noalt, self._jit_decide_prio_noalt,
          self._jit_exit, self._jit_exit_noalt,
-         self._jit_invalidate, self._jit_record_blocks) = \
+         self._jit_invalidate, self._jit_record_blocks,
+         self._jit_fused_steps) = \
             _jitted_steps(self.spec, self._device_slots,
-                          self._mesh_shardings)
+                          self._mesh_shardings, donate=self._donate)
 
     def _slot_code(self, kind: str, index: int) -> int:
         """Reason code for a custom slot denial (disjoint sub-spaces: the
@@ -940,9 +1098,10 @@ class Sentinel:
             (self._jit_decide, self._jit_decide_prio,
              self._jit_decide_noalt, self._jit_decide_prio_noalt,
              self._jit_exit, self._jit_exit_noalt,
-             self._jit_invalidate, self._jit_record_blocks) = \
+             self._jit_invalidate, self._jit_record_blocks,
+             self._jit_fused_steps) = \
                 _jitted_steps(self.spec, self._device_slots,
-                              self._mesh_shardings)
+                              self._mesh_shardings, donate=self._donate)
             self._occupy_live_until_ms = -1
             self._seen_idx = -(2 ** 62)
             self._fast.win_ms = max(1, new_second.win_ms)
@@ -1765,7 +1924,7 @@ class Sentinel:
                     obs.spans.record(tr, "entry.total", t0, t_end, n=n)
             return verdicts
 
-        return PendingVerdicts(_finalize)
+        return self._pending_verdicts(_finalize)
 
     def _log_cluster_block(self, reason: int, resource: str, origin: str,
                            acquire: int, exc=None,
@@ -1983,6 +2142,28 @@ class Sentinel:
         return bool(np.min(origin_rows, initial=pad_a) >= pad_a
                     and np.min(chain_rows, initial=pad_a) >= pad_a)
 
+    def _on_leaked_handle(self) -> None:
+        if self.obs.enabled:
+            self.obs.counters.add(obs_keys.PIPE_LEAKED)
+        _log.warning("PendingVerdicts dropped without .result(); "
+                     "settled by the GC finalizer")
+
+    def _pending_verdicts(self, fn) -> "PendingVerdicts":
+        """Wrap a deferred settle in a leak-guarded handle (every nowait
+        path returns through here so no handle can silently drop its
+        bookkeeping)."""
+        h = PendingVerdicts(fn)
+        h.attach_leak_guard(self._on_leaked_handle)
+        return h
+
+    def _breaker_snapshot_locked(self):
+        """Donation-safe handle on the current breaker-state column for a
+        DEFERRED read: with donation on, the state pytree owning this
+        leaf is consumed by the next dispatched step, so observers get a
+        small async device-side copy instead of the live leaf."""
+        col = self._state.breakers.state
+        return _jit_copy_column(col) if self._donate else col
+
     def decide_raw_nowait(self, rows, origin_ids, origin_rows, context_ids,
                           chain_rows, acquire, is_in, prioritized, *,
                           param_rules=None, param_keys=None,
@@ -2040,7 +2221,10 @@ class Sentinel:
         # the fast general path's composite rank key must fit int32
         key_fits = (self._ruleset.flow_table.active.shape[0]
                     * (pad_a + 1)) < 2 ** 31
-        any_prio = bool(np.asarray(prioritized).any())
+        # one host copy of the prioritized column, reused by the any-prio
+        # check, the split mask, and the occupy-granted counting below
+        prio_np = np.asarray(prioritized)
+        any_prio = bool(prio_np.any())
         now = self.clock.now_ms() if at_ms is None else at_ms
 
         # ---- per-event split (occupy state re-verified under the lock
@@ -2059,7 +2243,6 @@ class Sentinel:
             # RELATE rules match on the ID, not the row), no real alt
             # rows, no cluster-fallback bits, not prioritized (only the
             # general side may book); invalid lanes scalar-safe
-            prio_np = np.asarray(prioritized)
             ev_scalar = ((oid_np == 0)
                          & (np.asarray(origin_rows) >= pad_a)
                          & (np.asarray(chain_rows) >= pad_a)
@@ -2154,7 +2337,7 @@ class Sentinel:
             if self._breaker_observers:
                 self._breaker_seq += 1
                 brk = (self._breaker_seq, self._deg.rules,
-                       state.breakers.state)
+                       self._breaker_snapshot_locked())
         start_host_copy((verdicts.allow, verdicts.reason, verdicts.wait_ms)
                         + ((brk[2],) if brk else ()))
         t_disp = 0
@@ -2173,7 +2356,7 @@ class Sentinel:
             if tr:
                 obs.spans.record(tr, "decide.dispatch", t_d0, t_disp, n=n,
                                  note=route.split(".", 1)[1])
-        prio_np_full = np.asarray(prioritized) if any_prio else None
+        prio_np_full = prio_np if any_prio else None
 
         def _read() -> Verdicts:
             out = Verdicts(allow=np.asarray(verdicts.allow)[:n],
@@ -2193,11 +2376,10 @@ class Sentinel:
                         obs.counters.add(obs_keys.OCCUPY_GRANTED, granted)
             if brk is not None:
                 self._diff_and_fire_breakers(
-                    brk[0], brk[1],
-                    [int(s) for s in np.asarray(brk[2][:-1])])
+                    brk[0], brk[1], np.asarray(brk[2][:-1]).tolist())
             return out
 
-        return PendingVerdicts(_read)
+        return self._pending_verdicts(_read)
 
     def _warm_first_fetch_locked(self, dec, batch, times, sys_scalars,
                                  flags, trace_id: int = 0) -> None:
@@ -2220,9 +2402,28 @@ class Sentinel:
         repeat dispatches of each combo, ``compile_cache.
         first_fetch_retry`` each guarded-fetch stall retry, and a traced
         batch records the fetch as a ``decide.first_fetch`` span."""
+        from sentinel_tpu.core.compile_cache import program_key
+        b = int(batch.rows.shape[0])
+
+        def _attempt():
+            throwaway = init_state(self.spec, self.cfg.max_flow_rules,
+                                   self.cfg.max_degrade_rules)
+            warm = batch._replace(valid=np.zeros(b, np.bool_))
+            return jax.block_until_ready(
+                dec(self._ruleset, throwaway, warm, times, sys_scalars,
+                    **flags))
+
+        self._warm_first_fetch_key_locked(
+            program_key("decide", id(dec), (b,), flags), _attempt,
+            f"decide step (B={b})", trace_id, b)
+
+    def _warm_first_fetch_key_locked(self, key, attempt, what: str,
+                                     trace_id: int, n: int) -> None:
+        """Shared guard body for :meth:`_warm_first_fetch_locked` and the
+        fused decide+exit path: first-dispatch membership + hit/miss
+        counters, then ``attempt`` (an IDEMPOTENT throwaway execution of
+        the exact program) under the guarded fetch policy."""
         obs = self.obs
-        key = (id(dec), int(batch.rows.shape[0]),
-               tuple(sorted(flags.items())))
         hit = key in self._fetched_programs
         if obs.enabled:
             obs.counters.add(obs_keys.CACHE_HIT if hit
@@ -2237,48 +2438,72 @@ class Sentinel:
             # combo still counts as fetched for hit/miss accounting
             self._fetched_programs.add(key)
             return
-
-        def _attempt():
-            throwaway = init_state(self.spec, self.cfg.max_flow_rules,
-                                   self.cfg.max_degrade_rules)
-            warm = batch._replace(
-                valid=np.zeros(int(batch.valid.shape[0]), np.bool_))
-            return jax.block_until_ready(
-                dec(self._ruleset, throwaway, warm, times, sys_scalars,
-                    **flags))
-
         t0 = obs.spans.now_ns() if trace_id else 0
         guarded_first_fetch(
-            _attempt, f"decide step (B={int(batch.rows.shape[0])})",
-            timeout_s, retries,
+            attempt, what, timeout_s, retries,
             on_retry=((lambda: obs.counters.add(obs_keys.CACHE_RETRY))
                       if obs.enabled else None))
         if trace_id:
             obs.spans.record(trace_id, "decide.first_fetch", t0,
-                             obs.spans.now_ns(),
-                             n=int(batch.rows.shape[0]))
+                             obs.spans.now_ns(), n=n)
         self._fetched_programs.add(key)
+
+    # below this padded size, staging buys nothing: the per-call entry
+    # tier pads to b=8..256 and its allocation cost is noise, while the
+    # ring would become shared mutable state for every concurrent
+    # entry() thread
+    _STAGING_MIN_B = 512
 
     def _build_entry_batch(self, rows, origin_ids, origin_rows, context_ids,
                            chain_rows, acquire, is_in, prioritized, vfull,
                            param_rules, param_keys, cluster_fallback,
                            count_thread, record_block) -> EntryBatch:
         """Pad raw numpy event arrays into a device EntryBatch (shared by
-        the whole-batch and split dispatch paths)."""
+        the whole-batch, split, and fused dispatch paths).
+
+        Serving-sized batches fill a preallocated staging slot
+        (``_StagingRing``) in place of ~9 fresh allocations per step;
+        the rare optional columns (param pairs, cluster bits, thread
+        counting, block recording) stay freshly allocated."""
         n = rows.shape[0]
         b = self._pad(n)
         pad_r = self.spec.rows
         pad_a = self.spec.alt_rows
+        if self._staging_on and b >= self._STAGING_MIN_B:
+            ring = self._staging.get(b)
+            if ring is None:
+                ring = self._staging.setdefault(
+                    b, _StagingRing(b, self._staging_depth))
+            s = ring.next()
+            rows_c = _pad_into(s["rows"], rows, pad_r)
+            origin_ids_c = _pad_into(s["origin_ids"], origin_ids, 0)
+            origin_rows_c = _pad_into(s["origin_rows"], origin_rows, pad_a)
+            context_ids_c = _pad_into(s["context_ids"], context_ids, 0)
+            chain_rows_c = _pad_into(s["chain_rows"], chain_rows, pad_a)
+            acquire_c = _pad_into(s["acquire"], acquire, 0)
+            is_in_c = _pad_into(s["is_in"], is_in, False)
+            prio_c = _pad_into(s["prioritized"], prioritized, False)
+            valid_c = _pad_into(s["valid"], vfull, False)
+        else:
+            rows_c = _pad_to(rows, b, pad_r, np.int32)
+            origin_ids_c = _pad_to(origin_ids, b, 0, np.int32)
+            origin_rows_c = _pad_to(origin_rows, b, pad_a, np.int32)
+            context_ids_c = _pad_to(context_ids, b, 0, np.int32)
+            chain_rows_c = _pad_to(chain_rows, b, pad_a, np.int32)
+            acquire_c = _pad_to(acquire, b, 0, np.int32)
+            is_in_c = _pad_to(is_in, b, False, np.bool_)
+            prio_c = _pad_to(prioritized, b, False, np.bool_)
+            valid_c = _pad_to(vfull, b, False, np.bool_)
         return EntryBatch(
-            rows=_pad_to(rows, b, pad_r, np.int32),
-            origin_ids=_pad_to(origin_ids, b, 0, np.int32),
-            origin_rows=_pad_to(origin_rows, b, pad_a, np.int32),
-            context_ids=_pad_to(context_ids, b, 0, np.int32),
-            chain_rows=_pad_to(chain_rows, b, pad_a, np.int32),
-            acquire=_pad_to(acquire, b, 0, np.int32),
-            is_in=_pad_to(is_in, b, False, np.bool_),
-            prioritized=_pad_to(prioritized, b, False, np.bool_),
-            valid=_pad_to(vfull, b, False, np.bool_),
+            rows=rows_c,
+            origin_ids=origin_ids_c,
+            origin_rows=origin_rows_c,
+            context_ids=context_ids_c,
+            chain_rows=chain_rows_c,
+            acquire=acquire_c,
+            is_in=is_in_c,
+            prioritized=prio_c,
+            valid=valid_c,
             param_rules=self._pad_pairs(param_rules, b,
                                         self.cfg.max_param_rules),
             param_keys=self._pad_pairs(param_keys, b, self.spec.param_keys),
@@ -2394,7 +2619,7 @@ class Sentinel:
             if self._breaker_observers:
                 self._breaker_seq += 1
                 brk = (self._breaker_seq, self._deg.rules,
-                       state.breakers.state)
+                       self._breaker_snapshot_locked())
         start_host_copy((v1.allow, v1.reason, v1.wait_ms,
                          v2.allow, v2.reason, v2.wait_ms)
                         + ((brk[2],) if brk else ()))
@@ -2426,17 +2651,208 @@ class Sentinel:
                                      n=n)
                 if any_prio:
                     granted = int(np.count_nonzero(
-                        allow[idx_g] & (wait[idx_g] > 0)
-                        & np.asarray(prio_g)))
+                        allow[idx_g] & (wait[idx_g] > 0) & prio_g))
                     if granted:
                         obs.counters.add(obs_keys.OCCUPY_GRANTED, granted)
             if brk is not None:
                 self._diff_and_fire_breakers(
-                    brk[0], brk[1],
-                    [int(s) for s in np.asarray(brk[2][:-1])])
+                    brk[0], brk[1], np.asarray(brk[2][:-1]).tolist())
             return Verdicts(allow=allow, reason=reason, wait_ms=wait)
 
-        return PendingVerdicts(_read)
+        return self._pending_verdicts(_read)
+
+    def decide_and_exit_raw_nowait(
+            self, rows, origin_ids, origin_rows, context_ids, chain_rows,
+            acquire, is_in, prioritized, *, exit_rows,
+            exit_origin_rows=None, exit_chain_rows=None, exit_acquire=None,
+            exit_rt_ms=None, exit_error=None, exit_is_in=None,
+            exit_valid=None, valid=None, at_ms: Optional[int] = None,
+            trace_id: int = 0) -> "PendingVerdicts":
+        """Fused decide+exit dispatch: ONE device program runs this step's
+        entry decisions and records the previous step's completions
+        (engine/pipeline.py ``decide_and_record_exits`` — exits land
+        after decides, bit-identical to the decide-then-exit call pair).
+        The allow-then-exit serving loop collapses its two dispatches per
+        step into one; at the measured ~2.4 ms per-dispatch floor that is
+        the whole point.
+
+        Scope: the fused program covers the raw decide/exit columns only.
+        Call sites needing param-flow pairs, cluster token delegation,
+        host gates, per-event split routing, or exit-side thread-pair
+        accounting keep the two-call form (``entry_batch_nowait`` +
+        ``exit_batch``) — those tiers do host work between the halves
+        that a single program cannot express. Exit columns default to the
+        trivial padding (no origins, acquire=1, rt=0, no errors) so the
+        common "report last step's completions" call stays short."""
+        n = rows.shape[0]
+        n_x = exit_rows.shape[0]
+        obs = self.obs
+        obs_on = obs.enabled
+        tr = trace_id if trace_id else (obs.spans.maybe_trace()
+                                        if obs_on else 0)
+        t_d0 = obs.spans.now_ns() if obs_on else 0
+        pad_a = self.spec.alt_rows
+        vfull = np.ones(n, np.bool_)
+        if valid is not None:
+            vsrc = np.asarray(valid, bool)
+            m = min(n, vsrc.shape[0])
+            vfull[:] = False
+            vfull[:m] = vsrc[:m]
+        acq_np = np.asarray(acquire)
+        oid_np = np.asarray(origin_ids)
+        acq_v = acq_np if valid is None else acq_np[vfull]
+        acq_uniform = (acq_v.size > 0
+                       and int(acq_v.min()) == int(acq_v.max()) >= 1)
+        oid_v = oid_np if valid is None else oid_np[vfull]
+        no_origin_ids = int(np.max(oid_v, initial=0)) == 0
+        key_fits = (self._ruleset.flow_table.active.shape[0]
+                    * (pad_a + 1)) < 2 ** 31
+        prio_np = np.asarray(prioritized)
+        any_prio = bool(prio_np.any())
+        now = self.clock.now_ms() if at_ms is None else at_ms
+
+        # record_alt is shared by both fused halves: the no-alt scatter
+        # elision is legal only when NEITHER side carries real alt rows
+        # (defaulted exit columns are all padding)
+        empty = np.empty(0, np.int32)
+        no_alt = (self._batch_has_no_alt(origin_rows, chain_rows)
+                  and self._batch_has_no_alt(
+                      exit_origin_rows if exit_origin_rows is not None
+                      else empty,
+                      exit_chain_rows if exit_chain_rows is not None
+                      else empty))
+
+        batch = self._build_entry_batch(
+            rows, origin_ids, origin_rows, context_ids, chain_rows,
+            acquire, is_in, prioritized, vfull, None, None, None, None,
+            None)
+        b_x = self._pad(n_x)
+        xbatch = ExitBatch(
+            rows=_pad_to(exit_rows, b_x, self.spec.rows, np.int32),
+            origin_rows=(_pad_to(exit_origin_rows, b_x, pad_a, np.int32)
+                         if exit_origin_rows is not None
+                         else np.full(b_x, pad_a, np.int32)),
+            chain_rows=(_pad_to(exit_chain_rows, b_x, pad_a, np.int32)
+                        if exit_chain_rows is not None
+                        else np.full(b_x, pad_a, np.int32)),
+            acquire=(_pad_to(exit_acquire, b_x, 0, np.int32)
+                     if exit_acquire is not None
+                     else _pad_to(np.ones(n_x, np.int32), b_x, 0, np.int32)),
+            rt_ms=(_pad_to(exit_rt_ms, b_x, 0, np.int32)
+                   if exit_rt_ms is not None else np.zeros(b_x, np.int32)),
+            error=(_pad_to(exit_error, b_x, False, np.bool_)
+                   if exit_error is not None else np.zeros(b_x, np.bool_)),
+            is_in=(_pad_to(exit_is_in, b_x, False, np.bool_)
+                   if exit_is_in is not None
+                   else _pad_to(np.ones(n_x, np.bool_), b_x, False,
+                                np.bool_)),
+            valid=(_pad_to(exit_valid, b_x, False, np.bool_)
+                   if exit_valid is not None
+                   else _pad_to(np.ones(n_x, np.bool_), b_x, False,
+                                np.bool_)),
+        )
+        times = self._time_scalars(now)
+        load1, cpu = self._cpu.sample()
+        sys_scalars = jnp.asarray(np.array([load1, cpu], np.float32))
+        with self._lock:
+            self._drain_evictions_locked()
+            self._seen_idx = max(self._seen_idx,
+                                 self.spec.second.index_of(now))
+            if any_prio:
+                self._occupy_live_until_ms = now + (
+                    (self.spec.second.buckets + 1)
+                    * self.spec.second.win_ms)
+            use_occ = any_prio or now < self._occupy_live_until_ms
+            # variant order mirrors the decide set: (occ,alt) =
+            # (F,T),(T,T),(F,F),(T,F)
+            fused = self._jit_fused_steps[(2 if no_alt else 0)
+                                          + (1 if use_occ else 0)]
+            flags = {"skip_auth": self._skip_auth,
+                     "skip_sys": self._skip_sys,
+                     "skip_threads": self._skip_threads}
+            if no_alt and no_origin_ids and not any_prio and acq_uniform:
+                flags["scalar_flow"] = True
+                flags["scalar_has_rl"] = self._scalar_has_rl
+            elif acq_uniform and key_fits:
+                flags["fast_flow"] = True
+                flags["scalar_has_rl"] = self._scalar_has_rl
+            self._warm_fused_first_fetch_locked(fused, batch, xbatch, times,
+                                                sys_scalars, flags,
+                                                trace_id=tr)
+            with obs.annotate("sentinel_tpu.fused"):
+                state, verdicts = fused(
+                    self._ruleset, self._state, batch, xbatch, times,
+                    sys_scalars, **flags)
+            self._state = state
+            brk = None
+            if self._breaker_observers:
+                self._breaker_seq += 1
+                brk = (self._breaker_seq, self._deg.rules,
+                       self._breaker_snapshot_locked())
+        start_host_copy((verdicts.allow, verdicts.reason, verdicts.wait_ms)
+                        + ((brk[2],) if brk else ()))
+        t_disp = 0
+        if obs_on:
+            if "scalar_flow" in flags:
+                route = obs_keys.ROUTE_SCALAR
+            elif "fast_flow" in flags:
+                route = (obs_keys.ROUTE_FAST_OCCUPY if use_occ
+                         else obs_keys.ROUTE_FAST)
+            else:
+                route = obs_keys.ROUTE_GENERAL
+            obs.counters.add(obs_keys.ROUTE_FUSED)
+            t_disp = obs.spans.now_ns()
+            if tr:
+                obs.spans.record(tr, "fused.dispatch", t_d0, t_disp, n=n,
+                                 note=f"{route.split('.', 1)[1]} "
+                                      f"exits={n_x}")
+        prio_np_full = prio_np if any_prio else None
+
+        def _read() -> Verdicts:
+            out = Verdicts(allow=np.asarray(verdicts.allow)[:n],
+                           reason=np.asarray(verdicts.reason)[:n],
+                           wait_ms=np.asarray(verdicts.wait_ms)[:n])
+            if obs_on:
+                t_end = obs.spans.now_ns()
+                obs.hist_dispatch.record(t_end - t_disp)
+                if tr:
+                    obs.spans.record(tr, "fused.device", t_disp, t_end,
+                                     n=n)
+                if prio_np_full is not None:
+                    granted = int(np.count_nonzero(
+                        out.allow & (out.wait_ms > 0)
+                        & prio_np_full[:n]))
+                    if granted:
+                        obs.counters.add(obs_keys.OCCUPY_GRANTED, granted)
+            if brk is not None:
+                self._diff_and_fire_breakers(
+                    brk[0], brk[1], np.asarray(brk[2][:-1]).tolist())
+            return out
+
+        return self._pending_verdicts(_read)
+
+    def _warm_fused_first_fetch_locked(self, fused, batch, xbatch, times,
+                                       sys_scalars, flags,
+                                       trace_id: int = 0) -> None:
+        """First-fetch guard for the fused decide+exit program (same
+        policy as :meth:`_warm_first_fetch_locked`; the fused program is
+        keyed on BOTH padded geometries)."""
+        from sentinel_tpu.core.compile_cache import program_key
+        b_e = int(batch.rows.shape[0])
+        b_x = int(xbatch.rows.shape[0])
+
+        def _attempt():
+            throwaway = init_state(self.spec, self.cfg.max_flow_rules,
+                                   self.cfg.max_degrade_rules)
+            warm_e = batch._replace(valid=np.zeros(b_e, np.bool_))
+            warm_x = xbatch._replace(valid=np.zeros(b_x, np.bool_))
+            return jax.block_until_ready(
+                fused(self._ruleset, throwaway, warm_e, warm_x, times,
+                      sys_scalars, **flags))
+
+        self._warm_first_fetch_key_locked(
+            program_key("fused", id(fused), (b_e, b_x), flags), _attempt,
+            f"fused decide+exit step (B={b_e}/{b_x})", trace_id, b_e)
 
     def exit_batch(self, *, rows, origin_rows, chain_rows, acquire, rt_ms,
                    error, is_in, param_rules=None, param_keys=None,
@@ -2490,7 +2906,7 @@ class Sentinel:
             if self._breaker_observers:
                 self._breaker_seq += 1
                 brk = (self._breaker_seq, self._deg.rules,
-                       self._state.breakers.state)
+                       self._breaker_snapshot_locked())
         # unpin only AFTER the device-side decrement is enqueued (entry-side
         # pin discipline: resolve→pin, decide, exit-decrement→unpin)
         if unpin is not None:
@@ -2500,7 +2916,7 @@ class Sentinel:
                              n=n)
         if brk is not None:
             self._diff_and_fire_breakers(
-                brk[0], brk[1], [int(s) for s in np.asarray(brk[2][:-1])])
+                brk[0], brk[1], np.asarray(brk[2][:-1]).tolist())
 
     def _drain_evictions_locked(self) -> None:
         ev_keys, overrides = self.param_key_registry.drain_updates()
@@ -2691,7 +3107,7 @@ class Sentinel:
 
     def breaker_states(self) -> List[int]:
         with self._lock:
-            return [int(s) for s in np.asarray(self._state.breakers.state[:-1])]
+            return np.asarray(self._state.breakers.state[:-1]).tolist()
 
     def add_breaker_observer(self, fn) -> None:
         """Register ``fn(resource, prev_state, new_state)`` for circuit-
@@ -2795,8 +3211,10 @@ class Sentinel:
             self._breaker_seq += 1
             seq = self._breaker_seq
             rules_snap = self._deg.rules
-            states_dev = self._state.breakers.state
-        states = [int(s) for s in np.asarray(states_dev[:-1])]
+            # materialize under the lock: with donation on, the state
+            # could be consumed by a concurrent dispatch the moment the
+            # lock is released
+            states = np.asarray(self._state.breakers.state[:-1]).tolist()
         return self._diff_and_fire_breakers(seq, rules_snap, states)
 
     def breaker_resources(self) -> List[Tuple[str, int]]:
@@ -2805,8 +3223,7 @@ class Sentinel:
         snapshotted under one lock so a concurrent rule reload can't pair
         new rules with another generation's states."""
         with self._lock:
-            states = [int(s)
-                      for s in np.asarray(self._state.breakers.state[:-1])]
+            states = np.asarray(self._state.breakers.state[:-1]).tolist()
             rules = list(self._deg.rules)
         return [(r.resource, states[j]) for j, r in enumerate(rules)
                 if j < len(states)]

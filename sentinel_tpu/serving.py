@@ -1,0 +1,193 @@
+"""Depth-k dispatch pipelining over the runtime's nowait tier.
+
+The synchronous serving loop — ``entry_batch_nowait(...).result()`` per
+step — pays the full host dispatch cost (~2.4 ms measured floor,
+BENCH_r05) on every batch: the host prepares batch N, dispatches it,
+then idles until N's verdicts materialize before touching N+1.
+:class:`DispatchPipeline` keeps up to ``depth`` batches in flight:
+``submit`` dispatches batch N+1 while N still runs on device and
+settles N-k only when the window is full, so the host's prep/dispatch
+cost overlaps device execution instead of adding to it.
+
+Ordering semantics are UNCHANGED from the sequential loop: the runtime
+advances engine state at dispatch time under its own lock (submission
+order == state order), and the pipeline settles handles strictly in
+submission order — ``PipelinedVerdicts.result()`` for batch N first
+settles every older in-flight batch, so deferred host bookkeeping
+(blocked-pin release, block log, breaker diffs) also lands in dispatch
+order. ``tests/test_dispatch_pipeline.py`` pins
+``pipelined(depth=k) == sequential`` bit-parity.
+
+Self-telemetry (obs/): ``pipeline.enqueue`` / ``pipeline.settle`` spans
+on sampled batches, ``pipeline.depth`` (sum of in-flight counts at each
+enqueue — divide by enqueues for the achieved average depth) and
+``pipeline.stall`` (submits that had to settle the oldest batch first)
+counters. Knob: ``SENTINEL_PIPELINE_DEPTH`` (default 2).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Optional
+
+from sentinel_tpu.obs import counters as obs_keys
+from sentinel_tpu.runtime import (   # noqa: F401 - re-exported knob
+    PIPELINE_DEPTH_ENV, PendingVerdicts, Sentinel, pipeline_depth,
+)
+
+_MISSING = object()
+
+
+class PipelinedVerdicts:
+    """Ticket for one submitted batch: ``result()`` settles every older
+    in-flight batch first (strict in-order settle), then memoizes this
+    batch's :class:`~sentinel_tpu.engine.pipeline.Verdicts`. Safe to call
+    out of submission order and more than once."""
+
+    __slots__ = ("_pipe", "_seq", "_done", "_res")
+
+    def __init__(self, pipe: "DispatchPipeline", seq: int):
+        self._pipe = pipe
+        self._seq = seq
+        self._done = False
+        self._res = None
+
+    @property
+    def seq(self) -> int:
+        return self._seq
+
+    def result(self):
+        if not self._done:
+            self._res = self._pipe._settle_through(self._seq)
+            self._done = True
+            self._pipe = None
+        return self._res
+
+
+class DispatchPipeline:
+    """Depth-k dispatch window over one :class:`Sentinel`.
+
+    Typical serving loop (rows pre-interned once via
+    ``Sentinel.intern_resources``)::
+
+        pipe = DispatchPipeline(sentinel)          # depth from env, or pass
+        tickets = collections.deque()
+        for step_rows in traffic:
+            tickets.append(pipe.submit(step_rows))
+            if len(tickets) > pipe.depth:
+                verdicts = tickets.popleft().result()
+                ...
+        pipe.flush()
+
+    ``depth=1`` degenerates to the synchronous loop (every submit settles
+    the previous batch). The pipeline serializes submits under its own
+    lock; use one pipeline per dispatcher thread.
+    """
+
+    def __init__(self, sentinel: Sentinel, depth: Optional[int] = None):
+        self._s = sentinel
+        self.depth = (pipeline_depth() if depth is None
+                      else max(1, int(depth)))
+        self._lock = threading.Lock()
+        # (seq, PendingVerdicts) in submission order
+        self._inflight: "collections.deque" = collections.deque()
+        # seq → settled Verdicts awaiting its ticket's result()
+        self._results: dict = {}
+        self._next_seq = 0
+
+    # ------------------------------------------------------------------
+    # submission
+    # ------------------------------------------------------------------
+
+    def submit(self, resources, **entry_kwargs) -> PipelinedVerdicts:
+        """Dispatch one entry batch through
+        :meth:`Sentinel.entry_batch_nowait` (all its kwargs pass
+        through: origins, acquire, prioritized, args_list, ...)."""
+        n = len(resources)
+        return self._submit(
+            lambda: self._s.entry_batch_nowait(resources, **entry_kwargs), n)
+
+    def submit_raw(self, *args, **kwargs) -> PipelinedVerdicts:
+        """Dispatch through :meth:`Sentinel.decide_raw_nowait` (the
+        registry-free tier: pre-resolved rows/ids in, verdicts out)."""
+        n = args[0].shape[0] if args else 0
+        return self._submit(
+            lambda: self._s.decide_raw_nowait(*args, **kwargs), n)
+
+    def submit_fused(self, *args, **kwargs) -> PipelinedVerdicts:
+        """Dispatch through :meth:`Sentinel.decide_and_exit_raw_nowait`:
+        this step's decides and the previous step's completions in ONE
+        device program (see its docstring for the applicability scope)."""
+        n = args[0].shape[0] if args else 0
+        return self._submit(
+            lambda: self._s.decide_and_exit_raw_nowait(*args, **kwargs), n)
+
+    def _submit(self, dispatch, n: int) -> PipelinedVerdicts:
+        obs = self._s.obs
+        obs_on = obs.enabled
+        tr = obs.spans.maybe_trace() if obs_on else 0
+        t0 = obs.spans.now_ns() if tr else 0
+        with self._lock:
+            # make room BEFORE dispatching: settling the oldest here (a
+            # stall) keeps at most `depth` batches in flight and bounds
+            # how long deferred bookkeeping can wait
+            while len(self._inflight) >= self.depth:
+                if obs_on:
+                    obs.counters.add(obs_keys.PIPE_STALL)
+                self._settle_oldest_locked()
+            handle = dispatch()
+            seq = self._next_seq
+            self._next_seq += 1
+            self._inflight.append((seq, handle))
+            if obs_on:
+                obs.counters.add(obs_keys.PIPE_DEPTH, len(self._inflight))
+        if tr:
+            obs.spans.record(tr, "pipeline.enqueue", t0, obs.spans.now_ns(),
+                             n=n, note=f"seq={seq}")
+        return PipelinedVerdicts(self, seq)
+
+    # ------------------------------------------------------------------
+    # settlement
+    # ------------------------------------------------------------------
+
+    def _settle_oldest_locked(self) -> None:
+        seq, handle = self._inflight.popleft()
+        obs = self._s.obs
+        tr = obs.spans.maybe_trace() if obs.enabled else 0
+        t0 = obs.spans.now_ns() if tr else 0
+        self._results[seq] = handle.result()
+        if tr:
+            obs.spans.record(tr, "pipeline.settle", t0, obs.spans.now_ns(),
+                             note=f"seq={seq}")
+
+    def _settle_through(self, seq: int):
+        with self._lock:
+            res = self._results.pop(seq, _MISSING)
+            if res is not _MISSING:
+                return res
+            while self._inflight and self._inflight[0][0] <= seq:
+                self._settle_oldest_locked()
+            res = self._results.pop(seq, _MISSING)
+        if res is _MISSING:
+            raise KeyError(f"unknown or already-consumed batch seq {seq}")
+        return res
+
+    @property
+    def in_flight(self) -> int:
+        with self._lock:
+            return len(self._inflight)
+
+    def flush(self) -> None:
+        """Settle every in-flight batch (their verdicts stay claimable
+        via the corresponding tickets)."""
+        with self._lock:
+            while self._inflight:
+                self._settle_oldest_locked()
+
+    def __enter__(self) -> "DispatchPipeline":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.flush()
+        return False

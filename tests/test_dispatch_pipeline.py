@@ -1,0 +1,347 @@
+"""Depth-k dispatch pipelining (sentinel_tpu/serving.py) and the fused
+decide+exit program: bit-parity pins against the sequential two-call
+serving loop, strict in-order settle under out-of-order ``result()``
+calls, the leaked-handle GC guard, and host-staging reuse parity.
+
+All quick-tier, CPU: the pipeline changes HOST scheduling only — the
+device-visible dispatch order is pinned unchanged, so every verdict and
+every engine-state leaf must be bit-equal to the synchronous loop."""
+
+import gc
+
+import jax
+import numpy as np
+import pytest
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.obs import counters as obs_keys
+
+T0 = 1_785_000_000_000
+
+
+@pytest.fixture
+def clk():
+    return ManualClock(start_ms=T0)
+
+
+def make(clk, **over):
+    kw = dict(max_resources=64, max_flow_rules=16, max_degrade_rules=16,
+              max_authority_rules=16, minute_enabled=True)
+    kw.update(over)
+    return stpu.Sentinel(config=stpu.load_config(**kw), clock=clk)
+
+
+def _assert_state_equal(s1, s2):
+    for a, b in zip(jax.tree_util.tree_leaves(s1),
+                    jax.tree_util.tree_leaves(s2)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            "state leaf diverged"
+
+
+RULES = [stpu.FlowRule(resource="r0", count=30.0),
+         stpu.FlowRule(resource="r1", count=5.0),
+         stpu.FlowRule(resource="r2", count=12.0)]
+
+
+def _traffic(rng, step):
+    names = [f"r{int(i)}" for i in rng.integers(0, 4, 24)]
+    prio = (rng.random(24) < 0.3) if step % 2 else np.zeros(24, np.bool_)
+    return names, prio
+
+
+# ---------------------------------------------------------------------------
+# bit-parity: pipelined(depth=k) == sequential
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("depth", [1, 2, 3])
+def test_pipelined_matches_sequential(clk, depth):
+    """Interacting steps (QPS rules deplete across batches, prioritized
+    events book occupy slots): every verdict and the full engine state
+    must be bit-equal to the synchronous loop, at any depth."""
+    clk2 = ManualClock(start_ms=T0)
+    seq_s = make(clk)
+    pipe_s = make(clk2)
+    seq_s.load_flow_rules(RULES)
+    pipe_s.load_flow_rules(RULES)
+    rng = np.random.default_rng(7)
+    traffic = [_traffic(rng, step) for step in range(8)]
+
+    seq_out = []
+    for names, prio in traffic:
+        seq_out.append(seq_s.entry_batch_nowait(
+            names, prioritized=prio).result())
+        clk.advance_ms(120)
+
+    pipe = stpu.DispatchPipeline(pipe_s, depth=depth)
+    tickets = []
+    for names, prio in traffic:
+        tickets.append(pipe.submit(names, prioritized=prio))
+        clk2.advance_ms(120)
+    pipe.flush()
+    pipe_out = [t.result() for t in tickets]
+
+    for step, (v1, v2) in enumerate(zip(seq_out, pipe_out)):
+        assert np.array_equal(v1.allow, v2.allow), f"allow @ step {step}"
+        assert np.array_equal(v1.reason, v2.reason), f"reason @ step {step}"
+        assert np.array_equal(v1.wait_ms, v2.wait_ms), \
+            f"wait_ms @ step {step}"
+    _assert_state_equal(seq_s._state, pipe_s._state)
+    for r in ("r0", "r1", "r2"):
+        assert seq_s.node_totals(r) == pipe_s.node_totals(r)
+
+
+def test_pipelined_origin_batches_match(clk):
+    """Origin-bearing traffic (alt-row scatters live) through the
+    pipeline: same parity bar."""
+    clk2 = ManualClock(start_ms=T0)
+    seq_s = make(clk)
+    pipe_s = make(clk2)
+    rules = [stpu.FlowRule(resource="r1", count=8.0, limit_app="app-a")]
+    seq_s.load_flow_rules(rules)
+    pipe_s.load_flow_rules(rules)
+    rng = np.random.default_rng(8)
+    traffic = []
+    for _ in range(6):
+        names = [f"r{int(i)}" for i in rng.integers(0, 3, 16)]
+        origins = [("app-a" if rng.random() < 0.5 else "app-b")
+                   for _ in names]
+        traffic.append((names, origins))
+
+    seq_out = [seq_s.entry_batch_nowait(n, origins=o).result()
+               for n, o in traffic]
+    with stpu.DispatchPipeline(pipe_s, depth=2) as pipe:
+        tickets = [pipe.submit(n, origins=o) for n, o in traffic]
+        pipe_out = [t.result() for t in tickets]
+
+    for v1, v2 in zip(seq_out, pipe_out):
+        assert np.array_equal(v1.allow, v2.allow)
+        assert np.array_equal(v1.wait_ms, v2.wait_ms)
+    _assert_state_equal(seq_s._state, pipe_s._state)
+
+
+# ---------------------------------------------------------------------------
+# fused decide+exit == decide-then-exit
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("with_origins", [False, True])
+def test_fused_matches_decide_then_exit(clk, with_origins):
+    """One fused program per step vs the two-dispatch form: verdicts AND
+    every state leaf bit-equal across interacting steps (the fused exits
+    land after the decides, exactly like the separate exit dispatch)."""
+    clk2 = ManualClock(start_ms=T0)
+    two_s = make(clk)
+    fus_s = make(clk2)
+    two_s.load_flow_rules(RULES)
+    fus_s.load_flow_rules(RULES)
+    rng = np.random.default_rng(9)
+    n = 16
+    pad_a = two_s.spec.alt_rows
+
+    def cols(sph):
+        rows = np.asarray([sph.resources.get_or_create(f"r{int(i)}")
+                           for i in rng.integers(0, 3, n)], np.int32)
+        if with_origins:
+            oid = sph.origins.pin("app-a")
+            origin_ids = np.full(n, oid, np.int32)
+            origin_rows = np.asarray(
+                [sph._alt_row(int(r), 0, oid) for r in rows], np.int32)
+        else:
+            origin_ids = np.zeros(n, np.int32)
+            origin_rows = np.full(n, pad_a, np.int32)
+        return rows, origin_ids, origin_rows
+
+    ones = np.ones(n, np.int32)
+    is_in = np.ones(n, np.bool_)
+    no_prio = np.zeros(n, np.bool_)
+    ctx0 = np.zeros(n, np.int32)
+    crow = np.full(n, pad_a, np.int32)
+    prev = None     # (rows, origin_rows, rt, err) of the previous step
+    for step in range(6):
+        rng_state = rng.bit_generator.state
+        r1, oid1, orow1 = cols(two_s)
+        rng.bit_generator.state = rng_state
+        r2, oid2, orow2 = cols(fus_s)
+        assert np.array_equal(r1, r2)
+        rt = rng.integers(1, 50, n).astype(np.int32)
+        err = (rng.random(n) < 0.3)
+
+        # two-call form: exits (previous completions) BEFORE this step's
+        # decide would reorder state vs the fused program, so mirror the
+        # fused ordering: decide first, then record the previous exits —
+        # exactly what decide_and_record_exits fuses
+        h = two_s.decide_raw_nowait(r1, oid1, orow1, ctx0, crow, ones,
+                                    is_in, no_prio)
+        if prev is not None:
+            two_s.exit_batch(rows=prev[0], origin_rows=prev[1],
+                             chain_rows=crow, acquire=ones,
+                             rt_ms=prev[2], error=prev[3], is_in=is_in)
+        v1 = h.result()
+
+        if prev is not None:
+            h2 = fus_s.decide_and_exit_raw_nowait(
+                r2, oid2, orow2, ctx0, crow, ones, is_in, no_prio,
+                exit_rows=prev[0], exit_origin_rows=prev[1],
+                exit_chain_rows=crow, exit_acquire=ones,
+                exit_rt_ms=prev[2], exit_error=prev[3], exit_is_in=is_in)
+        else:
+            h2 = fus_s.decide_raw_nowait(r2, oid2, orow2, ctx0, crow,
+                                         ones, is_in, no_prio)
+        v2 = h2.result()
+
+        assert np.array_equal(v1.allow, v2.allow), f"allow @ step {step}"
+        assert np.array_equal(v1.wait_ms, v2.wait_ms)
+        assert np.array_equal(v1.reason, v2.reason)
+        prev = (r1, orow1, rt, err)
+        clk.advance_ms(130)
+        clk2.advance_ms(130)
+    # flush the trailing exits on both so the final states align
+    two_s.exit_batch(rows=prev[0], origin_rows=prev[1], chain_rows=crow,
+                     acquire=ones, rt_ms=prev[2], error=prev[3],
+                     is_in=is_in)
+    fus_s.exit_batch(rows=prev[0], origin_rows=prev[1], chain_rows=crow,
+                     acquire=ones, rt_ms=prev[2], error=prev[3],
+                     is_in=is_in)
+    _assert_state_equal(two_s._state, fus_s._state)
+
+
+def test_fused_counts_route_counter(clk):
+    sph = make(clk)
+    rows = np.asarray([sph.resources.get_or_create("x")], np.int32)
+    pad_a = sph.spec.alt_rows
+    one = np.ones(1, np.int32)
+    h = sph.decide_and_exit_raw_nowait(
+        rows, np.zeros(1, np.int32), np.full(1, pad_a, np.int32),
+        np.zeros(1, np.int32), np.full(1, pad_a, np.int32), one,
+        np.ones(1, np.bool_), np.zeros(1, np.bool_), exit_rows=rows)
+    assert bool(h.result().allow[0])
+    assert sph.obs.counters.get(obs_keys.ROUTE_FUSED) == 1
+
+
+# ---------------------------------------------------------------------------
+# in-order settle + pipeline counters
+# ---------------------------------------------------------------------------
+
+def test_in_order_settle_under_out_of_order_results(clk):
+    """Calling the LAST ticket's result() first must settle every older
+    handle first — deferred bookkeeping lands in dispatch order."""
+    sph = make(clk)
+    pipe = stpu.DispatchPipeline(sph, depth=4)
+    tickets = [pipe.submit(["a", "b"]) for _ in range(3)]
+    order = []
+    with pipe._lock:
+        for seq, h in pipe._inflight:
+            fn = h._cell.fn
+
+            def spied(f=fn, s=seq):
+                order.append(s)
+                return f()
+            h._cell.fn = spied
+    v_last = tickets[2].result()
+    assert order == [0, 1, 2]
+    assert np.array_equal(tickets[0].result().allow, v_last.allow)
+    # ticket results are memoized
+    assert tickets[2].result() is v_last
+
+
+def test_pipeline_counters_and_stall(clk):
+    sph = make(clk)
+    pipe = stpu.DispatchPipeline(sph, depth=2)
+    for _ in range(5):
+        pipe.submit(["a"])
+    pipe.flush()
+    c = sph.obs.counters
+    # depth sum: 1 + 2 + 2 + 2 + 2; stalls on submits 3..5
+    assert c.get(obs_keys.PIPE_DEPTH) == 9
+    assert c.get(obs_keys.PIPE_STALL) == 3
+    assert pipe.in_flight == 0
+
+
+def test_pipeline_depth_env_knob(clk, monkeypatch):
+    monkeypatch.setenv(stpu.serving.PIPELINE_DEPTH_ENV, "5")
+    assert stpu.pipeline_depth() == 5
+    sph = make(clk)
+    assert stpu.DispatchPipeline(sph).depth == 5
+    monkeypatch.setenv(stpu.serving.PIPELINE_DEPTH_ENV, "not-a-number")
+    assert stpu.pipeline_depth() == 2
+
+
+# ---------------------------------------------------------------------------
+# leaked-handle guard
+# ---------------------------------------------------------------------------
+
+def test_leaked_handle_settled_and_counted(clk):
+    """Dropping a handle without result() must still run its deferred
+    bookkeeping (the block log write below) and bump the leak counter."""
+    sph = make(clk)
+    sph.load_flow_rules([stpu.FlowRule(resource="q", count=1.0)])
+    h = sph.entry_batch_nowait(["q", "q", "q"])
+    del h
+    gc.collect()
+    assert sph.obs.counters.get(obs_keys.PIPE_LEAKED) == 1
+    # a consumed handle must NOT count as leaked
+    h2 = sph.entry_batch_nowait(["q"])
+    h2.result()
+    del h2
+    gc.collect()
+    assert sph.obs.counters.get(obs_keys.PIPE_LEAKED) == 1
+
+
+def test_leaked_nested_handle_counts_once(clk):
+    """entry_batch_nowait wraps decide_raw_nowait's handle — leaking the
+    outer one settles the whole chain exactly once."""
+    sph = make(clk)
+    h = sph.entry_batch_nowait(["a", "b"])
+    del h
+    gc.collect()
+    assert sph.obs.counters.get(obs_keys.PIPE_LEAKED) == 1
+
+
+# ---------------------------------------------------------------------------
+# host staging
+# ---------------------------------------------------------------------------
+
+def test_staging_reuse_parity(clk):
+    """Serving-sized batches reuse preallocated staging slots; verdicts
+    must match a staging-disabled twin re-dispatching fresh arrays."""
+    import sentinel_tpu.runtime as rt
+    clk2 = ManualClock(start_ms=T0)
+    on_s = make(clk)
+    assert on_s._staging_on     # default on
+    off_s = make(clk2)
+    off_s._staging_on = False
+    on_s.load_flow_rules([stpu.FlowRule(resource="r0", count=900.0)])
+    off_s.load_flow_rules([stpu.FlowRule(resource="r0", count=900.0)])
+    rng = np.random.default_rng(11)
+    b = max(600, rt.Sentinel._STAGING_MIN_B + 88)
+    for step in range(4):
+        names = [f"r{int(i)}" for i in rng.integers(0, 3, b)]
+        v1 = on_s.entry_batch_nowait(names).result()
+        v2 = off_s.entry_batch_nowait(names).result()
+        assert np.array_equal(v1.allow, v2.allow), f"step {step}"
+        assert np.array_equal(v1.wait_ms, v2.wait_ms)
+        clk.advance_ms(90)
+        clk2.advance_ms(90)
+    _assert_state_equal(on_s._state, off_s._state)
+    assert on_s._staging, "staging ring was never engaged"
+    assert not off_s._staging
+
+
+def test_staging_ring_rotates_slots(clk):
+    from sentinel_tpu.runtime import _StagingRing
+    ring = _StagingRing(1024, 4)
+    seen = [id(ring.next()["rows"]) for _ in range(8)]
+    assert len(set(seen)) == 4 and seen[:4] == seen[4:]
+
+
+def test_donation_escape_hatch(clk, monkeypatch):
+    """SENTINEL_DONATE=0 keeps the undonated steps working (external
+    callers of the _jit_* steps may re-read their inputs)."""
+    monkeypatch.setenv("SENTINEL_DONATE", "0")
+    sph = make(clk)
+    assert not sph._donate
+    state_before = sph._state
+    v = sph.entry_batch_nowait(["a", "b"]).result()
+    assert v.allow.all()
+    # undonated: the pre-dispatch state's buffers are still readable
+    np.asarray(jax.tree_util.tree_leaves(state_before)[0])
