@@ -375,6 +375,14 @@ def main() -> None:
         "pipeline_depth": _pipeline_depth(),
         "batch": B,
         "resources": R,
+        # serving-mode knob state at measurement time, so BENCH_r0N
+        # artifacts are self-describing (absent key = knob at default)
+        "env_knobs": {k: os.environ[k] for k in (
+            "SENTINEL_PIPELINE_DEPTH", "SENTINEL_DONATE",
+            "SENTINEL_HOST_STAGING", "SENTINEL_FRONTEND_BATCH",
+            "SENTINEL_FRONTEND_DEADLINE_MS", "SENTINEL_FRONTEND_BUDGET_MS",
+            "SENTINEL_FRONTEND_IDLE_MS", "SENTINEL_FRONTEND_QUEUE",
+        ) if k in os.environ},
     }
     # General-path + mixed-batch numbers ride the same artifact (VERDICT
     # r4 #10: the non-happy path must not regress silently). Skippable via

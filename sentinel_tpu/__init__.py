@@ -73,6 +73,9 @@ from sentinel_tpu.runtime import (
     ENTRY_TYPE_IN, ENTRY_TYPE_OUT, Entry, Sentinel, pipeline_depth,
 )
 from sentinel_tpu.serving import DispatchPipeline, PipelinedVerdicts
+from sentinel_tpu.frontend import (
+    AdaptiveBatcher, FrontendClosed, IngestOverload, RequestVerdict,
+)
 
 __version__ = "0.1.0"
 
@@ -95,4 +98,6 @@ __all__ = [
     "snapshot_context", "restore_context",
     "SentinelConfig", "load_config",
     "DispatchPipeline", "PipelinedVerdicts", "pipeline_depth",
+    "AdaptiveBatcher", "RequestVerdict", "IngestOverload",
+    "FrontendClosed",
 ]

@@ -1,0 +1,32 @@
+"""Serving front end: the async ingest tier above the dispatch pipeline.
+
+* frontend/batcher.py — :class:`AdaptiveBatcher`, the deadline-driven
+  adaptive batching loop (flush at ``min(B_max, oldest-deadline)``)
+  with per-request future fan-out over the PR 6 DispatchPipeline;
+* frontend/server.py — aiohttp HTTP endpoint + app factory so a
+  service owner can POST an entry and get a verdict;
+* frontend/workloads.py — the deterministic seeded workload zoo
+  (steady, diurnal, flash crowd, Zipf hot keys, priority mix, slow
+  consumer) that benchmarks/serving_bench.py replays through the real
+  front end.
+
+Operational guide: docs/OPERATIONS.md "Serving front end".
+"""
+
+from sentinel_tpu.frontend.batcher import (
+    FLUSH_DEADLINE, FLUSH_FULL, FLUSH_IDLE, FRONTEND_BATCH_ENV,
+    FRONTEND_BUDGET_ENV, FRONTEND_DEADLINE_ENV, FRONTEND_IDLE_ENV,
+    FRONTEND_QUEUE_ENV, AdaptiveBatcher, FrontendClosed, IngestOverload,
+    IngestQueue, RequestVerdict, frontend_batch_max, frontend_budget_ms,
+    frontend_deadline_ms, frontend_idle_ms, frontend_queue_max,
+)
+
+__all__ = [
+    "AdaptiveBatcher", "IngestQueue", "RequestVerdict",
+    "IngestOverload", "FrontendClosed",
+    "FLUSH_FULL", "FLUSH_DEADLINE", "FLUSH_IDLE",
+    "FRONTEND_BATCH_ENV", "FRONTEND_DEADLINE_ENV", "FRONTEND_BUDGET_ENV",
+    "FRONTEND_IDLE_ENV", "FRONTEND_QUEUE_ENV",
+    "frontend_batch_max", "frontend_deadline_ms", "frontend_budget_ms",
+    "frontend_idle_ms", "frontend_queue_max",
+]

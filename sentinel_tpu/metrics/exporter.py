@@ -20,6 +20,8 @@ Self-telemetry families (from ``Sentinel.obs`` — obs/; absent while
 
     sentinel_rt_p99_ms                     entry→verdict p99 (batch tier)
     sentinel_rt_quantile_ms{quantile=...}  p50 / p95 / p99 of the same
+    sentinel_request_quantile_ms{quantile=...} per-REQUEST ingest→verdict
+                                           through the serving front end
     sentinel_split_route_total{route=...}  dispatch-path decisions
     sentinel_compile_cache_hits_total      program-fetch cache hits
     sentinel_compile_cache_misses_total
@@ -78,6 +80,10 @@ class SentinelCollector:
         quant = GaugeMetricFamily(
             f"{ns}_rt_quantile_ms",
             "entry→verdict latency quantiles (ms)", labels=["quantile"])
+        req_quant = GaugeMetricFamily(
+            f"{ns}_request_quantile_ms",
+            "per-request ingest→verdict latency quantiles through the "
+            "serving front end (ms)", labels=["quantile"])
         route = CounterMetricFamily(
             f"{ns}_split_route",
             "Dispatch-path decisions by route", labels=["route"])
@@ -106,6 +112,9 @@ class SentinelCollector:
                 v = obs.hist_entry.percentile_ms(q)
                 if v is not None:
                     quant.add_metric([f"{q:g}"], v)
+                rv = obs.hist_request.percentile_ms(q)
+                if rv is not None:
+                    req_quant.add_metric([f"{q:g}"], rv)
             for key, fam_key in ((ck.ROUTE_SCALAR, "scalar"),
                                  (ck.ROUTE_FAST, "fast"),
                                  (ck.ROUTE_FAST_OCCUPY, "fast_occupy"),
@@ -123,8 +132,8 @@ class SentinelCollector:
                             (ck.OCCUPY_SETTLED, "settled"),
                             (ck.OCCUPY_EVICTED, "evicted")):
                 occupy.add_metric([ev], counts.get(key, 0))
-        yield from (p99, quant, route, hits, misses, retries, blocks,
-                    occupy)
+        yield from (p99, quant, req_quant, route, hits, misses, retries,
+                    blocks, occupy)
 
     def collect(self):
         ns = self.namespace

@@ -17,7 +17,10 @@ SPMD rules the caller must keep (the engine can't check them for you):
   same order, with the same ``now_ms``;
 * rule loads / connected counts / namespace limits are replayed
   identically on every process BEFORE the step that should see them;
-* the param-flow path (``request_params``) is not wired for multihost.
+* the param-flow path is not wired for multihost —
+  :meth:`MultihostIngest.request_params` raises ``NotImplementedError``
+  at the call site (ROADMAP item 5; operational note in
+  docs/OPERATIONS.md "Known multihost limitations").
 """
 
 from __future__ import annotations
@@ -74,3 +77,17 @@ class MultihostIngest:
                 lanes.rows, lanes.acquire, lanes.prioritized, lanes.valid,
                 lanes.lanes, now_ms=now_ms)
             return eng._gather_results_vec(verdicts, plan, lanes.lanes)
+
+    def request_params(self, *args, **kwargs):
+        """NOT wired for multihost. The param-flow step keys its table
+        by host-interned param values, and those intern tables are
+        process-local — routing them through the sharded step without a
+        cross-process intern agreement would silently diverge per host.
+        Tracked as ROADMAP item 5; single-process callers use
+        ``Sentinel.entry_batch(..., args_list=...)`` directly. See
+        docs/OPERATIONS.md "Known multihost limitations"."""
+        raise NotImplementedError(
+            "param-flow (request_params) is not wired for multihost: "
+            "param intern tables are process-local and would diverge "
+            "across hosts (ROADMAP item 5; docs/OPERATIONS.md 'Known "
+            "multihost limitations')")

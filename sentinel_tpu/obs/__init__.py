@@ -73,7 +73,10 @@ class RuntimeObs:
     Attributes the runtime's instrumentation sites touch directly:
     ``enabled`` (the one hot-path guard), ``spans``, ``counters``,
     ``hist_entry`` (entry→verdict ns), ``hist_dispatch``
-    (dispatch→verdict-ready device ns), ``block_events``."""
+    (dispatch→verdict-ready device ns), ``hist_request`` (per-REQUEST
+    ingest→verdict ns through the serving front end — the end-to-end
+    latency a service owner sees; recorded by frontend/batcher.py),
+    ``block_events``."""
 
     def __init__(self, clock=None, enabled: Optional[bool] = None,
                  sample: Optional[float] = None) -> None:
@@ -85,6 +88,7 @@ class RuntimeObs:
         self.spans = SpanRecorder.for_clock(clock, sample=sample)
         self.hist_entry = LogHistogram()
         self.hist_dispatch = LogHistogram()
+        self.hist_request = LogHistogram()
         self.block_events = BlockEventLog(sample=sample)
         self._closed = False
 
@@ -109,6 +113,7 @@ class RuntimeObs:
             "hist": {
                 "entry_to_verdict": self.hist_entry.snapshot(),
                 "dispatch_device": self.hist_dispatch.snapshot(),
+                "request_to_verdict": self.hist_request.snapshot(),
                 "bucket_bounds_ns": bucket_bounds_ns(),
             },
             "spans": self.spans.snapshot(limit=span_limit),

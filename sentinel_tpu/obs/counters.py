@@ -22,6 +22,12 @@ dispatch stays within the 2% observability budget (benchmarks/ci_gate.py
   that had to settle the oldest in-flight batch first), and
   ``leaked_handles`` (PendingVerdicts settled by the GC finalizer
   because ``.result()`` was never called).
+* ``frontend.*`` — the ingest tier (sentinel_tpu/frontend/):
+  ``enqueue`` (requests accepted), ``queue_depth`` (sum of pending
+  queue length sampled at each enqueue — divide by enqueues for the
+  achieved average depth), ``shed`` (requests rejected at the
+  ``queue_max`` backpressure bound), and ``flush_reason.{full,
+  deadline, idle}`` (why each device batch was cut).
 * ``block_reason.<ExceptionName>`` — per-reason denial breakdown keyed
   by the int8 verdict codes (``exception_name_for`` /
   ``slot_name_for_code`` for custom slots).
@@ -59,6 +65,13 @@ PIPE_DEPTH = "pipeline.depth"
 PIPE_STALL = "pipeline.stall"
 PIPE_LEAKED = "pipeline.leaked_handles"
 
+FE_ENQUEUE = "frontend.enqueue"
+FE_QUEUE_DEPTH = "frontend.queue_depth"
+FE_SHED = "frontend.shed"
+FE_FLUSH_FULL = "frontend.flush_reason.full"
+FE_FLUSH_DEADLINE = "frontend.flush_reason.deadline"
+FE_FLUSH_IDLE = "frontend.flush_reason.idle"
+
 BLOCK_PREFIX = "block_reason."
 
 #: Fixed aggregation catalog (order is the wire format of the multihost
@@ -74,6 +87,8 @@ CATALOG = (
     BLOCK_PREFIX + "ParamFlowException",
     ROUTE_FUSED,
     PIPE_DEPTH, PIPE_STALL, PIPE_LEAKED,
+    FE_ENQUEUE, FE_QUEUE_DEPTH, FE_SHED,
+    FE_FLUSH_FULL, FE_FLUSH_DEADLINE, FE_FLUSH_IDLE,
 )
 
 

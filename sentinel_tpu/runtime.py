@@ -1167,6 +1167,15 @@ class Sentinel:
     def __exit__(self, exc_type, exc, tb) -> None:
         self.close()
 
+    def frontend(self, **kwargs):
+        """A new :class:`~sentinel_tpu.frontend.AdaptiveBatcher` ingest
+        tier over this runtime (kwargs pass through: batch_max,
+        deadline_ms, budget_ms, idle_ms, queue_max, depth, ...). The
+        batcher self-registers with :meth:`register_shutdown`, so
+        :meth:`close` tears it down. One batcher per event loop."""
+        from sentinel_tpu.frontend import AdaptiveBatcher
+        return AdaptiveBatcher(self, **kwargs)
+
     # ------------------------------------------------------------------
     # Time helpers
     # ------------------------------------------------------------------

@@ -85,7 +85,8 @@ class DispatchPipeline:
     lock; use one pipeline per dispatcher thread.
     """
 
-    def __init__(self, sentinel: Sentinel, depth: Optional[int] = None):
+    def __init__(self, sentinel: Sentinel, depth: Optional[int] = None,
+                 on_settle=None):
         self._s = sentinel
         self.depth = (pipeline_depth() if depth is None
                       else max(1, int(depth)))
@@ -95,6 +96,13 @@ class DispatchPipeline:
         # seq → settled Verdicts awaiting its ticket's result()
         self._results: dict = {}
         self._next_seq = 0
+        # on_settle(seq, verdicts): fired after EVERY settle — stall,
+        # result() drain, or flush — so an overlay (the frontend ingest
+        # batcher) learns a batch landed at the earliest possible moment,
+        # whichever call settled it. Called with the pipeline lock held:
+        # keep it quick, and never call back into this pipeline from it
+        # (the frontend hands off via loop.call_soon_threadsafe).
+        self._on_settle = on_settle
 
     # ------------------------------------------------------------------
     # submission
@@ -160,6 +168,8 @@ class DispatchPipeline:
         if tr:
             obs.spans.record(tr, "pipeline.settle", t0, obs.spans.now_ns(),
                              note=f"seq={seq}")
+        if self._on_settle is not None:
+            self._on_settle(seq, self._results[seq])
 
     def _settle_through(self, seq: int):
         with self._lock:

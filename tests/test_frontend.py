@@ -1,0 +1,405 @@
+"""Serving front end (sentinel_tpu/frontend/): the IngestQueue deadline
+policy under the virtual clock, the AdaptiveBatcher's flush triggers and
+per-request fan-out PARITY against the sequential entry_batch loop
+(bit-identical verdicts incl. priority routing and occupy bookings),
+backpressure shed, no-leaked-futures on ``Sentinel.close()``, the
+workload zoo's determinism, and the HTTP endpoint.
+
+All quick-tier, CPU. The asyncio tests run real event loops under
+``asyncio.run`` inside sync tests (the aiohttp-adapter idiom): the
+deadline POLICY is pinned against explicit virtual ``now_ms`` values on
+the pure IngestQueue core, while loop-integration tests only rely on
+real time for "a bounded wait elapsed", never for policy values."""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.frontend import batcher as fe
+from sentinel_tpu.frontend import workloads
+from sentinel_tpu.frontend.batcher import (
+    AdaptiveBatcher, FrontendClosed, IngestOverload, IngestQueue,
+)
+from sentinel_tpu.obs import counters as obs_keys
+
+pytestmark = pytest.mark.quick
+
+T0 = 1_785_000_000_000
+
+
+@pytest.fixture
+def clk():
+    return ManualClock(start_ms=T0)
+
+
+def make(clk, **over):
+    kw = dict(max_resources=64, max_origins=32, max_flow_rules=16,
+              max_degrade_rules=16, max_authority_rules=16,
+              minute_enabled=True)
+    kw.update(over)
+    return stpu.Sentinel(config=stpu.load_config(**kw), clock=clk)
+
+
+def _assert_state_equal(s1, s2):
+    for a, b in zip(jax.tree_util.tree_leaves(s1),
+                    jax.tree_util.tree_leaves(s2)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            "state leaf diverged"
+
+
+def _req(resource="r", count=1, prioritized=False, origin="",
+         deadline_ms=T0 + 25):
+    return fe._Pending(resource, count, prioritized, origin, deadline_ms,
+                       0, None)
+
+
+# ---------------------------------------------------------------------------
+# IngestQueue: the pure deadline policy under explicit virtual time
+# ---------------------------------------------------------------------------
+
+def test_flush_on_full_beats_deadline():
+    q = IngestQueue(batch_max=3, budget_ms=0)
+    for _ in range(2):
+        q.add(_req(deadline_ms=T0 + 100))
+    assert q.flush_reason(T0) is None          # 2 < 3, deadline far
+    q.add(_req(deadline_ms=T0 + 100))
+    assert q.flush_reason(T0) == fe.FLUSH_FULL
+
+def test_flush_on_deadline_minus_budget():
+    q = IngestQueue(batch_max=100, budget_ms=3)
+    q.add(_req(deadline_ms=T0 + 25))
+    assert q.fire_at_ms() == T0 + 22           # deadline − device budget
+    assert q.flush_reason(T0 + 21) is None
+    assert q.flush_reason(T0 + 22) == fe.FLUSH_DEADLINE
+
+
+def test_oldest_deadline_governs():
+    q = IngestQueue(batch_max=100, budget_ms=0)
+    q.add(_req(deadline_ms=T0 + 50))
+    q.add(_req(deadline_ms=T0 + 10))           # tighter budget, later arrival
+    assert q.fire_at_ms() == T0 + 10
+    taken = q.take()
+    assert len(taken) == 2 and q.fire_at_ms() is None
+
+
+def test_take_caps_at_batch_max_and_recomputes_min():
+    q = IngestQueue(batch_max=2, budget_ms=0)
+    for d in (30, 10, 20):
+        q.add(_req(deadline_ms=T0 + d))
+    out = q.take()                             # FIFO: the 30 and the 10
+    assert [r.deadline_ms for r in out] == [T0 + 30, T0 + 10]
+    assert q.fire_at_ms() == T0 + 20           # min recomputed over the rest
+
+
+def test_idle_flush_only_when_reported_idle():
+    q = IngestQueue(batch_max=100, budget_ms=0)
+    q.add(_req(deadline_ms=T0 + 1000))
+    assert q.flush_reason(T0) is None
+    assert q.flush_reason(T0, idle=True) == fe.FLUSH_IDLE
+    assert q.take_all() and q.flush_reason(T0, idle=True) is None  # empty
+
+
+def test_backpressure_bound_counts_inflight():
+    q = IngestQueue(batch_max=4, queue_max=6)
+    for _ in range(4):
+        q.add(_req())
+    assert not q.would_shed(inflight=1)        # 4 + 1 < 6
+    assert q.would_shed(inflight=2)            # 4 + 2 ≥ 6
+
+
+def test_env_knobs(monkeypatch):
+    monkeypatch.setenv(fe.FRONTEND_BATCH_ENV, "64")
+    monkeypatch.setenv(fe.FRONTEND_DEADLINE_ENV, "40")
+    monkeypatch.setenv(fe.FRONTEND_BUDGET_ENV, "5")
+    monkeypatch.setenv(fe.FRONTEND_IDLE_ENV, "2.5")
+    monkeypatch.setenv(fe.FRONTEND_QUEUE_ENV, "100")
+    assert fe.frontend_batch_max() == 64
+    assert fe.frontend_deadline_ms() == 40
+    assert fe.frontend_budget_ms() == 5
+    assert fe.frontend_idle_ms() == 2.5
+    assert fe.frontend_queue_max(64) == 100
+    monkeypatch.setenv(fe.FRONTEND_BATCH_ENV, "not-a-number")
+    assert fe.frontend_batch_max() == 256      # default on parse failure
+
+
+# ---------------------------------------------------------------------------
+# AdaptiveBatcher: flush triggers through the real loop
+# ---------------------------------------------------------------------------
+
+def test_flush_on_full_fans_out(clk):
+    sph = make(clk)
+    sph.load_flow_rules([stpu.FlowRule(resource="api", count=2.0)])
+
+    async def run():
+        b = AdaptiveBatcher(sph, batch_max=4, deadline_ms=10_000,
+                            idle_ms=10_000.0)
+        verdicts = await asyncio.gather(*(b.submit("api") for _ in range(4)))
+        return verdicts
+
+    verdicts = asyncio.run(run())
+    assert [v.allow for v in verdicts] == [True, True, False, False]
+    assert all(v.reason_name == "FlowException"
+               for v in verdicts if not v.allow)
+    c = sph.obs.counters
+    assert c.get(obs_keys.FE_FLUSH_FULL) == 1
+    assert c.get(obs_keys.FE_FLUSH_DEADLINE) == 0
+    assert c.get(obs_keys.FE_ENQUEUE) == 4
+    assert sph.obs.hist_request.count == 4
+    sph.close()
+
+
+def test_flush_on_deadline_when_virtual_clock_advances(clk):
+    """A partial batch must dispatch once the virtual clock passes the
+    head request's fire point — the loop's bounded wait re-checks the
+    policy against the ADVANCED clock, and the reason is recorded as a
+    deadline flush, not an idle one."""
+    sph = make(clk)
+
+    async def run():
+        b = AdaptiveBatcher(sph, batch_max=100, deadline_ms=30, budget_ms=5,
+                            idle_ms=10_000.0)
+        task = asyncio.gather(b.submit("api"), b.submit("api"))
+        await asyncio.sleep(0.005)             # both queued, none flushed
+        assert b.pending == 2
+        clk.advance_ms(40)                     # virtual time passes fire_at
+        return await task
+
+    verdicts = asyncio.run(run())
+    assert all(v.allow for v in verdicts)
+    c = sph.obs.counters
+    assert c.get(obs_keys.FE_FLUSH_DEADLINE) == 1
+    assert c.get(obs_keys.FE_FLUSH_FULL) == 0
+    sph.close()
+
+
+def test_flush_on_idle_gap(clk):
+    """With deadlines far out (virtually) and a short idle gap, a partial
+    batch flushes as an idle flush once arrivals stop."""
+    sph = make(clk)
+
+    async def run():
+        b = AdaptiveBatcher(sph, batch_max=100, deadline_ms=60_000,
+                            idle_ms=2.0)
+        return await asyncio.gather(b.submit("api"), b.submit("api"))
+
+    verdicts = asyncio.run(run())
+    assert all(v.allow for v in verdicts)
+    c = sph.obs.counters
+    assert c.get(obs_keys.FE_FLUSH_IDLE) >= 1
+    assert c.get(obs_keys.FE_FLUSH_DEADLINE) == 0
+    sph.close()
+
+
+# ---------------------------------------------------------------------------
+# parity: front-end verdicts == sequential entry_batch over the same stream
+# ---------------------------------------------------------------------------
+
+def test_batcher_parity_with_sequential_entry_batch(clk):
+    """The tentpole pin: verdicts fanned out of the front end must be
+    bit-identical to a sequential entry_batch loop over the same seeded
+    stream — including priority routing (occupy bookings) and origin
+    alt-rows — and leave the engine in the bit-identical state."""
+    clk2 = ManualClock(start_ms=T0)
+    fe_s = make(clk)
+    seq_s = make(clk2)
+    rules = [stpu.FlowRule(resource="r0", count=6.0),
+             stpu.FlowRule(resource="r1", count=3.0),
+             stpu.FlowRule(resource="r1", count=2.0, limit_app="app-a"),
+             stpu.FlowRule(resource="r2", count=40.0)]
+    fe_s.load_flow_rules(rules)
+    seq_s.load_flow_rules(rules)
+
+    rng = np.random.default_rng(21)
+    n = 42                                     # 5 full batches + a tail
+    stream = [(f"r{int(rng.integers(0, 4))}",
+               bool(rng.random() < 0.3),
+               "app-a" if rng.random() < 0.4 else "")
+              for _ in range(n)]
+
+    async def run():
+        b = AdaptiveBatcher(fe_s, batch_max=8, deadline_ms=60_000,
+                            idle_ms=10_000.0, depth=2, record_flushes=True)
+        # submissions enter the queue in gather order, so flush
+        # composition is the FIFO prefix of the stream at each cut
+        verdicts = await asyncio.gather(
+            *(b.submit(r, prioritized=p, origin=o) for r, p, o in stream))
+        await b.drain()
+        return verdicts, b.flush_log
+
+    verdicts, flush_log = asyncio.run(run())
+    assert [r for f in flush_log for r in f["resources"]] == \
+        [r for r, _p, _o in stream]
+
+    # sequential replay of the SAME batch cuts on a twin runtime
+    seq_verdicts = []
+    for f in flush_log:
+        v = seq_s.entry_batch_nowait(
+            f["resources"],
+            acquire=np.asarray(f["counts"], np.int32),
+            prioritized=np.asarray(f["prioritized"], np.bool_),
+            origins=(f["origins"] if any(f["origins"]) else None),
+        ).result()
+        seq_verdicts.extend(zip(np.asarray(v.allow), np.asarray(v.reason),
+                                np.asarray(v.wait_ms)))
+
+    assert len(seq_verdicts) == len(verdicts)
+    for i, (got, want) in enumerate(zip(verdicts, seq_verdicts)):
+        assert (got.allow, got.reason, got.wait_ms) == \
+            (bool(want[0]), int(want[1]), int(want[2])), f"request {i}"
+    _assert_state_equal(fe_s._state, seq_s._state)
+    for r in ("r0", "r1", "r2"):
+        assert fe_s.node_totals(r) == seq_s.node_totals(r)
+    fe_s.close()
+    seq_s.close()
+
+
+# ---------------------------------------------------------------------------
+# backpressure + lifecycle
+# ---------------------------------------------------------------------------
+
+def test_overload_shed_is_fail_fast(clk):
+    sph = make(clk)
+
+    async def run():
+        b = AdaptiveBatcher(sph, batch_max=100, deadline_ms=60_000,
+                            idle_ms=10_000.0, queue_max=3)
+        tasks = [asyncio.ensure_future(b.submit("api")) for _ in range(3)]
+        await asyncio.sleep(0.005)             # all three sit in the queue
+        with pytest.raises(IngestOverload):
+            await b.submit("api")
+        await b.drain()                        # the queued three complete
+        return await asyncio.gather(*tasks)
+
+    verdicts = asyncio.run(run())
+    assert len(verdicts) == 3 and all(v.allow for v in verdicts)
+    assert sph.obs.counters.get(obs_keys.FE_SHED) == 1
+    sph.close()
+
+
+def test_close_fails_pending_futures_no_leak(clk):
+    """Sentinel.close() tears the registered batcher down: every pending
+    request resolves with FrontendClosed — no future is left pending."""
+    sph = make(clk)
+
+    async def run():
+        b = sph.frontend(batch_max=100, deadline_ms=60_000,
+                         idle_ms=10_000.0)
+        tasks = [asyncio.ensure_future(b.submit("api")) for _ in range(5)]
+        await asyncio.sleep(0.005)
+        assert b.pending == 5
+        sph.close()                            # shutdown registry → close
+        results = await asyncio.gather(*tasks, return_exceptions=True)
+        assert all(isinstance(r, FrontendClosed) for r in results)
+        assert b.pending == 0
+        with pytest.raises(FrontendClosed):
+            await b.submit("api")
+
+    asyncio.run(run())
+
+
+def test_close_is_idempotent_and_reentrant(clk):
+    sph = make(clk)
+
+    async def run():
+        b = AdaptiveBatcher(sph, batch_max=2)
+        v = await b.submit("api")
+        assert v.allow
+        b.close()
+        b.close()
+
+    asyncio.run(run())
+    sph.close()
+
+
+# ---------------------------------------------------------------------------
+# workload zoo
+# ---------------------------------------------------------------------------
+
+def test_workloads_deterministic_and_shaped():
+    for name in workloads.WORKLOADS:
+        a = workloads.make(name, 5, duration_ms=200.0, rate_rps=400.0)
+        b = workloads.make(name, 5, duration_ms=200.0, rate_rps=400.0)
+        assert a == b, f"{name} not deterministic"
+        assert a != workloads.make(name, 6, duration_ms=200.0,
+                                   rate_rps=400.0), f"{name} ignores seed"
+        assert all(0 <= r.t_ms < 200.0 for r in a)
+
+
+def test_flash_crowd_concentrates_on_hot_key():
+    reqs = workloads.make("flash_crowd", 3, duration_ms=400.0,
+                          rate_rps=500.0, spike_mult=8.0)
+    spike = [r for r in reqs if 160 <= r.t_ms < 240]
+    calm = [r for r in reqs if r.t_ms < 160]
+    # spike window offers ~8x the calm rate and is mostly the hot key
+    assert len(spike) > 2 * len(calm)
+    hot = sum(r.resource == "flash/hot" for r in spike)
+    assert hot > len(spike) // 2
+
+
+def test_zipf_is_head_heavy():
+    reqs = workloads.make("zipf_hot", 9, duration_ms=300.0, rate_rps=600.0)
+    ranks = [int(r.resource.split("zipf/r")[1]) for r in reqs]
+    assert sum(k == 1 for k in ranks) > len(ranks) // 20   # hot head
+    assert len(set(ranks)) > 10                            # long tail
+
+
+def test_priority_mix_marks_prioritized():
+    reqs = workloads.make("priority_mix", 4, duration_ms=300.0,
+                          rate_rps=600.0, prio_frac=0.3)
+    frac = sum(r.prioritized for r in reqs) / len(reqs)
+    assert 0.15 < frac < 0.45
+    assert all(r.origin == ("gold" if r.prioritized else "bronze")
+               for r in reqs)
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoint
+# ---------------------------------------------------------------------------
+
+def test_http_entry_endpoint(clk):
+    aiohttp = pytest.importorskip("aiohttp")
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from sentinel_tpu.frontend.server import make_app
+
+    sph = make(clk)
+    sph.load_flow_rules([stpu.FlowRule(resource="api", count=2.0)])
+
+    async def run():
+        b = AdaptiveBatcher(sph, batch_max=4, idle_ms=0.5)
+        client = TestClient(TestServer(make_app(b)))
+        await client.start_server()
+        r = await client.post("/v1/entry", json={"resource": "api"})
+        assert r.status == 200
+        body = await r.json()
+        assert body["allow"] is True and body["reason"] == 0
+        r = await client.post("/v1/entry_batch", json={
+            "entries": [{"resource": "api"} for _ in range(4)]})
+        verdicts = (await r.json())["verdicts"]
+        assert [v["allow"] for v in verdicts] == [True, False, False, False]
+        assert verdicts[1]["reason_name"] == "FlowException"
+        r = await client.post("/v1/entry", json={"count": 2})
+        assert r.status == 400
+        r = await client.get("/healthz")
+        assert (await r.json())["ok"] is True
+        r = await client.get("/stats")
+        stats = await r.json()
+        assert stats["counters"][obs_keys.FE_ENQUEUE] == 5
+        assert stats["hist_request_to_verdict"]["count"] == 5
+        await client.close()
+        b.close()
+
+    asyncio.run(run())
+    sph.close()
+
+
+def test_multihost_request_params_raises():
+    """Satellite pin: the unwired multihost param-flow path must fail
+    loud with a tracking pointer, not drift in a docstring."""
+    from sentinel_tpu.multihost.ingest import MultihostIngest
+    with pytest.raises(NotImplementedError, match="ROADMAP item 5"):
+        MultihostIngest.request_params(object())
