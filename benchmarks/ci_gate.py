@@ -61,6 +61,12 @@ benchmarks/serving_bench.py). The steady workload's p99 must sit in
 accounting; the flash-crowd run must shed/queue gracefully (no lost
 futures, no deadline-miss collapse) while actually cutting full
 batches. See the comment block above ``measure_serving``.
+
+Gate (g) — the trace-capture mechanism probe (r8): an induced
+flash-crowd deadline miss must leave a persisted ``<app>-trace`` chain
+behind (obs/flight.py) that spans the request AND batch tiers and
+survives the Chrome-trace export round trip. See the comment block
+above ``TRACE_REQUIRED_REQUEST_SPAN``.
 """
 
 from __future__ import annotations
@@ -535,6 +541,22 @@ def measure_dispatch_pipeline() -> dict:
 STEADY_P99_BAND_MS = (0.2, 150.0)
 FLASH_MISS_COLLAPSE = 0.9
 
+# Gate (g) — the trace-capture mechanism probe (r8): an induced
+# flash-crowd deadline miss must leave a PERSISTED causal chain behind.
+# A fresh flash replay with a 2 ms request deadline (every settled
+# request misses) runs with the flight recorder's <app>-trace log
+# attached to a temp dir; the probe then reads the rotation back with
+# ``load_pinned`` and requires (i) ≥1 pinned record including a
+# ``deadline_miss`` kind, (ii) the chain to span the TIERS — the
+# request-side terminal span (frontend.settle) AND a batch-side span
+# (frontend.flush / pipeline.enqueue) reached through a fan-in link —
+# and (iii) the record to survive the Chrome-trace export + json.loads
+# round trip. Each leg pins a different failure: trace-id threading
+# severed (chain collapses to one tier), trigger plumbing dead (no
+# record at all), writer/searcher codec drift (parse failure).
+TRACE_REQUIRED_REQUEST_SPAN = "frontend.settle"
+TRACE_REQUIRED_BATCH_SPANS = ("frontend.flush", "pipeline.enqueue")
+
 
 def measure_serving() -> dict:
     sys.path.insert(0, str(HERE.parent))
@@ -549,6 +571,8 @@ def measure_serving() -> dict:
         batch_max=64, wl_kwargs={"spike_mult": 8.0})
     return {
         "steady_p99_ms": steady["p99_ms"],
+        "steady_worst_traced": bool(
+            steady.get("worst_request", {}).get("trace")),
         "steady_p50_ms": steady["p50_ms"],
         "steady_offered": steady["offered"],
         "steady_completed": steady["completed"],
@@ -562,6 +586,54 @@ def measure_serving() -> dict:
     }
 
 
+def measure_trace_capture() -> dict:
+    """Gate (g): induced deadline misses must pin a persisted, parseable,
+    tier-spanning causal chain (see the comment block above
+    TRACE_REQUIRED_REQUEST_SPAN)."""
+    import shutil
+    import tempfile
+
+    sys.path.insert(0, str(HERE.parent))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from benchmarks import serving_bench
+    from sentinel_tpu.obs import flight as flight_mod
+    from sentinel_tpu.obs import traceexport
+
+    tmp = tempfile.mkdtemp(prefix="sentinel-trace-gate-")
+    try:
+        res = serving_bench.run_workload(
+            "flash_crowd", seed=44, duration_ms=300.0, rate_rps=1000.0,
+            batch_max=64, deadline_ms=2, wl_kwargs={"spike_mult": 8.0},
+            trace_dir=tmp)
+        pinned = flight_mod.load_pinned(tmp, "flash_crowd")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    kinds, names = set(), set()
+    for rec in pinned:
+        kinds.add(rec.get("kind"))
+        for s in rec.get("spans", ()):
+            names.add(s.get("name"))
+    chain_ok = False
+    export_ok = False
+    for rec in pinned:
+        rec_names = {s.get("name") for s in rec.get("spans", ())}
+        if (TRACE_REQUIRED_REQUEST_SPAN in rec_names
+                and rec_names.intersection(TRACE_REQUIRED_BATCH_SPANS)
+                and rec.get("links")):
+            chain_ok = True
+            doc = json.loads(traceexport.dumps(traceexport.chrome_trace(rec)))
+            export_ok = bool(doc.get("traceEvents"))
+            break
+    return {
+        "induced_misses": res["deadline_miss"],
+        "pinned_records": len(pinned),
+        "kinds": sorted(k for k in kinds if k),
+        "chain_spans_tiers_ok": chain_ok,
+        "chrome_trace_ok": export_ok,
+    }
+
+
 def main() -> int:
     best = max(measure_once() for _ in range(3))
     cal = calibrate()
@@ -571,6 +643,7 @@ def main() -> int:
     obs = measure_obs_overhead()
     disp = measure_dispatch_pipeline()
     serving = measure_serving()
+    trace = measure_trace_capture()
     ratios = {k.replace("_s_per_step", "_ratio"): v / cal
               for k, v in prep.items()}
     if "--update" in sys.argv:
@@ -592,6 +665,9 @@ def main() -> int:
              # re-baselined per machine
              "serving": {k: (round(v, 4) if isinstance(v, float) else v)
                          for k, v in serving.items()},
+             # informational: gate (g) is binary (mechanism), nothing
+             # machine-relative to pin
+             "trace_capture": trace,
              "calibration_s": cal}, indent=1))
         print(f"baseline updated: floor={best / 2:.0f} (measured {best:.0f}) "
               f"on {fingerprint()}; host-prep ratios "
@@ -616,9 +692,29 @@ def main() -> int:
             for k, v in disp.items()},
         "serving": {k: (round(v, 4) if isinstance(v, float) else v)
                     for k, v in serving.items()},
+        "trace_capture": trace,
     }
     print(json.dumps(out))
     rc = 0
+    if trace["pinned_records"] == 0 or "deadline_miss" not in trace["kinds"]:
+        print(f"TRACE-CAPTURE REGRESSION: {trace['induced_misses']} induced "
+              f"deadline misses pinned {trace['pinned_records']} chains "
+              f"(kinds {trace['kinds']}) — the flight recorder's "
+              f"deadline_miss trigger or its <app>-trace persistence is "
+              f"dead", file=sys.stderr)
+        rc = 1
+    elif not trace["chain_spans_tiers_ok"]:
+        print("TRACE-CAPTURE REGRESSION: no pinned chain spans both the "
+              f"request tier ({TRACE_REQUIRED_REQUEST_SPAN}) and a batch "
+              f"tier span {TRACE_REQUIRED_BATCH_SPANS} with a causal "
+              "link — the trace-id threading between the front end and "
+              "the dispatch path is severed", file=sys.stderr)
+        rc = 1
+    elif not trace["chrome_trace_ok"]:
+        print("TRACE-CAPTURE REGRESSION: the pinned chain did not survive "
+              "the Chrome-trace export + json.loads round trip",
+              file=sys.stderr)
+        rc = 1
     p99 = serving["steady_p99_ms"]
     slo_lo, slo_hi = STEADY_P99_BAND_MS
     if p99 is None or not slo_lo <= p99 <= slo_hi:

@@ -14,7 +14,10 @@ obs/hist.py :class:`LogHistogram` plus the frontend's own counters.
 Output: one JSON line per workload on stdout and a single artifact
 (``SERVING_BENCH_OUT``, default ``serving_bench.json`` in the CWD) with
 the per-workload metrics and the serving-knob environment, so BENCH_rN
-records are self-describing.
+records are self-describing. Each workload also carries a
+``worst_request`` entry — the slowest request's causal chain exported as
+a Chrome-trace-event document (obs/traceexport.py), loadable directly in
+``ui.perfetto.dev``.
 
 Knobs: ``SERVING_DURATION_MS`` (default 600), ``SERVING_RATE`` (offered
 req/s, default 1000), ``SERVING_SEED`` (default 42), plus the
@@ -106,9 +109,14 @@ def run_workload(name: str, *, seed: int = DEFAULT_SEED,
                  batch_max: int = 256, deadline_ms: int = 25,
                  budget_ms: int = 3, idle_ms: float = 1.0,
                  depth: int = 2, queue_max: Optional[int] = None,
-                 wl_kwargs: Optional[dict] = None) -> Dict:
+                 wl_kwargs: Optional[dict] = None,
+                 trace_dir: Optional[str] = None) -> Dict:
     """Replay one zoo workload open-loop through a fresh Sentinel +
-    AdaptiveBatcher; returns the per-workload metrics dict."""
+    AdaptiveBatcher; returns the per-workload metrics dict.
+
+    ``trace_dir`` attaches the SLO flight recorder's rolling
+    ``<workload>-trace`` log there (obs/flight.py) — what ci_gate's
+    trace-capture probe reads back with ``load_pinned``."""
     import sentinel_tpu as stpu
     from sentinel_tpu.frontend import AdaptiveBatcher, IngestOverload
     from sentinel_tpu.frontend.workloads import make as make_workload
@@ -121,12 +129,15 @@ def run_workload(name: str, *, seed: int = DEFAULT_SEED,
         max_resources=4096, max_origins=64, max_flow_rules=64,
         max_degrade_rules=16, max_authority_rules=16))
     sph.load_flow_rules(_rules_for(stpu, name))
+    if trace_dir is not None:
+        sph.obs.flight.configure(trace_dir, name)
     _warm(sph, batch_max, reqs[0].resource if reqs else "warm/0")
     sph.obs.counters.clear()
     sph.obs.hist_request.clear()
 
     lat = LogHistogram()
     stats = {"shed": 0, "allowed": 0, "blocked": 0, "deadline_miss": 0}
+    worst = {"ns": -1, "trace": 0}      # worst-latency request + trace id
     deadline_ns = deadline_ms * 1e6
 
     async def replay() -> None:
@@ -153,6 +164,8 @@ def run_workload(name: str, *, seed: int = DEFAULT_SEED,
             lat.record(dt)
             if dt > deadline_ns:
                 stats["deadline_miss"] += 1
+            if dt > worst["ns"]:
+                worst["ns"], worst["trace"] = dt, v.trace_id
             stats["allowed" if v.allow else "blocked"] += 1
 
         await asyncio.gather(*(fire(r) for r in reqs))
@@ -184,6 +197,18 @@ def run_workload(name: str, *, seed: int = DEFAULT_SEED,
                     "budget_ms": budget_ms, "idle_ms": idle_ms,
                     "depth": depth, "queue_max": queue_max},
     }
+    # worst-request trace dump: the slowest request's causal chain as a
+    # Chrome-trace document (load serving_bench.json, pull
+    # workloads.<name>.worst_request.trace into ui.perfetto.dev) — must
+    # happen before close() drops the span rings
+    if worst["trace"] and sph.obs.enabled:
+        from sentinel_tpu.obs import traceexport
+        out["worst_request"] = {
+            "latency_ms": worst["ns"] / 1e6,
+            "trace_id": worst["trace"],
+            "trace": traceexport.export_chain(sph.obs.spans,
+                                              worst["trace"]),
+        }
     sph.close()
     return out
 

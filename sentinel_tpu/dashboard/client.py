@@ -116,6 +116,14 @@ class SentinelApiClient:
             params["trace"] = trace
         return json.loads(self._get(ip, port, "obs", params) or "{}")
 
+    def fetch_trace(self, ip: str, port: int,
+                    trace_id: str = "") -> Dict[str, Any]:
+        """Request-scoped trace export (``trace`` command): with an id, a
+        Chrome-trace-event/Perfetto document of that causal chain; without,
+        the flight recorder's pinned-record index."""
+        params = {"id": trace_id} if trace_id else None
+        return json.loads(self._get(ip, port, "trace", params) or "{}")
+
     def get_cluster_mode(self, ip: str, port: int) -> Dict[str, Any]:
         return json.loads(self._get(ip, port, "getClusterMode") or "{}")
 

@@ -501,6 +501,17 @@ class _Handler(BaseHTTPRequestHandler):
             except AgentUnreachable as exc:
                 self._json(_fail(str(exc)))
             return
+        if method == "GET" and path == "/obs/traces.json":
+            # request-scoped tracing: ?id= proxies one causal chain as a
+            # Chrome-trace-event document; without id, the flight
+            # recorder's pinned-record index (docs/OBSERVABILITY.md)
+            try:
+                self._json(_ok(d.client.fetch_trace(
+                    q.get("ip", ""), int(q.get("port", "0") or 0),
+                    trace_id=q.get("id", ""))))
+            except AgentUnreachable as exc:
+                self._json(_fail(str(exc)))
+            return
         if method == "GET" and path == "/cluster/state.json":
             self._json(d.cluster_state(q.get("app", "")))
             return

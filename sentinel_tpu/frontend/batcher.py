@@ -60,6 +60,17 @@ Self-telemetry (obs/): counters ``frontend.enqueue``,
 spans ``frontend.enqueue`` / ``frontend.flush`` on sampled requests and
 flushes; per-request ingest→verdict ns in ``obs.hist_request`` (the
 p50/p95/p99 a service owner quotes).
+
+Request-scoped tracing (PR 8, docs/OBSERVABILITY.md "Request tracing"):
+``submit`` mints a per-request trace id (every request while the flight
+recorder is active, stride-sampled otherwise), the flush records fan-in
+links request→batch and threads the batch trace through
+``DispatchPipeline.submit(trace_id=...)`` into the device spans, and the
+settle loop records fan-out links batch→request plus the terminal
+``frontend.settle`` span — so ``obs.spans.chain(request_id)`` walks the
+full lifecycle. SLO triggers fired from here: ``shed`` on
+:class:`IngestOverload`, ``deadline_miss`` on the worst overrun of each
+settled batch, and the rolling p99 check (obs/flight.py).
 """
 
 from __future__ import annotations
@@ -145,6 +156,7 @@ class RequestVerdict(NamedTuple):
     reason: int          # int8 verdict code (0 = pass)
     wait_ms: int         # PriorityWait / pacing hint
     latency_ms: float    # ingest → verdict, this request
+    trace_id: int = 0    # request-scoped trace id (0 = not traced)
 
     @property
     def reason_name(self) -> str:
@@ -153,10 +165,10 @@ class RequestVerdict(NamedTuple):
 
 class _Pending:
     __slots__ = ("resource", "count", "prioritized", "origin",
-                 "deadline_ms", "t0_ns", "future")
+                 "deadline_ms", "t0_ns", "future", "trace_id")
 
     def __init__(self, resource, count, prioritized, origin, deadline_ms,
-                 t0_ns, future):
+                 t0_ns, future, trace_id=0):
         self.resource = resource
         self.count = count
         self.prioritized = prioritized
@@ -164,6 +176,7 @@ class _Pending:
         self.deadline_ms = deadline_ms      # ABSOLUTE fire-by time
         self.t0_ns = t0_ns
         self.future = future
+        self.trace_id = trace_id            # request-scoped trace (0=off)
 
 
 class IngestQueue:
@@ -317,11 +330,15 @@ class AdaptiveBatcher:
         self._ensure_started()
         obs = self._s.obs
         obs_on = obs.enabled
-        tr = obs.spans.maybe_trace() if obs_on else 0
+        # request-scoped trace id: the flight recorder's always-on tier
+        # mints for EVERY request (an SLO trigger must be able to pin any
+        # chain retroactively); without it the stride sampler decides
+        tr = obs.request_trace() if obs_on else 0
         t0 = obs.spans.now_ns() if obs_on else 0
         if self.queue.would_shed(self._inflight):
             if obs_on:
                 obs.counters.add(obs_keys.FE_SHED)
+                obs.flight.trigger("shed", note=f"resource={resource}")
             raise IngestOverload(
                 f"ingest queue at bound ({self.queue.queue_max} pending"
                 f"+inflight); request shed")
@@ -330,7 +347,7 @@ class AdaptiveBatcher:
             1, int(deadline_ms))
         req = _Pending(resource, int(count), bool(prioritized), origin,
                        now + budget, t0 if obs_on else 0,
-                       self._loop.create_future())
+                       self._loop.create_future(), tr)
         self.queue.add(req)
         if obs_on:
             obs.counters.add(obs_keys.FE_ENQUEUE)
@@ -417,10 +434,17 @@ class AdaptiveBatcher:
             return
         obs = self._s.obs
         obs_on = obs.enabled
-        tr = obs.spans.maybe_trace() if obs_on else 0
+        tr = obs.request_trace() if obs_on else 0
         t0 = obs.spans.now_ns() if tr else 0
         if obs_on:
             obs.counters.add(_FLUSH_KEY[reason])
+        if tr:
+            # fan-in: every request trace joins this batch's trace (the
+            # causal edges chain(request_id) walks to reach the
+            # pipeline/device spans)
+            for r in reqs:
+                if r.trace_id:
+                    obs.spans.link(r.trace_id, tr, "flush")
         if self.flush_log is not None:
             self.flush_log.append({
                 "reason": reason,
@@ -435,17 +459,18 @@ class AdaptiveBatcher:
         # `depth` without DispatchPipeline.submit ever stalling — a stall
         # would block a worker thread on a device readback mid-dispatch
         await self._slots.acquire()
-        ticket = await asyncio.to_thread(self._dispatch, reqs)
+        ticket = await asyncio.to_thread(self._dispatch, reqs, tr)
         if tr:
             obs.spans.record(tr, "frontend.flush", t0, obs.spans.now_ns(),
                              n=len(reqs), note=reason)
         self._inflight_reqs.append(reqs)
-        await self._settle_q.put((ticket, reqs))
+        await self._settle_q.put((ticket, reqs, tr))
 
-    def _dispatch(self, reqs: List[_Pending]):
+    def _dispatch(self, reqs: List[_Pending], trace_id: int = 0):
         """Host prep + device dispatch for one batch (worker thread).
         Rows are pre-interned through the instance cache; misses intern
-        once via the vectorized registry path."""
+        once via the vectorized registry path. ``trace_id`` (the batch
+        trace) threads through the pipeline seq into the device spans."""
         n = len(reqs)
         rows = np.empty(n, np.int32)
         cache = self._rows
@@ -467,7 +492,8 @@ class AdaptiveBatcher:
         origins = ([r.origin for r in reqs]
                    if any(r.origin for r in reqs) else None)
         return self._pipe.submit(rows, acquire=acquire,
-                                 prioritized=prio, origins=origins)
+                                 prioritized=prio, origins=origins,
+                                 trace_id=trace_id)
 
     # ------------------------------------------------------------------
     # settle / fan-out
@@ -485,13 +511,15 @@ class AdaptiveBatcher:
         each batch's verdicts out to its request futures."""
         obs = self._s.obs
         while True:
-            ticket, reqs = await self._settle_q.get()
+            ticket, reqs, batch_tr = await self._settle_q.get()
             verdicts = await asyncio.to_thread(ticket.result)
             if self._inflight_reqs and self._inflight_reqs[0] is reqs:
                 self._inflight_reqs.popleft()
             self._inflight -= len(reqs)
             obs_on = obs.enabled
             t_end = obs.spans.now_ns() if obs_on else 0
+            now_ms = self._s.clock.now_ms() if obs_on else 0
+            worst = None              # worst deadline overrun this batch
             allow = np.asarray(verdicts.allow)
             reason = np.asarray(verdicts.reason)
             wait = np.asarray(verdicts.wait_ms)
@@ -499,10 +527,30 @@ class AdaptiveBatcher:
                 lat_ns = (t_end - r.t0_ns) if obs_on else 0
                 if obs_on:
                     obs.hist_request.record(lat_ns)
+                    if r.trace_id:
+                        # fan-out: the batch settles THIS request (the
+                        # flow arrow back), then the request's terminal
+                        # span closes its chain
+                        if batch_tr:
+                            obs.spans.link(batch_tr, r.trace_id, "verdict")
+                        obs.spans.record(r.trace_id, "frontend.settle",
+                                         r.t0_ns, t_end, n=1)
+                    if now_ms > r.deadline_ms and (
+                            worst is None or worst[1] < now_ms
+                            - r.deadline_ms):
+                        worst = (r.trace_id, now_ms - r.deadline_ms)
                 if not r.future.done():
                     r.future.set_result(RequestVerdict(
                         bool(allow[i]), int(reason[i]), int(wait[i]),
-                        lat_ns / 1e6))
+                        lat_ns / 1e6, r.trace_id))
+            if obs_on:
+                if worst is not None:
+                    # SLO trigger: pin the worst-overrun request's chain
+                    # (rate-limited per kind inside the recorder)
+                    obs.flight.trigger("deadline_miss", root=worst[0],
+                                       note=f"overrun_ms={worst[1]}",
+                                       worst_ms=worst[1])
+                obs.flight.note_requests(len(reqs))
 
     # ------------------------------------------------------------------
     # lifecycle

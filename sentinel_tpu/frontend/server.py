@@ -49,6 +49,7 @@ def _verdict_json(v: RequestVerdict) -> dict:
         "reason_name": v.reason_name,
         "wait_ms": v.wait_ms,
         "latency_ms": round(v.latency_ms, 3),
+        "trace_id": v.trace_id,
     }
 
 

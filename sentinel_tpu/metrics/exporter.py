@@ -28,6 +28,17 @@ Self-telemetry families (from ``Sentinel.obs`` — obs/; absent while
     sentinel_compile_cache_first_fetch_retries_total
     sentinel_block_reason_total{reason=...} denials by verdict code name
     sentinel_occupy_bookings_total{event=...} granted/carried/settled/evicted
+    sentinel_pipeline_total{event=...}     depth/stall/leaked_handles
+    sentinel_frontend_total{event=...}     enqueue/queue_depth/shed
+    sentinel_frontend_flush_total{reason=...} full/deadline/idle batch cuts
+    sentinel_span_ring_wraps_total         spans/links lost to ring wrap
+    sentinel_flight_pinned_total           SLO-pinned trace chains
+    sentinel_flight_trigger_total{kind=...} deadline_miss/shed/p99/block_burst
+
+Every key in the fixed counter CATALOG (obs/counters.py) has a family
+here — tests/test_obs.py walks the catalog against the rendered scrape
+so a key added without an export shows up as a test failure, not a
+silent observability gap.
 """
 
 from __future__ import annotations
@@ -102,6 +113,29 @@ class SentinelCollector:
         occupy = CounterMetricFamily(
             f"{ns}_occupy_bookings",
             "Priority occupy booking lifecycle events", labels=["event"])
+        pipeline = CounterMetricFamily(
+            f"{ns}_pipeline",
+            "Dispatch-pipeline health: depth (sum of in-flight at each "
+            "enqueue), stall, leaked_handles", labels=["event"])
+        frontend = CounterMetricFamily(
+            f"{ns}_frontend",
+            "Serving front-end ingest events: enqueue, queue_depth "
+            "(sum of pending depth at each enqueue), shed",
+            labels=["event"])
+        fe_flush = CounterMetricFamily(
+            f"{ns}_frontend_flush",
+            "Why each device batch was cut", labels=["reason"])
+        wraps = CounterMetricFamily(
+            f"{ns}_span_ring_wraps",
+            "Spans/links lost to per-thread ring wrap (capacity too "
+            "small for the sustained span rate)")
+        flight_pinned = CounterMetricFamily(
+            f"{ns}_flight_pinned",
+            "Trace chains pinned by an SLO flight-recorder trigger")
+        flight_trig = CounterMetricFamily(
+            f"{ns}_flight_trigger",
+            "Flight-recorder SLO triggers fired (post rate limiting)",
+            labels=["kind"])
         if not describe_only and obs is not None and obs.enabled:
             from sentinel_tpu.obs import counters as ck
             counts = obs.counters.snapshot()
@@ -119,7 +153,8 @@ class SentinelCollector:
                                  (ck.ROUTE_FAST, "fast"),
                                  (ck.ROUTE_FAST_OCCUPY, "fast_occupy"),
                                  (ck.ROUTE_GENERAL, "general_sorted"),
-                                 (ck.ROUTE_SPLIT, "split_fired")):
+                                 (ck.ROUTE_SPLIT, "split_fired"),
+                                 (ck.ROUTE_FUSED, "fused_exit")):
                 route.add_metric([fam_key], counts.get(key, 0))
             hits.add_metric([], counts.get(ck.CACHE_HIT, 0))
             misses.add_metric([], counts.get(ck.CACHE_MISS, 0))
@@ -132,8 +167,27 @@ class SentinelCollector:
                             (ck.OCCUPY_SETTLED, "settled"),
                             (ck.OCCUPY_EVICTED, "evicted")):
                 occupy.add_metric([ev], counts.get(key, 0))
+            for key, ev in ((ck.PIPE_DEPTH, "depth"),
+                            (ck.PIPE_STALL, "stall"),
+                            (ck.PIPE_LEAKED, "leaked_handles")):
+                pipeline.add_metric([ev], counts.get(key, 0))
+            for key, ev in ((ck.FE_ENQUEUE, "enqueue"),
+                            (ck.FE_QUEUE_DEPTH, "queue_depth"),
+                            (ck.FE_SHED, "shed")):
+                frontend.add_metric([ev], counts.get(key, 0))
+            for key, reason in ((ck.FE_FLUSH_FULL, "full"),
+                                (ck.FE_FLUSH_DEADLINE, "deadline"),
+                                (ck.FE_FLUSH_IDLE, "idle")):
+                fe_flush.add_metric([reason], counts.get(key, 0))
+            wraps.add_metric([], counts.get(ck.SPAN_RING_WRAP, 0))
+            flight_pinned.add_metric([], counts.get(ck.FLIGHT_PINNED, 0))
+            for key, v in sorted(counts.items()):
+                if key.startswith(ck.FLIGHT_TRIGGER_PREFIX):
+                    flight_trig.add_metric(
+                        [key[len(ck.FLIGHT_TRIGGER_PREFIX):]], v)
         yield from (p99, quant, req_quant, route, hits, misses, retries,
-                    blocks, occupy)
+                    blocks, occupy, pipeline, frontend, fe_flush, wraps,
+                    flight_pinned, flight_trig)
 
     def collect(self):
         ns = self.namespace

@@ -15,7 +15,10 @@ Env knobs (read at ``RuntimeObs`` construction):
   off (spans, counters, histograms, block events);
 * ``SENTINEL_TRACE_SAMPLE`` — span/block-event sampling rate in
   ``[0, 1]`` (default 1.0 = every dispatch eligible; rendered as a
-  deterministic stride, see obs/spans.py).
+  deterministic stride, see obs/spans.py);
+* ``SENTINEL_FLIGHT_DISABLE`` / ``SENTINEL_FLIGHT_WINDOW_MS`` /
+  ``SENTINEL_FLIGHT_P99_MS`` / ``SENTINEL_FLIGHT_BLOCK_BURST`` — the
+  SLO flight recorder (obs/flight.py).
 
 Surfaces: the Prometheus collector (metrics/exporter.py), the ``obs``
 transport command (transport/handlers.py), the dashboard
@@ -32,6 +35,7 @@ from typing import Dict, Optional
 from sentinel_tpu.obs import counters as counters_mod
 from sentinel_tpu.obs.counters import CounterSet
 from sentinel_tpu.obs.eventlog import BlockEventLog
+from sentinel_tpu.obs.flight import FlightRecorder
 from sentinel_tpu.obs.hist import LogHistogram, bucket_bounds_ns
 from sentinel_tpu.obs.spans import SpanRecorder
 
@@ -84,15 +88,34 @@ class RuntimeObs:
             sample = trace_sample_rate()
         self.enabled = (not obs_disabled()) if enabled is None else enabled
         self.sample = sample
+        self.clock = clock
         self.counters = CounterSet()
-        self.spans = SpanRecorder.for_clock(clock, sample=sample)
+        # ring wrap is an operator signal, not a silent overwrite: each
+        # span/link lost to a wrapped per-thread ring ticks the counter
+        self.spans = SpanRecorder.for_clock(
+            clock, sample=sample,
+            on_wrap=lambda: self.counters.add(counters_mod.SPAN_RING_WRAP))
         self.hist_entry = LogHistogram()
         self.hist_dispatch = LogHistogram()
         self.hist_request = LogHistogram()
         self.block_events = BlockEventLog(sample=sample)
+        # tail-based SLO capture (obs/flight.py); inert when the bundle
+        # is disabled, individually removable via SENTINEL_FLIGHT_DISABLE
+        self.flight = FlightRecorder(self)
         self._closed = False
 
     # ---- hot-path helpers -------------------------------------------
+
+    def request_trace(self) -> int:
+        """Trace id for one ingest request/flush: the flight recorder's
+        always-on tier mints unconditionally (an SLO trigger must be able
+        to pin ANY chain retroactively); otherwise the stride sampler
+        decides. → 0 when telemetry is off."""
+        if not self.enabled:
+            return 0
+        if self.flight.active:
+            return self.spans.mint()
+        return self.spans.maybe_trace()
 
     def annotate(self, name: str):
         """Profiler trace annotation for a jitted step — a shared no-op
@@ -118,26 +141,33 @@ class RuntimeObs:
             },
             "spans": self.spans.snapshot(limit=span_limit),
             "block_events": self.block_events.snapshot(limit=event_limit),
+            "flight": {
+                "active": self.flight.active,
+                "window_ms": self.flight.window_ms,
+                "pinned": self.flight.snapshot(),
+            },
         }
 
     def flush(self) -> int:
-        """Flush buffered block events to their writer (ridden by the
-        metric timer's tick and by close)."""
-        return self.block_events.flush()
+        """Flush buffered block events + pinned flight chains to their
+        writers (ridden by the metric timer's tick and by close)."""
+        return self.block_events.flush() + self.flight.flush()
 
     def close(self) -> None:
         """Idempotent teardown: disable, drop span rings, flush + close
-        the block-event writer. Safe across repeated open/close."""
+        the block-event and flight-recorder writers. Safe across
+        repeated open/close."""
         if self._closed:
             return
         self._closed = True
         self.enabled = False
+        self.flight.close()
         self.spans.close()
         self.block_events.close()
 
 
 __all__ = [
     "OBS_DISABLE_ENV", "TRACE_SAMPLE_ENV", "RuntimeObs", "CounterSet",
-    "LogHistogram", "SpanRecorder", "BlockEventLog", "obs_disabled",
-    "trace_sample_rate", "trace_annotation", "counters_mod",
+    "LogHistogram", "SpanRecorder", "BlockEventLog", "FlightRecorder",
+    "obs_disabled", "trace_sample_rate", "trace_annotation", "counters_mod",
 ]

@@ -31,6 +31,13 @@ dispatch stays within the 2% observability budget (benchmarks/ci_gate.py
 * ``block_reason.<ExceptionName>`` — per-reason denial breakdown keyed
   by the int8 verdict codes (``exception_name_for`` /
   ``slot_name_for_code`` for custom slots).
+* ``obs.span_ring_wrap`` — spans/links lost to per-thread ring wrap
+  (capacity 2048 too small for the sustained span rate; previously a
+  silent overwrite).
+* ``flight.*`` — the SLO flight recorder (obs/flight.py): ``pinned``
+  (chains persisted to the ``<app>-trace`` log) and
+  ``trigger.{deadline_miss, shed, p99, block_burst}`` (which SLO
+  trigger fired, after per-kind rate limiting).
 
 :data:`CATALOG` is the fixed, ordered multihost-aggregatable key set:
 every process packs its snapshot into one int64 vector
@@ -74,6 +81,11 @@ FE_FLUSH_IDLE = "frontend.flush_reason.idle"
 
 BLOCK_PREFIX = "block_reason."
 
+# PR 8 — tracing / flight-recorder health
+SPAN_RING_WRAP = "obs.span_ring_wrap"     # spans/links lost to ring wrap
+FLIGHT_PINNED = "flight.pinned"           # chains pinned by an SLO trigger
+FLIGHT_TRIGGER_PREFIX = "flight.trigger."  # per-kind trigger tallies
+
 #: Fixed aggregation catalog (order is the wire format of the multihost
 #: counter vector — append only, never reorder).
 CATALOG = (
@@ -89,6 +101,11 @@ CATALOG = (
     PIPE_DEPTH, PIPE_STALL, PIPE_LEAKED,
     FE_ENQUEUE, FE_QUEUE_DEPTH, FE_SHED,
     FE_FLUSH_FULL, FE_FLUSH_DEADLINE, FE_FLUSH_IDLE,
+    SPAN_RING_WRAP, FLIGHT_PINNED,
+    FLIGHT_TRIGGER_PREFIX + "deadline_miss",
+    FLIGHT_TRIGGER_PREFIX + "shed",
+    FLIGHT_TRIGGER_PREFIX + "p99",
+    FLIGHT_TRIGGER_PREFIX + "block_burst",
 )
 
 

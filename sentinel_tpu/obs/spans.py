@@ -26,6 +26,23 @@ Span schema (``snapshot()`` dicts — docs/OBSERVABILITY.md):
 ``trace`` (sampled trace id), ``name``, ``start_ns``, ``end_ns``,
 ``dur_ns``, ``thread`` (ident), ``n`` (event count the span covered),
 ``note`` (free-form: route taken, sub-batch sizes, ...).
+
+Causal links (PR 8): traces relate across the fan-in/fan-out points of
+the serving stack — many request traces coalesce into one batch trace at
+an ingest flush, and the batch fans back out to per-request verdicts at
+settle. :meth:`link` records one ``(src, dst, kind, ts_ns)`` edge per
+relation into per-thread rings of the same lock-free shape as the span
+rings; :meth:`causal` computes the trace-id closure over those edges so
+``chain(request_id)`` returns the request's FULL lifecycle: its own
+frontend spans, the flush batch's pipeline/device spans, and the settle
+edge back. ``verdict`` edges (batch→request fan-out) are only expanded
+from the closure root — walking them from an interior batch node would
+pull every sibling request of the batch into every request's chain.
+
+Ring overflow is an explicit signal (PR 8): every overwritten span/link
+fires ``on_wrap`` (wired by RuntimeObs to the ``obs.span_ring_wrap``
+counter) so operators can see when capacity 2048 is too small instead of
+silently losing the tail.
 """
 
 from __future__ import annotations
@@ -36,6 +53,11 @@ import time
 from typing import Dict, List, Optional
 
 DEFAULT_CAPACITY = 2048
+LINK_CAPACITY = 4096
+
+#: link kinds (the causal-edge vocabulary; docs/OBSERVABILITY.md)
+LINK_FLUSH = "flush"        # request trace → the batch trace that took it
+LINK_VERDICT = "verdict"    # batch trace → one request trace it settled
 
 
 class _Ring:
@@ -48,7 +70,7 @@ class _Ring:
 
 class SpanRecorder:
     def __init__(self, capacity: int = DEFAULT_CAPACITY,
-                 sample: float = 1.0, time_ns=None) -> None:
+                 sample: float = 1.0, time_ns=None, on_wrap=None) -> None:
         self.capacity = max(16, int(capacity))
         # rate → stride: 1.0 records every trace, 0.01 every 100th, ≤0 none
         self._stride = 0 if sample <= 0 else max(1, round(1.0 / sample))
@@ -58,18 +80,23 @@ class SpanRecorder:
         self._trace_seq = itertools.count(1)     # issued trace ids
         self._tls = threading.local()
         self._rings: List[_Ring] = []
+        self._link_rings: List[_Ring] = []
         self._rings_lock = threading.Lock()
+        # fired once per OVERWRITTEN span/link (ring wrapped past a live
+        # entry); RuntimeObs wires it to the obs.span_ring_wrap counter
+        self.on_wrap = on_wrap
         self.enabled = True
 
     @staticmethod
     def for_clock(clock, capacity: int = DEFAULT_CAPACITY,
-                  sample: float = 1.0) -> "SpanRecorder":
+                  sample: float = 1.0, on_wrap=None) -> "SpanRecorder":
         """Recorder whose ns timestamps ride a manual/virtual clock when
         one is installed (tests), the monotonic clock otherwise."""
         tfn = None
         if clock is not None and hasattr(clock, "set_ms"):
             tfn = lambda: int(clock.now_ms()) * 1_000_000   # noqa: E731
-        return SpanRecorder(capacity=capacity, sample=sample, time_ns=tfn)
+        return SpanRecorder(capacity=capacity, sample=sample, time_ns=tfn,
+                            on_wrap=on_wrap)
 
     # ---- hot path ----------------------------------------------------
 
@@ -81,6 +108,15 @@ class SpanRecorder:
         if not self.enabled or self._stride == 0:
             return 0
         if next(self._dispatch_seq) % self._stride:
+            return 0
+        return next(self._trace_seq)
+
+    def mint(self) -> int:
+        """A fresh trace id UNCONDITIONALLY (no sampling stride) — the
+        flight recorder's always-on tier: every request/batch gets an id
+        so an SLO trigger can retroactively pin any chain, not just the
+        stride-sampled ones. → 0 only when the recorder is disabled."""
+        if not self.enabled:
             return 0
         return next(self._trace_seq)
 
@@ -101,6 +137,30 @@ class SpanRecorder:
             ring.buf.append(entry)
         else:
             ring.buf[ring.idx % self.capacity] = entry
+            if self.on_wrap is not None:
+                self.on_wrap()
+        ring.idx += 1
+
+    def link(self, src: int, dst: int, kind: str) -> None:
+        """One causal edge ``src trace → dst trace`` (fan-in: request →
+        flush batch; fan-out: batch → request verdict). Same lock-free
+        per-thread ring discipline as :meth:`record`."""
+        if not src or not dst or not self.enabled:
+            return
+        try:
+            ring = self._tls.links
+        except AttributeError:
+            ring = _Ring()
+            self._tls.links = ring
+            with self._rings_lock:
+                self._link_rings.append(ring)
+        entry = (int(src), int(dst), kind, self._time_ns())
+        if len(ring.buf) < LINK_CAPACITY:
+            ring.buf.append(entry)
+        else:
+            ring.buf[ring.idx % LINK_CAPACITY] = entry
+            if self.on_wrap is not None:
+                self.on_wrap()
         ring.idx += 1
 
     # ---- read side ---------------------------------------------------
@@ -121,10 +181,57 @@ class SpanRecorder:
                  "end_ns": s[3], "dur_ns": s[3] - s[2], "thread": s[4],
                  "n": s[5], "note": s[6]} for s in spans]
 
+    def links_snapshot(self, limit: Optional[int] = None) -> List[Dict]:
+        """All recorded causal edges, ts-ordered."""
+        links = self._raw_links()
+        links.sort(key=lambda e: e[3])
+        if limit is not None and len(links) > limit:
+            links = links[-limit:]
+        return [{"src": e[0], "dst": e[1], "kind": e[2], "ts_ns": e[3]}
+                for e in links]
+
+    def _raw_links(self) -> list:
+        with self._rings_lock:
+            rings = list(self._link_rings)
+        links = []
+        for ring in rings:
+            links.extend(list(ring.buf))   # atomic-enough copy (see module)
+        return links
+
+    def causal(self, trace_id: int) -> Dict:
+        """The causal closure of one trace: ``{"root", "spans", "links"}``.
+
+        Follows recorded edges forward from ``trace_id`` to a fixpoint.
+        ``verdict`` (fan-out) edges expand only from the root itself:
+        from a request root, the flush edge reaches the batch and the
+        batch's verdict edge BACK to this request is kept (both endpoints
+        are in the closure) while sibling requests stay out; from a batch
+        root, the fan-out to every request it settled is the point."""
+        raw = self._raw_links()
+        ids = {int(trace_id)}
+        changed = True
+        while changed:
+            changed = False
+            for src, dst, kind, _ts in raw:
+                if (src in ids and dst not in ids
+                        and (kind != LINK_VERDICT or src == trace_id)):
+                    ids.add(dst)
+                    changed = True
+        spans = self.snapshot()
+        spans = [s for s in spans if s["trace"] in ids]
+        spans.sort(key=lambda s: s["start_ns"])
+        links = [{"src": e[0], "dst": e[1], "kind": e[2], "ts_ns": e[3]}
+                 for e in sorted(raw, key=lambda e: e[3])
+                 if e[0] in ids and e[1] in ids]
+        return {"root": int(trace_id), "spans": spans, "links": links}
+
     def chain(self, trace_id: int) -> List[Dict]:
-        """All spans of one sampled trace, start-ordered (the demo's
-        "full span chain" view)."""
-        return self.snapshot(trace_id=trace_id)
+        """All spans reachable from one trace id, start-ordered: the
+        trace's own spans plus — through recorded causal links — the
+        flush batch / settle spans of its full lifecycle (the demo's
+        "full span chain" view; identical to a single-trace filter when
+        no links were recorded)."""
+        return self.causal(trace_id)["spans"]
 
     def last_trace_id(self) -> int:
         """Highest trace id with at least one recorded span (0 if none)."""
@@ -139,8 +246,9 @@ class SpanRecorder:
 
     def clear(self) -> None:
         with self._rings_lock:
-            rings = list(self._rings)
+            rings = list(self._rings) + list(self._link_rings)
             self._rings = []
+            self._link_rings = []
         for ring in rings:
             ring.buf = []
             ring.idx = 0

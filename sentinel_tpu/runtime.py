@@ -1711,7 +1711,8 @@ class Sentinel:
             acquire: Optional[Sequence[int]] = None,
             entry_types: Optional[Sequence[int]] = None,
             prioritized: Optional[Sequence[bool]] = None,
-            args_list: Optional[Sequence[Sequence]] = None
+            args_list: Optional[Sequence[Sequence]] = None,
+            trace_id: int = 0
     ) -> "PendingVerdicts":
         """Dispatch-only batch tier: host prep + cluster delegation + the
         jitted decide are all issued, but the verdict readback (the ~RTT
@@ -1740,10 +1741,12 @@ class Sentinel:
         # (obs.spans stride) carries a trace id through its whole
         # lifecycle — entry prep → host gates → cluster precheck →
         # split decision → compile-cache lookup → device dispatch →
-        # settle (docs/OBSERVABILITY.md span schema)
+        # settle (docs/OBSERVABILITY.md span schema). A caller-minted
+        # trace_id (DispatchPipeline / the serving front end) overrides
+        # the stride so the batch stays on its causal chain.
         obs = self.obs
         obs_on = obs.enabled
-        tr = obs.spans.maybe_trace() if obs_on else 0
+        tr = (trace_id or obs.spans.maybe_trace()) if obs_on else 0
         t0 = obs.spans.now_ns() if obs_on else 0
         if isinstance(resources, np.ndarray) and resources.dtype.kind in "iu":
             rows = np.ascontiguousarray(resources, np.int32)
@@ -1966,9 +1969,13 @@ class Sentinel:
                  else err_mod.exception_name_for(rcode))
         obs = self.obs
         obs.counters.add(obs_keys.BLOCK_PREFIX + label, count)
+        ms = self.clock.now_ms() if now_ms is None else now_ms
         obs.block_events.log(
-            self.clock.now_ms() if now_ms is None else now_ms,
-            resource, rcode, reason_name=label, origin=origin, count=count)
+            ms, resource, rcode, reason_name=label, origin=origin,
+            count=count)
+        # block-reason burst SLO trigger (obs/flight.py): one cheap
+        # counter roll per grouped denial record, window math inside
+        obs.flight.note_blocks(count, ms)
 
     def _cluster_precheck_batch(self, resources, origins, rows, origin_rows,
                                 chain_rows, acq, is_in, prio, args_list,

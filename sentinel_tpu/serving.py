@@ -111,17 +111,23 @@ class DispatchPipeline:
     def submit(self, resources, **entry_kwargs) -> PipelinedVerdicts:
         """Dispatch one entry batch through
         :meth:`Sentinel.entry_batch_nowait` (all its kwargs pass
-        through: origins, acquire, prioritized, args_list, ...)."""
+        through: origins, acquire, prioritized, args_list, ...).
+        ``trace_id`` threads a caller-minted trace (the frontend's batch
+        trace) through the pipeline AND the runtime dispatch, so the
+        whole lifecycle records under one id."""
         n = len(resources)
+        trace_id = entry_kwargs.get("trace_id", 0)
         return self._submit(
-            lambda: self._s.entry_batch_nowait(resources, **entry_kwargs), n)
+            lambda: self._s.entry_batch_nowait(resources, **entry_kwargs),
+            n, trace_id=trace_id)
 
     def submit_raw(self, *args, **kwargs) -> PipelinedVerdicts:
         """Dispatch through :meth:`Sentinel.decide_raw_nowait` (the
         registry-free tier: pre-resolved rows/ids in, verdicts out)."""
         n = args[0].shape[0] if args else 0
         return self._submit(
-            lambda: self._s.decide_raw_nowait(*args, **kwargs), n)
+            lambda: self._s.decide_raw_nowait(*args, **kwargs), n,
+            trace_id=kwargs.get("trace_id", 0))
 
     def submit_fused(self, *args, **kwargs) -> PipelinedVerdicts:
         """Dispatch through :meth:`Sentinel.decide_and_exit_raw_nowait`:
@@ -129,12 +135,14 @@ class DispatchPipeline:
         device program (see its docstring for the applicability scope)."""
         n = args[0].shape[0] if args else 0
         return self._submit(
-            lambda: self._s.decide_and_exit_raw_nowait(*args, **kwargs), n)
+            lambda: self._s.decide_and_exit_raw_nowait(*args, **kwargs), n,
+            trace_id=kwargs.get("trace_id", 0))
 
-    def _submit(self, dispatch, n: int) -> PipelinedVerdicts:
+    def _submit(self, dispatch, n: int,
+                trace_id: int = 0) -> PipelinedVerdicts:
         obs = self._s.obs
         obs_on = obs.enabled
-        tr = obs.spans.maybe_trace() if obs_on else 0
+        tr = (trace_id or obs.spans.maybe_trace()) if obs_on else 0
         t0 = obs.spans.now_ns() if tr else 0
         with self._lock:
             # make room BEFORE dispatching: settling the oldest here (a
@@ -147,7 +155,9 @@ class DispatchPipeline:
             handle = dispatch()
             seq = self._next_seq
             self._next_seq += 1
-            self._inflight.append((seq, handle))
+            # the batch's trace id rides the in-flight entry so the
+            # settle span lands on the SAME chain as the enqueue span
+            self._inflight.append((seq, handle, tr))
             if obs_on:
                 obs.counters.add(obs_keys.PIPE_DEPTH, len(self._inflight))
         if tr:
@@ -160,9 +170,10 @@ class DispatchPipeline:
     # ------------------------------------------------------------------
 
     def _settle_oldest_locked(self) -> None:
-        seq, handle = self._inflight.popleft()
+        seq, handle, tr = self._inflight.popleft()
         obs = self._s.obs
-        tr = obs.spans.maybe_trace() if obs.enabled else 0
+        if not obs.enabled:
+            tr = 0
         t0 = obs.spans.now_ns() if tr else 0
         self._results[seq] = handle.result()
         if tr:

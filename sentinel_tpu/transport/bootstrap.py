@@ -70,12 +70,15 @@ def start_transport(sentinel, *, host: str = "0.0.0.0", port: int = 8719,
         metric_searcher = MetricSearcher(
             sentinel.cfg.metric_dir(),
             form_metric_file_name(sentinel.cfg.app_name))
-        # attach the sampled block-event log (obs/eventlog.py) to the same
-        # metric directory — its 1 s drain rides metric_timer.tick()
+        # attach the sampled block-event log (obs/eventlog.py) and the
+        # SLO flight recorder's <app>-trace log (obs/flight.py) to the
+        # same metric directory — both 1 s drains ride metric_timer.tick()
         obs = getattr(sentinel, "obs", None)
         if obs is not None:
             obs.block_events.configure(sentinel.cfg.metric_dir(),
                                        sentinel.cfg.app_name)
+            obs.flight.configure(sentinel.cfg.metric_dir(),
+                                 sentinel.cfg.app_name)
     cstate = register_default_handlers(
         center, sentinel, metric_searcher=metric_searcher,
         extra_info=extra, writable_registry=writable_registry,

@@ -279,6 +279,31 @@ def register_default_handlers(
                 return CommandResponse.of_failure("invalid trace id", 400)
         return CommandResponse.of_success(json.dumps(payload))
 
+    def cmd_trace(req: CommandRequest) -> CommandResponse:
+        """Request-scoped trace export (docs/OBSERVABILITY.md "Request
+        tracing"). Params: ``id`` (a trace id → that chain's causal
+        closure as a Chrome-trace-event/Perfetto document; when the
+        flight recorder pinned the id, the pinned — possibly
+        richer-than-ring — record is exported); without ``id``, the
+        pinned-record index (``{"pinned": [...metadata...]}``)."""
+        obs = getattr(s, "obs", None)
+        if obs is None:
+            return CommandResponse.of_failure("observability unavailable",
+                                              404)
+        from sentinel_tpu.obs import traceexport
+        raw = req.param("id", "")
+        if not raw:
+            return CommandResponse.of_success(json.dumps({
+                "pinned": obs.flight.snapshot(limit=32)}))
+        try:
+            trace_id = int(raw)
+        except ValueError:
+            return CommandResponse.of_failure("invalid trace id", 400)
+        pinned = obs.flight.pinned(trace_id)
+        doc = (traceexport.chrome_trace(pinned) if pinned is not None
+               else traceexport.export_chain(obs.spans, trace_id))
+        return CommandResponse.of_success(json.dumps(doc))
+
     # ---- cluster mode ----------------------------------------------------
 
     def cmd_get_cluster_mode(req: CommandRequest) -> CommandResponse:
@@ -393,6 +418,7 @@ def register_default_handlers(
         ("jsonTree", "node tree (json)", cmd_json_tree),
         ("systemStatus", "system adaptive status", cmd_system_status),
         ("obs", "runtime self-telemetry snapshot", cmd_obs),
+        ("trace", "causal trace chain as chrome-trace JSON", cmd_trace),
         ("getClusterMode", "get cluster mode", cmd_get_cluster_mode),
         ("setClusterMode", "set cluster mode", cmd_set_cluster_mode),
         ("getClusterClientConfig", "get cluster client config",

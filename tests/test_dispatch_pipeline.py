@@ -230,7 +230,7 @@ def test_in_order_settle_under_out_of_order_results(clk):
     tickets = [pipe.submit(["a", "b"]) for _ in range(3)]
     order = []
     with pipe._lock:
-        for seq, h in pipe._inflight:
+        for seq, h, _tr in pipe._inflight:
             fn = h._cell.fn
 
             def spied(f=fn, s=seq):
