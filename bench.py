@@ -13,6 +13,13 @@ Knobs via env: BENCH_RESOURCES, BENCH_BATCH, BENCH_STEPS, BENCH_RULES,
 BENCH_SHARDS (>1 row-shards the counter tensors over that many devices via
 parallel/local_shard.py — the product multi-chip mode; requires that many
 visible devices, e.g. the 8-virtual-device CPU harness or a real pod).
+
+The artifact always carries a ``mesh`` block (device count, rows per
+device, sharded-vs-replicated state leaf counts, donation/staging knob
+state) so the 1-chip run is a self-describing comparison row, and — on
+sharded runs or under BENCH_WEAK_SCALING=1 — a ``weak_scaling`` block:
+the 1/2/4/8-device fixed-rows-per-device curve through the runtime with
+its normalized flatness ratios (benchmarks/weak_scaling.py).
 """
 
 from __future__ import annotations
@@ -166,7 +173,11 @@ def main() -> None:
     import jax.numpy as jnp
 
     from sentinel_tpu.core.registry import OriginRegistry, Registry, ResourceRegistry
-    from sentinel_tpu.runtime import pipeline_depth as _pipeline_depth
+    from sentinel_tpu.runtime import (
+        donation_enabled as _donation_enabled,
+        host_staging_enabled as _staging_enabled,
+        pipeline_depth as _pipeline_depth,
+    )
     from sentinel_tpu.engine.pipeline import (
         EngineSpec, EntryBatch, RuleSet, decide_entries, init_state,
     )
@@ -226,23 +237,22 @@ def main() -> None:
 
     state = init_state(spec, NRULES, max(len(deg_rules), 1))
 
-    SHARDS = int(os.environ.get("BENCH_SHARDS", "1"))
-    mesh_sh = None
-    if SHARDS > 1:
-        from jax.sharding import Mesh
+    # One layout authority (parallel/local_shard.py) for mesh construction,
+    # shardings, and placement — the runtime, this bench, and the gates all
+    # build the serving layout through the same helpers.
+    from sentinel_tpu.parallel.local_shard import (
+        local_mesh, mesh_topology, pin_state, place_batch, shardings_for,
+    )
 
-        from sentinel_tpu.parallel.local_shard import (
-            MESH_AXIS, state_shardings, validate_mesh, verdict_shardings,
-        )
-        devs = jax.devices()
-        if len(devs) < SHARDS:
-            raise SystemExit(f"BENCH_SHARDS={SHARDS} but only {len(devs)} "
-                             f"devices visible")
-        mesh = Mesh(np.array(devs[:SHARDS]), (MESH_AXIS,))
-        validate_mesh(spec, mesh)
-        st_sh = state_shardings(spec, mesh, state)
-        mesh_sh = (st_sh, verdict_shardings(mesh))
-        state = jax.tree.map(jax.device_put, state, st_sh)
+    SHARDS = int(os.environ.get("BENCH_SHARDS", "1"))
+    mesh = mesh_sh = None
+    if SHARDS > 1:
+        try:
+            mesh = local_mesh(SHARDS)
+        except ValueError as exc:
+            raise SystemExit(str(exc))
+        mesh_sh = shardings_for(spec, mesh, state)
+        state = pin_state(state, mesh_sh[0])
 
     rng = np.random.default_rng(42)
     n_batches = 4
@@ -263,6 +273,11 @@ def main() -> None:
             is_in=jnp.ones(B, jnp.bool_),
             prioritized=jnp.zeros(B, jnp.bool_),
             valid=jnp.ones(B, jnp.bool_)))
+    if mesh is not None:
+        # batch columns partitioned on the event axis, exactly as the
+        # runtime's dispatch tier places them (layout only — values and
+        # verdicts are unchanged; the parity tests pin that)
+        batches = [place_batch(b, mesh) for b in batches]
 
     # record_alt=False + scalar_flow: the bench batch carries no origin/
     # chain rows, uniform acquire=1, no priorities — the runtime selects
@@ -383,6 +398,16 @@ def main() -> None:
             "SENTINEL_FRONTEND_DEADLINE_MS", "SENTINEL_FRONTEND_BUDGET_MS",
             "SENTINEL_FRONTEND_IDLE_MS", "SENTINEL_FRONTEND_QUEUE",
         ) if k in os.environ},
+        # serving layout that produced the headline (n_devices=1 on the
+        # single-chip run — the comparison row the weak-scaling curve and
+        # sharded artifacts are read against), plus the transfer knobs
+        # whose defaults depend on the mesh (donation on, host staging
+        # bypassed when batch placement is active)
+        "mesh": {**mesh_topology(spec, mesh,
+                                 mesh_sh[0] if mesh_sh else None),
+                 "donation": _donation_enabled(),
+                 "host_staging": mesh is None and _staging_enabled(),
+                 "batch_placement": mesh is not None},
     }
     # General-path + mixed-batch numbers ride the same artifact (VERDICT
     # r4 #10: the non-happy path must not regress silently). Skippable via
@@ -411,6 +436,32 @@ def main() -> None:
             out["serving"] = measure_serving(jax)
         except Exception as exc:      # noqa: BLE001
             out["serving_error"] = repr(exc)
+    # 1/2/4/8-device weak-scaling curve through the runtime (r9: fixed
+    # rows per device, DispatchPipeline depth swept). Runs by default only
+    # on a sharded invocation (the single-chip TPU artifact would see one
+    # device and produce a degenerate curve); BENCH_WEAK_SCALING=1 forces
+    # it (the CPU virtual-device harness), =0 skips. Never takes the
+    # headline down.
+    ws_knob = os.environ.get("BENCH_WEAK_SCALING", "")
+    if ws_knob != "0" and (ws_knob == "1" or SHARDS > 1):
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        try:
+            from benchmarks.weak_scaling import flatness, measure as ws_measure
+            counts = tuple(n for n in (1, 2, 4, 8)
+                           if n <= max(SHARDS, len(jax.devices())))
+            points = ws_measure(
+                jax,
+                rows_per_dev=int(os.environ.get("WEAK_ROWS_PER_DEV",
+                                                str(1 << 14))),
+                batch=int(os.environ.get("WEAK_BATCH", str(1 << 13))),
+                steps=int(os.environ.get("WEAK_STEPS", "6")),
+                device_counts=counts,
+                depths=tuple(int(d) for d in os.environ.get(
+                    "WEAK_DEPTHS", "1,2,4").split(",")))
+            out["weak_scaling"] = {"curve": points,
+                                   "flatness_norm": flatness(points)}
+        except Exception as exc:      # noqa: BLE001
+            out["weak_scaling_error"] = repr(exc)
     print(json.dumps(out))
 
 

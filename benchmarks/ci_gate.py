@@ -67,6 +67,16 @@ flash-crowd deadline miss must leave a persisted ``<app>-trace`` chain
 behind (obs/flight.py) that spans the request AND batch tiers and
 survives the Chrome-trace export round trip. See the comment block
 above ``TRACE_REQUIRED_REQUEST_SPAN``.
+
+Gate (h) — the meshed-serving gate (r9): on an 8-virtual-device CPU
+mesh (a ``--meshed`` subprocess, so XLA_FLAGS lands before jax
+initializes), the row-sharded engine's verdicts through the FULL
+serving path — DispatchPipeline, fused decide+exit, split/prio/occupy
+routing, a rule reload with live occupy bookings, and the
+AdaptiveBatcher fan-out — must be bit-identical to the single-device
+engine, and the weak-scaling curve's normalized per-partition cost must
+stay flat (≤ ``WEAK_SCALING_FLAT_MAX``). ``CI_GATE_MESHED=0`` skips.
+See the comment block above ``MESHED_ENV_FLAG``.
 """
 
 from __future__ import annotations
@@ -634,6 +644,246 @@ def measure_trace_capture() -> dict:
     }
 
 
+# Gate (h) — the meshed-serving gate (r9): the row-sharded engine IS the
+# serving hot path, so its promotion is pinned by two probes run in a
+# dedicated subprocess on an 8-virtual-device CPU mesh (XLA_FLAGS must be
+# set before the jax backend initializes — hence the ``--meshed``
+# re-exec, the same isolation trick measure_once uses for bench.py):
+#   parity:   a single-device engine and an 8-device meshed engine are
+#             driven through the FULL serving stack with identical
+#             traffic — DispatchPipeline over decide_raw_nowait (a mixed
+#             batch above the split threshold with 10% origins and 1%
+#             prioritized, so the split + fast-occupy routes fire), a
+#             mid-stream rule reload with live occupy bookings (the
+#             carry path), the fused decide+exit tier, and the
+#             AdaptiveBatcher fan-out (meshed verdicts replayed
+#             flush-by-flush on the single-device twin) — and every
+#             verdict must be BIT-IDENTICAL. Placement is layout, not
+#             math; any divergence means the mesh path computes
+#             something different from what the tests promise.
+#             Mechanism probes ride along: the split dispatch must
+#             actually fire, ROUTE_MESHED/PIPE_MESHED must tick, and
+#             both engines must CARRY the same number of live occupy
+#             bookings across the reload (a zero means the probe never
+#             exercised the carry path it claims to pin).
+#   flatness: the weak-scaling curve (benchmarks/weak_scaling.py) at
+#             small shapes — fixed rows per device, 1/2/4/8 devices,
+#             depth-swept through the pipeline. On this host the
+#             virtual devices SERIALIZE, so the gated number is the
+#             normalized per-partition cost step_ms(n)/(n·step_ms(1)):
+#             ~1.0 benign (measured 0.71-1.02 here), and climbing past
+#             WEAK_SCALING_FLAT_MAX only on super-linear pathology
+#             (all-to-all blowup, per-shard recompiles, a host loop
+#             over shards) — the portable signal that survives the move
+#             to real parallel silicon.
+# CI_GATE_MESHED=0 skips the whole gate (e.g. a tier that already ran
+# it, or a debug loop on the other gates).
+MESHED_ENV_FLAG = "CI_GATE_MESHED"
+WEAK_SCALING_FLAT_MAX = 1.6
+MESHED_N_DEV = 8
+
+
+def _meshed_parity(jax) -> dict:
+    import numpy as np
+
+    import sentinel_tpu as stpu
+    from sentinel_tpu.core.clock import ManualClock
+    from sentinel_tpu.obs import counters as obs_keys
+    from sentinel_tpu.parallel.local_shard import local_mesh
+    from sentinel_tpu.serving import DispatchPipeline
+
+    T0 = 1_785_000_000_000
+
+    def cfg():
+        return stpu.load_config(
+            max_resources=64, max_origins=32, max_flow_rules=32,
+            max_degrade_rules=16, max_authority_rules=16,
+            host_fast_path=False)
+
+    def build(mesh):
+        s = stpu.Sentinel(cfg(), clock=ManualClock(start_ms=T0), mesh=mesh)
+        s.load_flow_rules([
+            stpu.FlowRule(resource="api", count=3.0),
+            stpu.FlowRule(resource="api", count=2.0, limit_app="app-a"),
+            stpu.FlowRule(resource="bulk", count=1e6),
+        ])
+        return s
+
+    ref, meshed = build(None), build(local_mesh(MESHED_N_DEV))
+
+    def vequal(a, b) -> bool:
+        return (np.array_equal(np.asarray(a.allow), np.asarray(b.allow))
+                and np.array_equal(np.asarray(a.reason),
+                                   np.asarray(b.reason))
+                and np.array_equal(np.asarray(a.wait_ms),
+                                   np.asarray(b.wait_ms)))
+
+    # mixed raw traffic above the 4096 split threshold: 90% scalar bulk,
+    # 10% origin-carrying (the general side), 1% prioritized (the
+    # fast-occupy side, denied often enough under count=3.0 to book)
+    rng = np.random.default_rng(29)
+    n = 8192
+    row_api = ref.resources.get_or_create("api")
+    row_bulk = ref.resources.get_or_create("bulk")
+    assert meshed.resources.get_or_create("api") == row_api
+    assert meshed.resources.get_or_create("bulk") == row_bulk
+    oid = ref.origins.pin("app-a")
+    meshed.origins.pin("app-a")
+    pad_a = ref.spec.alt_rows
+    rows = np.where(rng.random(n) < 0.5, row_api, row_bulk).astype(np.int32)
+    has_o = rng.random(n) < 0.1
+    oids = np.where(has_o, oid, 0).astype(np.int32)
+    # alt rows are scalar-hashed per (resource row, origin); record the
+    # edge on BOTH engines so eviction hygiene stays in lockstep
+    alt = {r: ref._alt_row(r, 0, int(oid)) for r in (row_api, row_bulk)}
+    for r in (row_api, row_bulk):
+        assert meshed._alt_row(r, 0, int(oid)) == alt[r]
+    orow = np.where(has_o,
+                    np.where(rows == row_api, alt[row_api], alt[row_bulk]),
+                    pad_a).astype(np.int32)
+    ctx0 = np.zeros(n, np.int32)
+    chain = np.full(n, pad_a, np.int32)
+    ones = np.ones(n, np.int32)
+    is_in = np.ones(n, np.bool_)
+    prio = rng.random(n) < 0.01
+    rt = np.full(n, 5, np.int32)
+    err = np.zeros(n, np.bool_)
+
+    split_calls = []
+    orig_split = meshed._decide_split_nowait
+    meshed._decide_split_nowait = lambda *a, **k: (
+        split_calls.append(1), orig_split(*a, **k))[1]
+
+    out = {"parity": {}}
+    pipes = {"ref": DispatchPipeline(ref, depth=2),
+             "meshed": DispatchPipeline(meshed, depth=2)}
+
+    def drive_raw(steps: int, tick0: int) -> bool:
+        got = {}
+        for key, pipe in pipes.items():
+            tickets = [pipe.submit_raw(
+                rows, oids, orow, ctx0, chain, ones, is_in, prio,
+                at_ms=T0 + (tick0 + i) * 250) for i in range(steps)]
+            got[key] = [t.result() for t in tickets]
+        return all(vequal(a, b) for a, b in zip(got["ref"], got["meshed"]))
+
+    # depth-2 pipelined dispatch, windows rotating, split + occupy live
+    out["parity"]["pipeline_raw"] = drive_raw(4, 0)
+    granted = {k: s.obs.counters.get(obs_keys.OCCUPY_GRANTED)
+               for k, s in (("ref", ref), ("meshed", meshed))}
+    # rule reload with those bookings still PENDING: the engine clock
+    # must first catch up to the traffic timeline — settle_occupied
+    # carries only bookings whose target window is the clock's next one
+    for s in (ref, meshed):
+        s.clock.advance_ms(750)
+        s.load_flow_rules([
+            stpu.FlowRule(resource="api", count=4.0),
+            stpu.FlowRule(resource="api", count=2.0, limit_app="app-a"),
+            stpu.FlowRule(resource="bulk", count=1e6),
+        ])
+    out["parity"]["post_reload"] = drive_raw(4, 4)
+    # fused decide+exit through the pipeline
+    fused = {}
+    for key, pipe in pipes.items():
+        tickets = [pipe.submit_fused(
+            rows, oids, orow, ctx0, chain, ones, is_in, prio,
+            exit_rows=rows, exit_origin_rows=orow, exit_chain_rows=chain,
+            exit_acquire=ones, exit_rt_ms=rt, exit_error=err,
+            exit_is_in=is_in, at_ms=T0 + (8 + i) * 50)
+            for i in range(3)]
+        fused[key] = [t.result() for t in tickets]
+    out["parity"]["fused"] = all(
+        vequal(a, b) for a, b in zip(fused["ref"], fused["meshed"]))
+
+    out["split_fired"] = len(split_calls)
+    out["occupy_granted_ref"] = granted["ref"]
+    out["occupy_granted_meshed"] = granted["meshed"]
+    out["occupy_carried_ref"] = ref.obs.counters.get(
+        obs_keys.OCCUPY_CARRIED)
+    out["occupy_carried_meshed"] = meshed.obs.counters.get(
+        obs_keys.OCCUPY_CARRIED)
+    out["route_meshed"] = meshed.obs.counters.get(obs_keys.ROUTE_MESHED)
+    out["pipe_meshed"] = meshed.obs.counters.get(obs_keys.PIPE_MESHED)
+    ref.close()
+    meshed.close()
+
+    # front-end fan-out: the batcher on the MESHED engine, its recorded
+    # flush cuts replayed sequentially on a fresh single-device twin
+    import asyncio
+
+    from sentinel_tpu.frontend.batcher import AdaptiveBatcher
+
+    fe_m, seq_r = build(local_mesh(MESHED_N_DEV)), build(None)
+    frng = np.random.default_rng(31)
+    stream = [("api" if frng.random() < 0.7 else "bulk",
+               bool(frng.random() < 0.3),
+               "app-a" if frng.random() < 0.4 else "")
+              for _ in range(42)]
+
+    async def run():
+        b = AdaptiveBatcher(fe_m, batch_max=8, deadline_ms=60_000,
+                            idle_ms=10_000.0, depth=2, record_flushes=True)
+        verdicts = await asyncio.gather(
+            *(b.submit(r, prioritized=p, origin=o) for r, p, o in stream))
+        await b.drain()
+        return verdicts, b.flush_log
+
+    verdicts, flush_log = asyncio.run(run())
+    seq = []
+    for f in flush_log:
+        v = seq_r.entry_batch_nowait(
+            f["resources"],
+            acquire=np.asarray(f["counts"], np.int32),
+            prioritized=np.asarray(f["prioritized"], np.bool_),
+            origins=(f["origins"] if any(f["origins"]) else None),
+        ).result()
+        seq.extend(zip(np.asarray(v.allow), np.asarray(v.reason),
+                       np.asarray(v.wait_ms)))
+    out["parity"]["frontend"] = (
+        len(seq) == len(verdicts)
+        and all((g.allow, g.reason, g.wait_ms)
+                == (bool(w[0]), int(w[1]), int(w[2]))
+                for g, w in zip(verdicts, seq)))
+    fe_m.close()
+    seq_r.close()
+    return out
+
+
+def meshed_main() -> int:
+    """The ``--meshed`` re-exec body: 8 virtual CPU devices (flag set
+    before jax initializes), parity + flatness, ONE JSON line out."""
+    os.environ.setdefault(
+        "XLA_FLAGS", f"--xla_force_host_platform_device_count={MESHED_N_DEV}")
+    sys.path.insert(0, str(HERE.parent))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from benchmarks import weak_scaling
+
+    out = _meshed_parity(jax)
+    points = weak_scaling.measure(
+        jax, rows_per_dev=2048, batch=4096, steps=4,
+        device_counts=(1, 2, 4, MESHED_N_DEV), depths=(1, 2), rules=64)
+    out["curve_devices"] = [p["devices"] for p in points if "step_ms" in p]
+    out["flatness_norm"] = weak_scaling.flatness(points)
+    print(json.dumps(out))
+    return 0
+
+
+def measure_meshed() -> dict:
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_PLATFORM": "cpu",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={MESHED_N_DEV}",
+    }
+    out = subprocess.run(
+        [sys.executable, str(Path(__file__).resolve()), "--meshed"],
+        env=env, capture_output=True, text=True, timeout=900)
+    if out.returncode != 0:
+        return {"error": (out.stderr or out.stdout)[-2000:]}
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def main() -> int:
     best = max(measure_once() for _ in range(3))
     cal = calibrate()
@@ -644,6 +894,8 @@ def main() -> int:
     disp = measure_dispatch_pipeline()
     serving = measure_serving()
     trace = measure_trace_capture()
+    meshed = (measure_meshed()
+              if os.environ.get(MESHED_ENV_FLAG, "1") != "0" else None)
     ratios = {k.replace("_s_per_step", "_ratio"): v / cal
               for k, v in prep.items()}
     if "--update" in sys.argv:
@@ -668,6 +920,9 @@ def main() -> int:
              # informational: gate (g) is binary (mechanism), nothing
              # machine-relative to pin
              "trace_capture": trace,
+             # informational: gate (h) is parity (binary) plus the fixed
+             # WEAK_SCALING_FLAT_MAX band, not re-baselined per machine
+             "meshed_serving": meshed,
              "calibration_s": cal}, indent=1))
         print(f"baseline updated: floor={best / 2:.0f} (measured {best:.0f}) "
               f"on {fingerprint()}; host-prep ratios "
@@ -693,9 +948,60 @@ def main() -> int:
         "serving": {k: (round(v, 4) if isinstance(v, float) else v)
                     for k, v in serving.items()},
         "trace_capture": trace,
+        "meshed_serving": meshed if meshed is not None else "skipped",
     }
     print(json.dumps(out))
     rc = 0
+    if meshed is not None:
+        if "error" in meshed:
+            print(f"MESHED-GATE REGRESSION: the --meshed probe subprocess "
+                  f"failed to run: {meshed['error']}", file=sys.stderr)
+            rc = 1
+        else:
+            for probe, ok in meshed["parity"].items():
+                if not ok:
+                    print(f"MESHED-PARITY REGRESSION ({probe}): verdicts "
+                          f"through the meshed serving path diverged from "
+                          f"the single-device engine — placement must be "
+                          f"layout, not math; the row-sharded hot path is "
+                          f"computing something different", file=sys.stderr)
+                    rc = 1
+            if meshed["split_fired"] == 0:
+                print("MESHED-MECHANISM REGRESSION: the mixed probe batch "
+                      "never took the split dispatch on the meshed engine "
+                      "— the parity above did not cover the prio/occupy "
+                      "routing it claims to", file=sys.stderr)
+                rc = 1
+            if meshed["route_meshed"] == 0 or meshed["pipe_meshed"] == 0:
+                print(f"MESHED-MECHANISM REGRESSION: mesh attribution "
+                      f"counters dead (split_route.meshed="
+                      f"{meshed['route_meshed']}, pipeline.meshed_dispatch="
+                      f"{meshed['pipe_meshed']}) — the scrape can no "
+                      f"longer tell meshed traffic from single-device",
+                      file=sys.stderr)
+                rc = 1
+            carried = (meshed["occupy_carried_ref"],
+                       meshed["occupy_carried_meshed"])
+            if carried[0] != carried[1] or carried[0] == 0:
+                print(f"MESHED-OCCUPY REGRESSION: occupy bookings carried "
+                      f"across the rule reload diverged or never happened "
+                      f"(ref={carried[0]}, meshed={carried[1]}) — the "
+                      f"booking carry path is broken or unexercised on "
+                      f"the mesh", file=sys.stderr)
+                rc = 1
+            flat = meshed.get("flatness_norm") or {}
+            worst = max((v for k, v in flat.items() if k != "1"),
+                        default=None)
+            if (worst is None or worst > WEAK_SCALING_FLAT_MAX
+                    or MESHED_N_DEV not in meshed.get("curve_devices", [])):
+                print(f"WEAK-SCALING REGRESSION: normalized per-partition "
+                      f"cost {flat} (curve over "
+                      f"{meshed.get('curve_devices')}) — worst ratio "
+                      f"{worst} vs max {WEAK_SCALING_FLAT_MAX}; per-step "
+                      f"cost is growing super-linearly with device count "
+                      f"(all-to-all blowup, per-shard recompiles, or a "
+                      f"host loop over shards)", file=sys.stderr)
+                rc = 1
     if trace["pinned_records"] == 0 or "deadline_miss" not in trace["kinds"]:
         print(f"TRACE-CAPTURE REGRESSION: {trace['induced_misses']} induced "
               f"deadline misses pinned {trace['pinned_records']} chains "
@@ -812,4 +1118,6 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if "--meshed" in sys.argv:
+        raise SystemExit(meshed_main())
     raise SystemExit(main())

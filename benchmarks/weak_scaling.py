@@ -1,23 +1,35 @@
-"""Virtual weak-scaling curve for the row-sharded product engine.
+"""Weak-scaling curve for the row-sharded serving engine (1/2/4/8).
 
-CORRECTNESS-TIER ONLY: the 1/2/4/8 "devices" are virtual CPU devices
-sharing one physical host CPU, so absolute times mean nothing and
-speedups are not expected. What the curve shows is that per-step cost
-does NOT blow up as device count grows at fixed per-device rows — i.e.
-the sharded step's collective/layout overhead is flat, not pathological
-(VERDICT r4 #6: when real multi-chip hardware appears, the build should
-already know its collectives aren't the problem).
+Promoted in round 9 from a standalone correctness probe to the
+bench.py artifact's ``weak_scaling`` block: per-device rows held FIXED
+(R = rows_per_device × n), traffic dispatched THROUGH THE RUNTIME
+(``Sentinel(mesh=...)`` + :class:`~sentinel_tpu.serving.DispatchPipeline`
+over ``decide_raw_nowait``) with the pipeline depth swept, so the curve
+measures the serving hot path — host prep, batch-axis placement, pinned
+out-shardings, pipelined settle — not a bare jitted step.
 
-Fixed per-device rows (default 128k) → R = rows_per_device x n. One
-fused scalar decide step per measurement, chained + honest-gated like
-every other harness.
+CORRECTNESS-TIER ON CPU: the 1/2/4/8 "devices" are virtual CPU devices
+sharing one physical host, so absolute times mean nothing and speedups
+are not expected — on a host with fewer cores than devices the n
+partitions SERIALIZE and wall-clock step time grows ~linearly in n by
+construction. The portable flatness signal is therefore the
+PER-PARTITION cost ``step_ms(n) / (n × step_ms(1))`` (:func:`flatness`):
+≈1.0 when the sharded step's collective/layout overhead is benign on a
+saturated host, <1.0 when real parallel silicon helps, and climbing
+well above 1 exactly when something pathological scales super-linearly
+with device count (all-to-all blowup, per-shard recompiles, a host loop
+over shards). benchmarks/ci_gate.py gate (h) bands that normalized
+ratio, so when real multi-chip hardware appears the build already knows
+its collectives aren't the problem (VERDICT r4 #6).
 
 Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
      python benchmarks/weak_scaling.py
+Knobs: WEAK_ROWS_PER_DEV, WEAK_BATCH, WEAK_STEPS, WEAK_DEPTHS.
 """
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import sys
@@ -28,6 +40,99 @@ import numpy as np
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+T0 = 1_785_000_000_000
+
+
+def measure(jax, rows_per_dev: int, batch: int, steps: int,
+            device_counts=(1, 2, 4, 8), depths=(1, 2, 4),
+            rules: int = 512) -> list:
+    """One curve point per device count that fits the visible devices:
+    ``{"devices", "rows", "rows_per_device", "batch",
+    "step_ms": {depth: ms}, "mesh": {...}}``. Through-the-runtime:
+    pre-resolved raw columns submitted via ``DispatchPipeline.submit_raw``
+    on a ManualClock, each depth timed over ``steps`` settled batches."""
+    from jax.sharding import PartitionSpec as P
+
+    from sentinel_tpu.core.clock import ManualClock
+    from sentinel_tpu.core.config import load_config
+    from sentinel_tpu.parallel.local_shard import (
+        MESH_AXIS, local_mesh, mesh_topology,
+    )
+    from sentinel_tpu.runtime import Sentinel
+    from sentinel_tpu.rules.flow import FlowRule
+    from sentinel_tpu.serving import DispatchPipeline
+
+    n_visible = len(jax.devices())
+    out = []
+    for n in device_counts:
+        if n > n_visible:
+            out.append({"devices": n, "error": "not enough devices"})
+            continue
+        R = rows_per_dev * n
+        mesh = local_mesh(n)
+        clk = ManualClock(start_ms=T0)
+        eng = Sentinel(load_config(max_resources=R,
+                                   max_flow_rules=max(rules, 1),
+                                   max_degrade_rules=64,
+                                   max_authority_rules=16,
+                                   host_fast_path=False),
+                       clock=clk, mesh=mesh)
+        eng.load_flow_rules([FlowRule(resource=f"r{i}", count=1e6)
+                             for i in range(rules)])
+        # the probe is only honest if the state actually sharded
+        assert (eng._state.second.counters.sharding.spec == P(MESH_AXIS))
+        rng = np.random.default_rng(2)
+        rows = rng.integers(1, R, batch).astype(np.int32)
+        z = np.zeros(batch, np.int32)
+        p = np.full(batch, eng.spec.alt_rows, np.int32)
+        ones = np.ones(batch, np.int32)
+        tru = np.ones(batch, np.bool_)
+        fal = np.zeros(batch, np.bool_)
+
+        def run_depth(depth: int, tick0: int) -> float:
+            pipe = DispatchPipeline(eng, depth=depth)
+            tickets: "collections.deque" = collections.deque()
+            t_start = time.perf_counter()
+            for i in range(steps):
+                tickets.append(pipe.submit_raw(
+                    rows, z, p, z, p, ones, tru, fal,
+                    at_ms=T0 + (tick0 + i) * 2))
+                if len(tickets) > depth:
+                    tickets.popleft().result()
+            while tickets:
+                tickets.popleft().result()
+            return (time.perf_counter() - t_start) / steps * 1000
+
+        run_depth(max(depths), 0)            # warm compile, every variant
+        step_ms = {}
+        tick = steps
+        for d in depths:
+            step_ms[str(d)] = round(run_depth(d, tick), 2)
+            tick += steps
+        point = {"devices": n, "rows": R, "batch": batch,
+                 "rows_per_device": rows_per_dev,
+                 "step_ms": step_ms,
+                 "mesh": mesh_topology(eng.spec, mesh,
+                                       eng._mesh_shardings[0]),
+                 "tier": ("virtual-cpu-correctness"
+                          if jax.devices()[0].platform == "cpu"
+                          else jax.devices()[0].platform)}
+        eng.close()
+        out.append(point)
+    return out
+
+
+def flatness(points: list) -> dict:
+    """``{"<n>": step_ms(n) / (n × step_ms(1))}`` over the curve, using
+    each point's best depth — the machine-portable weak-scaling signal
+    (see the module docstring; gate (h) bands its maximum)."""
+    best = {p["devices"]: min(p["step_ms"].values())
+            for p in points if "step_ms" in p}
+    if 1 not in best or best[1] <= 0:
+        return {}
+    return {str(n): round(ms / (n * best[1]), 4)
+            for n, ms in sorted(best.items())}
+
 
 def main() -> None:
     os.environ.setdefault("XLA_FLAGS",
@@ -35,56 +140,15 @@ def main() -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    from jax.sharding import Mesh, PartitionSpec as P
-
-    from sentinel_tpu.core.clock import ManualClock
-    from sentinel_tpu.core.config import load_config
-    from sentinel_tpu.parallel.local_shard import MESH_AXIS
-    from sentinel_tpu.runtime import Sentinel
-    from sentinel_tpu.rules.flow import FlowRule
-
-    ROWS_PER_DEV = int(os.environ.get("WEAK_ROWS_PER_DEV", str(1 << 17)))
-    B = int(os.environ.get("WEAK_BATCH", str(1 << 16)))
-    STEPS = int(os.environ.get("WEAK_STEPS", "8"))
-    t0 = 1_785_000_000_000
-
-    for n in (1, 2, 4, 8):
-        devs = jax.devices()[:n]
-        if len(devs) < n:
-            print(json.dumps({"devices": n, "error": "not enough devices"}))
-            continue
-        R = ROWS_PER_DEV * n
-        mesh = Mesh(np.array(devs), (MESH_AXIS,))
-        clk = ManualClock(start_ms=t0)
-        eng = Sentinel(load_config(max_resources=R, max_flow_rules=512,
-                                   max_degrade_rules=64,
-                                   max_authority_rules=16,
-                                   host_fast_path=False),
-                       clock=clk, mesh=mesh)
-        eng.load_flow_rules([FlowRule(resource=f"r{i}", count=1e6)
-                             for i in range(512)])
-        assert (eng._state.second.counters.sharding.spec == P(MESH_AXIS))
-        rng = np.random.default_rng(2)
-        rows = rng.integers(1, R, B).astype(np.int32)
-        z = np.zeros(B, np.int32)
-        p = np.full(B, eng.spec.alt_rows, np.int32)
-        ones = np.ones(B, np.int32)
-        tru = np.ones(B, np.bool_)
-        fal = np.zeros(B, np.bool_)
-
-        def step(i):
-            return eng.decide_raw(rows, z, p, z, p, ones, tru, fal,
-                                  at_ms=t0 + i * 2)
-
-        step(0)                      # warm compile
-        t0s = time.perf_counter()
-        for i in range(STEPS):
-            step(1 + i)
-        dt = (time.perf_counter() - t0s) / STEPS * 1000
-        print(json.dumps({"devices": n, "rows": R, "batch": B,
-                          "step_ms": round(dt, 1),
-                          "rows_per_device": ROWS_PER_DEV,
-                          "tier": "virtual-cpu-correctness"}), flush=True)
+    rows_per_dev = int(os.environ.get("WEAK_ROWS_PER_DEV", str(1 << 17)))
+    batch = int(os.environ.get("WEAK_BATCH", str(1 << 16)))
+    steps = int(os.environ.get("WEAK_STEPS", "8"))
+    depths = tuple(int(d) for d in
+                   os.environ.get("WEAK_DEPTHS", "1,2,4").split(","))
+    points = measure(jax, rows_per_dev, batch, steps, depths=depths)
+    for point in points:
+        print(json.dumps(point), flush=True)
+    print(json.dumps({"flatness_norm": flatness(points)}), flush=True)
 
 
 if __name__ == "__main__":

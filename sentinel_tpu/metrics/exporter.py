@@ -28,7 +28,8 @@ Self-telemetry families (from ``Sentinel.obs`` — obs/; absent while
     sentinel_compile_cache_first_fetch_retries_total
     sentinel_block_reason_total{reason=...} denials by verdict code name
     sentinel_occupy_bookings_total{event=...} granted/carried/settled/evicted
-    sentinel_pipeline_total{event=...}     depth/stall/leaked_handles
+    sentinel_pipeline_total{event=...}     depth/stall/leaked_handles/
+                                           meshed_dispatch
     sentinel_frontend_total{event=...}     enqueue/queue_depth/shed
     sentinel_frontend_flush_total{reason=...} full/deadline/idle batch cuts
     sentinel_span_ring_wraps_total         spans/links lost to ring wrap
@@ -154,7 +155,8 @@ class SentinelCollector:
                                  (ck.ROUTE_FAST_OCCUPY, "fast_occupy"),
                                  (ck.ROUTE_GENERAL, "general_sorted"),
                                  (ck.ROUTE_SPLIT, "split_fired"),
-                                 (ck.ROUTE_FUSED, "fused_exit")):
+                                 (ck.ROUTE_FUSED, "fused_exit"),
+                                 (ck.ROUTE_MESHED, "meshed")):
                 route.add_metric([fam_key], counts.get(key, 0))
             hits.add_metric([], counts.get(ck.CACHE_HIT, 0))
             misses.add_metric([], counts.get(ck.CACHE_MISS, 0))
@@ -169,7 +171,8 @@ class SentinelCollector:
                 occupy.add_metric([ev], counts.get(key, 0))
             for key, ev in ((ck.PIPE_DEPTH, "depth"),
                             (ck.PIPE_STALL, "stall"),
-                            (ck.PIPE_LEAKED, "leaked_handles")):
+                            (ck.PIPE_LEAKED, "leaked_handles"),
+                            (ck.PIPE_MESHED, "meshed_dispatch")):
                 pipeline.add_metric([ev], counts.get(key, 0))
             for key, ev in ((ck.FE_ENQUEUE, "enqueue"),
                             (ck.FE_QUEUE_DEPTH, "queue_depth"),

@@ -9,7 +9,10 @@ dispatch stays within the 2% observability budget (benchmarks/ci_gate.py
   (:meth:`~sentinel_tpu.runtime.Sentinel.decide_raw_nowait` path
   selection): ``scalar`` / ``fast`` / ``fast_occupy`` /
   ``general_sorted``, plus ``split_fired`` when a mixed batch was
-  per-event split (``_decide_split_nowait``).
+  per-event split (``_decide_split_nowait``) and ``meshed`` when the
+  dispatch ran on a row-sharded engine (alongside its route counter:
+  meshed_total/route_total attributes how much traffic the mesh path
+  carries).
 * ``compile_cache.*`` — first-dispatch program accounting per (variant,
   geometry, statics) combo: ``hit`` / ``miss`` /
   ``first_fetch_retry`` (the guarded-fetch stall retries).
@@ -19,9 +22,10 @@ dispatch stays within the 2% observability budget (benchmarks/ci_gate.py
 * ``pipeline.*`` — dispatch-pipeline health (sentinel_tpu/serving.py):
   ``depth`` (sum of in-flight handles observed at each enqueue — divide
   by enqueue count for the achieved average depth), ``stall`` (submits
-  that had to settle the oldest in-flight batch first), and
+  that had to settle the oldest in-flight batch first),
   ``leaked_handles`` (PendingVerdicts settled by the GC finalizer
-  because ``.result()`` was never called).
+  because ``.result()`` was never called), and ``meshed_dispatch``
+  (submits whose backing Sentinel is row-sharded over a mesh).
 * ``frontend.*`` — the ingest tier (sentinel_tpu/frontend/):
   ``enqueue`` (requests accepted), ``queue_depth`` (sum of pending
   queue length sampled at each enqueue — divide by enqueues for the
@@ -86,6 +90,12 @@ SPAN_RING_WRAP = "obs.span_ring_wrap"     # spans/links lost to ring wrap
 FLIGHT_PINNED = "flight.pinned"           # chains pinned by an SLO trigger
 FLIGHT_TRIGGER_PREFIX = "flight.trigger."  # per-kind trigger tallies
 
+# PR 9 — meshed serving hot path: dispatches decided by a row-sharded
+# engine (one per decide/split/fused dispatch alongside its route
+# counter) and pipeline submits whose backing Sentinel is meshed
+ROUTE_MESHED = "split_route.meshed"
+PIPE_MESHED = "pipeline.meshed_dispatch"
+
 #: Fixed aggregation catalog (order is the wire format of the multihost
 #: counter vector — append only, never reorder).
 CATALOG = (
@@ -106,6 +116,7 @@ CATALOG = (
     FLIGHT_TRIGGER_PREFIX + "shed",
     FLIGHT_TRIGGER_PREFIX + "p99",
     FLIGHT_TRIGGER_PREFIX + "block_burst",
+    ROUTE_MESHED, PIPE_MESHED,
 )
 
 

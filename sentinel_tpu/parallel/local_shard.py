@@ -44,8 +44,10 @@ custom               user DeviceSlot pytrees     replicated
 
 from __future__ import annotations
 
-from typing import Optional
+import functools
+from typing import Optional, Sequence
 
+import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -53,6 +55,27 @@ from sentinel_tpu.engine.pipeline import EngineSpec, SentinelState, Verdicts
 from sentinel_tpu.parallel import shard_math
 
 MESH_AXIS = "rows"
+
+
+def local_mesh(n_devices: Optional[int] = None,
+               devices: Optional[Sequence] = None) -> Mesh:
+    """The one way to build a local row-sharding mesh — runtime callers,
+    benches, the driver dry run, and tests all construct through here so
+    the axis name and device ordering can never drift apart.
+
+    ``n_devices`` takes the first n visible devices (all of them when
+    None); pass ``devices`` to pin an explicit ordering instead."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            if len(devices) < n_devices:
+                raise ValueError(
+                    f"local_mesh(n_devices={n_devices}) but only "
+                    f"{len(devices)} devices visible — on CPU, set "
+                    f"XLA_FLAGS=--xla_force_host_platform_device_count="
+                    f"{n_devices}")
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (MESH_AXIS,))
 
 
 def validate_mesh(spec: EngineSpec, mesh: Mesh) -> None:
@@ -123,3 +146,56 @@ def shardings_for(spec: EngineSpec, mesh: Optional[Mesh],
         return None, None
     validate_mesh(spec, mesh)
     return state_shardings(spec, mesh, state), verdict_shardings(mesh)
+
+
+@functools.lru_cache(maxsize=8)
+def batch_shardings(mesh: Mesh):
+    """→ (batch_axis, replicated) :class:`NamedSharding` pair for event
+    columns (cached per mesh — one pair serves every dispatch)."""
+    return NamedSharding(mesh, P(MESH_AXIS)), NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, x) -> NamedSharding:
+    """Batch-axis sharding for ONE event column: partition the leading
+    (event) dimension over the mesh when it divides evenly, else
+    replicate — the retrieval brief's naive-sharding utility pattern
+    (SNIPPETS [1]/[2] ``get_naive_sharding``). Trailing dimensions (the
+    param-pair lanes) stay unpartitioned."""
+    sharded, rep = batch_shardings(mesh)
+    n = mesh.shape[MESH_AXIS]
+    return sharded if (x.ndim >= 1 and x.shape[0] % n == 0) else rep
+
+
+def place_batch(batch, mesh: Mesh):
+    """Place every present column of an ``EntryBatch`` / ``ExitBatch``
+    (any NamedTuple of host arrays with optional ``None`` leaves) on its
+    batch-axis sharding before dispatch. Explicit placement keeps the
+    host→device transfer of the event columns partitioned like the step
+    that consumes them — without it the compiled step would re-lay-out
+    replicated inputs on every dispatch. Values are unchanged (placement
+    is layout, not math); the parity tests pin that."""
+    return jax.tree.map(
+        lambda x: jax.device_put(x, batch_sharding(mesh, np.asarray(x))),
+        batch)
+
+
+def mesh_topology(spec: EngineSpec, mesh: Optional[Mesh],
+                  state_sh: Optional[SentinelState] = None) -> dict:
+    """Artifact-ready description of the serving layout: device count,
+    axis name, per-device row span, and — when the sharding pytree is
+    supplied — how many state leaves actually shard vs replicate, so a
+    BENCH artifact records the layout that produced its numbers."""
+    if mesh is None:
+        return {"n_devices": 1, "axis": None,
+                "rows_per_device": spec.rows, "sharded": False}
+    n = mesh.shape[MESH_AXIS]
+    out = {"n_devices": int(n), "axis": MESH_AXIS,
+           "rows_per_device": spec.rows // int(n), "sharded": True,
+           "multihost": len({d.process_index
+                             for d in np.ravel(np.asarray(mesh.devices))}) > 1}
+    if state_sh is not None:
+        leaves = jax.tree.leaves(state_sh)
+        n_rows = sum(1 for s in leaves if s.spec == P(MESH_AXIS))
+        out["state_leaves_sharded"] = n_rows
+        out["state_leaves_replicated"] = len(leaves) - n_rows
+    return out

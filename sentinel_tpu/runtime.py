@@ -487,6 +487,11 @@ class Sentinel:
         # leaves are only partially addressable per host.
         self.is_multihost = mesh is not None and len(
             {d.process_index for d in np.ravel(np.asarray(mesh.devices))}) > 1
+        # meshed serving places batch columns on batch-axis NamedShardings
+        # before dispatch (parallel/local_shard.place_batch) — single-
+        # process meshes only: a multihost batch column is per-process
+        # host data and stays with the SPMD replication contract
+        self._place_batches = mesh is not None and not self.is_multihost
         if mesh is not None:
             from sentinel_tpu.parallel.local_shard import validate_mesh
             validate_mesh(self.spec, mesh)
@@ -2271,6 +2276,8 @@ class Sentinel:
             if n_general_v > 0 and n_scalar_v >= 4096:
                 if obs_on:
                     obs.counters.add(obs_keys.ROUTE_SPLIT)
+                    if self.mesh is not None:
+                        obs.counters.add(obs_keys.ROUTE_MESHED)
                     if tr:
                         obs.spans.record(
                             tr, "decide.split_decision", t_d0,
@@ -2368,6 +2375,8 @@ class Sentinel:
             else:
                 route = obs_keys.ROUTE_GENERAL
             obs.counters.add(route)
+            if self.mesh is not None:
+                obs.counters.add(obs_keys.ROUTE_MESHED)
             t_disp = obs.spans.now_ns()
             if tr:
                 obs.spans.record(tr, "decide.dispatch", t_d0, t_disp, n=n,
@@ -2424,7 +2433,13 @@ class Sentinel:
         def _attempt():
             throwaway = init_state(self.spec, self.cfg.max_flow_rules,
                                    self.cfg.max_degrade_rules)
-            warm = batch._replace(valid=np.zeros(b, np.bool_))
+            # re-place the all-invalid copy so the warm execution's input
+            # shardings (hence its compiled program) match the real one's
+            warm = self._place_batch(
+                batch._replace(valid=np.zeros(b, np.bool_)))
+            if self.mesh is not None:
+                throwaway = jax.tree.map(jax.device_put, throwaway,
+                                         self._mesh_shardings[0])
             return jax.block_until_ready(
                 dec(self._ruleset, throwaway, warm, times, sys_scalars,
                     **flags))
@@ -2480,12 +2495,21 @@ class Sentinel:
         Serving-sized batches fill a preallocated staging slot
         (``_StagingRing``) in place of ~9 fresh allocations per step;
         the rare optional columns (param pairs, cluster bits, thread
-        counting, block recording) stay freshly allocated."""
+        counting, block recording) stay freshly allocated.
+
+        Meshed serving additionally places every column on its batch-axis
+        :class:`NamedSharding` (parallel/local_shard.place_batch) so the
+        host→device transfer lands partitioned like the step that
+        consumes it. Placement BYPASSES the staging ring: ``device_put``
+        gives no bound on when it finishes reading the source buffer, so
+        a reused slot could be rewritten mid-transfer by a later step in
+        the dispatch window — fresh columns make the handoff safe."""
         n = rows.shape[0]
         b = self._pad(n)
         pad_r = self.spec.rows
         pad_a = self.spec.alt_rows
-        if self._staging_on and b >= self._STAGING_MIN_B:
+        if (self._staging_on and b >= self._STAGING_MIN_B
+                and not self._place_batches):
             ring = self._staging.get(b)
             if ring is None:
                 ring = self._staging.setdefault(
@@ -2510,7 +2534,7 @@ class Sentinel:
             is_in_c = _pad_to(is_in, b, False, np.bool_)
             prio_c = _pad_to(prioritized, b, False, np.bool_)
             valid_c = _pad_to(vfull, b, False, np.bool_)
-        return EntryBatch(
+        batch = EntryBatch(
             rows=rows_c,
             origin_ids=origin_ids_c,
             origin_rows=origin_rows_c,
@@ -2530,6 +2554,15 @@ class Sentinel:
             record_block=(_pad_to(record_block, b, False, np.bool_)
                           if record_block is not None else None),
         )
+        return self._place_batch(batch)
+
+    def _place_batch(self, batch):
+        """Meshed-mode batch-axis placement (no-op otherwise); shared by
+        the entry, split, fused, and exit dispatch tiers."""
+        if not self._place_batches:
+            return batch
+        from sentinel_tpu.parallel.local_shard import place_batch
+        return place_batch(batch, self.mesh)
 
     def _decide_split_nowait(self, rows, origin_ids, origin_rows,
                              context_ids, chain_rows, acquire, is_in,
@@ -2767,6 +2800,7 @@ class Sentinel:
                    else _pad_to(np.ones(n_x, np.bool_), b_x, False,
                                 np.bool_)),
         )
+        xbatch = self._place_batch(xbatch)
         times = self._time_scalars(now)
         load1, cpu = self._cpu.sample()
         sys_scalars = jnp.asarray(np.array([load1, cpu], np.float32))
@@ -2817,6 +2851,8 @@ class Sentinel:
             else:
                 route = obs_keys.ROUTE_GENERAL
             obs.counters.add(obs_keys.ROUTE_FUSED)
+            if self.mesh is not None:
+                obs.counters.add(obs_keys.ROUTE_MESHED)
             t_disp = obs.spans.now_ns()
             if tr:
                 obs.spans.record(tr, "fused.dispatch", t_d0, t_disp, n=n,
@@ -2860,8 +2896,13 @@ class Sentinel:
         def _attempt():
             throwaway = init_state(self.spec, self.cfg.max_flow_rules,
                                    self.cfg.max_degrade_rules)
-            warm_e = batch._replace(valid=np.zeros(b_e, np.bool_))
-            warm_x = xbatch._replace(valid=np.zeros(b_x, np.bool_))
+            warm_e = self._place_batch(
+                batch._replace(valid=np.zeros(b_e, np.bool_)))
+            warm_x = self._place_batch(
+                xbatch._replace(valid=np.zeros(b_x, np.bool_)))
+            if self.mesh is not None:
+                throwaway = jax.tree.map(jax.device_put, throwaway,
+                                         self._mesh_shardings[0])
             return jax.block_until_ready(
                 fused(self._ruleset, throwaway, warm_e, warm_x, times,
                       sys_scalars, **flags))
@@ -2893,6 +2934,7 @@ class Sentinel:
             count_thread=(_pad_to(count_thread, b, False, np.bool_)
                           if count_thread is not None else None),
         )
+        batch = self._place_batch(batch)
         now = self.clock.now_ms() if at_ms is None else at_ms
         times = self._time_scalars(now)
         with self._lock:

@@ -20,9 +20,11 @@ order. ``tests/test_dispatch_pipeline.py`` pins
 
 Self-telemetry (obs/): ``pipeline.enqueue`` / ``pipeline.settle`` spans
 on sampled batches, ``pipeline.depth`` (sum of in-flight counts at each
-enqueue — divide by enqueues for the achieved average depth) and
+enqueue — divide by enqueues for the achieved average depth),
 ``pipeline.stall`` (submits that had to settle the oldest batch first)
-counters. Knob: ``SENTINEL_PIPELINE_DEPTH`` (default 2).
+and ``pipeline.meshed_dispatch`` (submits whose backing runtime is
+row-sharded over a mesh) counters. Knob: ``SENTINEL_PIPELINE_DEPTH``
+(default 2).
 """
 
 from __future__ import annotations
@@ -88,6 +90,10 @@ class DispatchPipeline:
     def __init__(self, sentinel: Sentinel, depth: Optional[int] = None,
                  on_settle=None):
         self._s = sentinel
+        # row-sharded runtime underneath: each submit also lands a
+        # pipeline.meshed_dispatch counter so the scrape can attribute
+        # pipeline traffic to the mesh path without reading the runtime
+        self._meshed = sentinel.mesh is not None
         self.depth = (pipeline_depth() if depth is None
                       else max(1, int(depth)))
         self._lock = threading.Lock()
@@ -160,6 +166,8 @@ class DispatchPipeline:
             self._inflight.append((seq, handle, tr))
             if obs_on:
                 obs.counters.add(obs_keys.PIPE_DEPTH, len(self._inflight))
+                if self._meshed:
+                    obs.counters.add(obs_keys.PIPE_MESHED)
         if tr:
             obs.spans.record(tr, "pipeline.enqueue", t0, obs.spans.now_ns(),
                              n=n, note=f"seq={seq}")

@@ -445,3 +445,15 @@ def test_catalog_vector_roundtrip():
     # newer-peer vectors (extra trailing keys) aggregate on the prefix
     longer = np.concatenate([vec, np.array([42], np.int64)])
     assert ck.vector_counts(longer) == back
+
+
+def test_catalog_is_append_only_with_r9_keys_last():
+    """The multihost allgather aggregates CATALOG by POSITION (prefix
+    compatibility with older peers), so the catalog may only ever grow at
+    the tail. Pin the round-9 mesh keys to the end — an insertion above
+    them (or a re-ordering) would silently mis-attribute every counter on
+    a mixed-version fleet."""
+    assert ck.CATALOG[-2:] == (ck.ROUTE_MESHED, ck.PIPE_MESHED)
+    assert ck.ROUTE_MESHED == "split_route.meshed"
+    assert ck.PIPE_MESHED == "pipeline.meshed_dispatch"
+    assert len(ck.CATALOG) == len(set(ck.CATALOG))

@@ -15,7 +15,7 @@ import sentinel_tpu as stpu
 from sentinel_tpu.core.clock import ManualClock
 from sentinel_tpu.core.errors import BlockException
 from sentinel_tpu.parallel.local_shard import (
-    MESH_AXIS, state_shardings, validate_mesh,
+    MESH_AXIS, local_mesh, state_shardings, validate_mesh,
 )
 from sentinel_tpu.rules.degrade import DegradeRule, GRADE_EXCEPTION_RATIO
 from sentinel_tpu.rules.flow import FlowRule
@@ -25,7 +25,7 @@ N_DEV = 8
 
 
 def _mesh():
-    return Mesh(np.array(jax.devices()[:N_DEV]), (MESH_AXIS,))
+    return local_mesh(N_DEV)
 
 
 def _cfg(**over):
