@@ -418,7 +418,14 @@ def main() -> None:
             from benchmarks.general_bench import measure
             del state, batches        # free HBM before the second fixture
             g_steps = int(os.environ.get("BENCH_GENERAL_STEPS", "20"))
-            out["general"] = measure(jax, "fast", R, B, g_steps, NRULES, 3)
+            # the sorted/sortfree pair carries the r10 claim: same mode,
+            # same fixture, aggregation stage swapped — with the
+            # per-stage aggregation_ms marginal in both rows
+            out["general"] = measure(jax, "fast", R, B, g_steps, NRULES, 3,
+                                     aggregation=True)
+            out["general_sortfree"] = measure(
+                jax, "fast", R, B, g_steps, NRULES, 3, sortfree=True,
+                aggregation=True)
             out["mixed"] = measure(jax, "mixed", R, B, g_steps, NRULES, 3)
             # prioritized-traffic numbers (r6: the 16x priority/occupy
             # cliff — BENCH artifacts from r06 on must carry them so a

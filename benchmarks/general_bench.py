@@ -335,11 +335,105 @@ def ablate(jax, spec, ruleset, state0, batches, t0_ms, STEPS,
     print(f"  {'floor':<40s} {results['-all (floor)']:9.2f} ms")
 
 
+def _aggregation_ms(jax, spec, ruleset, state0, batches, t0_ms, steps,
+                    flow_kw, sortfree: bool) -> float:
+    """Marginal cost of the SEGMENT-AGGREGATION stage (the r10 per-stage
+    artifact key): full step minus a step with the grouping stubbed out —
+    fixed permutation / zero ranks in place of the composite-key sort
+    (sorted path) or the claim cascade + counting order (sort-free path).
+    Same subtractive discipline as :func:`ablate`."""
+    import contextlib
+    import functools as ft
+    import time as tm
+
+    import jax.numpy as jnp
+
+    import sentinel_tpu.engine.pipeline as pl
+    from sentinel_tpu.ops import segments as seg_mod
+    from sentinel_tpu.ops import sortfree as sfo_mod
+
+    rng = np.random.default_rng(11)
+    B = batches[0].rows.shape[0]
+    K = ruleset.flow_idx.shape[1]
+    fixed_perm = jnp.asarray(rng.permutation(B * K).astype(np.int32))
+
+    def stub_sort(primary, secondary=None):
+        return fixed_perm[:primary.shape[0]]
+
+    def stub_ranks_slot(key):
+        return jnp.zeros(key.shape, jnp.int32)
+
+    def stub_pair_plan(k1, k2, sentinel_mask, bits):
+        return sfo_mod.BucketPlan(
+            bucket=jnp.zeros(k1.shape, jnp.int32),
+            overflow=jnp.asarray(False),
+            overflow_count=jnp.int32(0),
+            num_buckets=sfo_mod.ROUNDS * (1 << bits) + 1)
+
+    def stub_counting(bucket, num_buckets, ranks=None):
+        return fixed_perm[:bucket.shape[0]]
+
+    def stub_ranks2d(key2d, sentinel_value, bits):
+        return jnp.zeros(key2d.shape, jnp.int32), jnp.int32(0)
+
+    patches = ([(sfo_mod, "build_pair_plan", stub_pair_plan),
+                (sfo_mod, "counting_order", stub_counting),
+                (sfo_mod, "ranks2d_hashed", stub_ranks2d)]
+               if sortfree else
+               [(seg_mod, "sort_by_keys", stub_sort),
+                (seg_mod, "ranks_per_slot", stub_ranks_slot)])
+
+    @contextlib.contextmanager
+    def patched(on: bool):
+        saved = [(m, a, getattr(m, a)) for m, a, _ in patches] if on else []
+        if on:
+            for m, a, stub in patches:
+                setattr(m, a, stub)
+        try:
+            yield
+        finally:
+            for m, a, orig in saved:
+                setattr(m, a, orig)
+
+    sys_scalars = jnp.asarray(np.array([0.5, 0.1], np.float32))
+
+    def times_for(i):
+        now = t0_ms + i * 2
+        return jnp.asarray(np.array(
+            [spec.second.index_of(now), 0, now - t0_ms,
+             now % spec.second.win_ms], np.int32))
+
+    def run(stubbed: bool) -> float:
+        state = jax.tree.map(jnp.copy, state0)
+        with patched(stubbed):
+            step = jax.jit(ft.partial(
+                pl.decide_entries, spec, enable_occupy=False,
+                record_alt=True, skip_auth=True, skip_sys=True,
+                skip_threads=True, sortfree=sortfree, **flow_kw),
+                donate_argnums=(1,))
+            state, v = step(ruleset, state, batches[0], times_for(0),
+                            sys_scalars)
+        _ = np.asarray(v.allow[:1])
+        jax.block_until_ready(state)
+        t0 = tm.perf_counter()
+        for i in range(steps):
+            state, v = step(ruleset, state, batches[(1 + i) % len(batches)],
+                            times_for(1 + i), sys_scalars)
+        jax.block_until_ready((state, v))
+        return (tm.perf_counter() - t0) / steps * 1000
+
+    return run(False) - run(True)
+
+
 def measure(jax, mode: str, R: int, B: int, STEPS: int, NRULES: int,
-            REPEATS: int) -> dict:
+            REPEATS: int, sortfree: bool = False,
+            aggregation: bool = False) -> dict:
     """Measure one GENERAL_MODE → result dict (the JSON payload). Callable
     from bench.py so the driver artifact carries the general/mixed numbers
-    beside the headline (VERDICT r4 #10)."""
+    beside the headline (VERDICT r4 #10). ``sortfree`` measures the same
+    mode through the r10 hash-bucketed aggregation (the runtime default);
+    ``aggregation`` adds the per-stage ``aggregation_ms`` key (marginal
+    cost of the segment-grouping stage, subtractive)."""
     import jax.numpy as jnp
 
     from sentinel_tpu.engine.pipeline import decide_entries
@@ -413,21 +507,24 @@ def measure(jax, mode: str, R: int, B: int, STEPS: int, NRULES: int,
         step = jax.jit(functools.partial(
             decide_entries, spec, enable_occupy=True, record_alt=False,
             skip_auth=True, skip_sys=True, skip_threads=True,
-            fast_flow=True, scalar_has_rl=False), donate_argnums=(1,))
+            fast_flow=True, scalar_has_rl=False, sortfree=sortfree),
+            donate_argnums=(1,))
     else:
         step = jax.jit(functools.partial(
             decide_entries, spec, enable_occupy=False, record_alt=True,
-            skip_auth=True, skip_sys=True, skip_threads=True, **flow_kw),
-            donate_argnums=(1,))
+            skip_auth=True, skip_sys=True, skip_threads=True,
+            sortfree=sortfree, **flow_kw), donate_argnums=(1,))
     if mode == "mixed":
         step_s = jax.jit(functools.partial(
             decide_entries, spec, enable_occupy=False, record_alt=False,
             skip_auth=True, skip_sys=True, scalar_flow=True,
-            scalar_has_rl=False, skip_threads=True), donate_argnums=(1,))
+            scalar_has_rl=False, skip_threads=True, sortfree=sortfree),
+            donate_argnums=(1,))
         step_g = jax.jit(functools.partial(
             decide_entries, spec, enable_occupy=False, record_alt=True,
             skip_auth=True, skip_sys=True, fast_flow=True,
-            scalar_has_rl=False, skip_threads=True), donate_argnums=(1,))
+            scalar_has_rl=False, skip_threads=True, sortfree=sortfree),
+            donate_argnums=(1,))
     elif mode == "prio_mixed":
         # the occupy-aware split: scalar step with the occupy-base fold
         # on the 99% bulk + fast occupy step on the prioritized slice —
@@ -436,11 +533,13 @@ def measure(jax, mode: str, R: int, B: int, STEPS: int, NRULES: int,
         step_s = jax.jit(functools.partial(
             decide_entries, spec, enable_occupy=True, record_alt=False,
             skip_auth=True, skip_sys=True, scalar_flow=True,
-            scalar_has_rl=False, skip_threads=True), donate_argnums=(1,))
+            scalar_has_rl=False, skip_threads=True, sortfree=sortfree),
+            donate_argnums=(1,))
         step_g = jax.jit(functools.partial(
             decide_entries, spec, enable_occupy=True, record_alt=False,
             skip_auth=True, skip_sys=True, fast_flow=True,
-            scalar_has_rl=False, skip_threads=True), donate_argnums=(1,))
+            scalar_has_rl=False, skip_threads=True, sortfree=sortfree),
+            donate_argnums=(1,))
     sys_scalars = jnp.asarray(np.array([0.5, 0.1], np.float32))
 
     def scalars(i):
@@ -478,8 +577,9 @@ def measure(jax, mode: str, R: int, B: int, STEPS: int, NRULES: int,
         print(f"general_bench: {B * STEPS} decisions in {elapsed:.3f}s "
               f"({rates[-1]:.0f}/s)", file=sys.stderr)
     rate = sorted(rates)[len(rates) // 2]
-    return {
-        "metric": f"decisions_per_sec_general_{mode}_1chip",
+    suffix = "_sortfree" if sortfree else ""
+    out = {
+        "metric": f"decisions_per_sec_general_{mode}{suffix}_1chip",
         "value": round(rate, 1),
         "unit": "decisions/s",
         "vs_baseline": round(rate / 6.25e6, 4),
@@ -490,6 +590,14 @@ def measure(jax, mode: str, R: int, B: int, STEPS: int, NRULES: int,
         "batch": B,
         "resources": R,
     }
+    if aggregation and mode not in ("mixed", "prio_mixed"):
+        # per-stage key (r10): marginal cost of the segment-grouping
+        # stage in THIS variant's step — the sorted-vs-sortfree pair of
+        # these is the ablation the round-10 claim rides on
+        out["aggregation_ms"] = round(_aggregation_ms(
+            jax, spec, ruleset, state, batches, t0_ms,
+            min(STEPS, 10), flow_kw, sortfree), 3)
+    return out
 
 
 def main() -> None:
@@ -503,7 +611,10 @@ def main() -> None:
     NRULES = int(os.environ.get("BENCH_RULES", "4096"))
     REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
     mode = os.environ.get("GENERAL_MODE", "fast")
-    out = measure(jax, mode, R, B, STEPS, NRULES, REPEATS)
+    out = measure(jax, mode, R, B, STEPS, NRULES, REPEATS,
+                  sortfree=os.environ.get("GENERAL_SORTFREE", "0") == "1",
+                  aggregation=os.environ.get("GENERAL_AGGREGATION",
+                                             "0") == "1")
     if out:
         print(json.dumps(out))
 

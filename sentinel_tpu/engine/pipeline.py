@@ -165,6 +165,10 @@ class Verdicts(NamedTuple):
     allow: jnp.ndarray          # bool[B]
     reason: jnp.ndarray         # int8[B] (BlockReason codes)
     wait_ms: jnp.ndarray        # int32[B]
+    sf_overflow: Optional[jnp.ndarray] = None   # int32 scalar — sort-free
+    # claim-cascade overflow count this step (elements that took the
+    # sorted fallback; feeds obs counter sortfree.bucket_overflow). None
+    # when the step was built without the sortfree static.
 
 
 def _init_state_traced(spec: EngineSpec, nf: int, nd: int) -> SentinelState:
@@ -330,6 +334,12 @@ def decide_entries(
     # trade documented in docs/OPERATIONS.md); loading a gauge-reading
     # rule flips the flag (retrace) and the gauge warms as pre-flip
     # entries exit (decrements clamp at 0).
+    sortfree: bool = False,      # STATIC: every flow path groups segments
+    # via the sort-free hash-bucketed scatter machinery (ops/sortfree.py)
+    # instead of stable sorts — bit-exact by construction (claim overflow
+    # falls back to the sorted branch under lax.cond). The verdicts then
+    # carry sf_overflow (int32 scalar) for the runtime's
+    # sortfree.bucket_overflow counter.
 ) -> Tuple[SentinelState, Verdicts]:
     """One device step: decide a batch, then record post-decision statistics.
 
@@ -402,6 +412,7 @@ def decide_entries(
         in_r = (batch.rows < R)[:, None]
         flow_bk = jnp.where(in_r, joint[:, :Kf], NFs)
         deg_bk = jnp.where(in_r, joint[:, Kf:], NDs)
+    sf_ovf = jnp.int32(0)
     if scalar_flow:
         flow_dyn, flow_ok, wait_ms = flow_mod.flow_check_scalar(
             rules.flow_table, state.flow_dyn, rules.flow_idx, spec.second,
@@ -412,7 +423,8 @@ def decide_entries(
             now_idx_m=now_idx_m,
             has_rate_limiter=scalar_has_rl,
             rules_bk=flow_bk,
-            occupy_base=enable_occupy)
+            occupy_base=enable_occupy,
+            sortfree=sortfree)
         occupied = jnp.zeros_like(flow_ok)
         live3 = live2 & flow_ok
         breakers, deg_ok = deg_mod.degrade_entry_check_scalar(
@@ -430,22 +442,29 @@ def decide_entries(
             chain_rows=batch.chain_rows, acquire=batch.acquire, valid=live2,
             prioritized=batch.prioritized, cluster_fallback=cl_fb)
         if enable_occupy:
-            flow_dyn, flow_ok, wait_ms, occupied = \
-                flow_mod.flow_check_fast_occupy(
-                    rules.flow_table, state.flow_dyn, rules.flow_idx,
-                    spec.second, state.second, state.alt_second,
-                    state.threads, state.alt_threads, fview, now_idx_s,
-                    rel_now_ms,
-                    minute_spec=spec.minute,
-                    main_minute=state.minute if spec.minute else None,
-                    now_idx_m=now_idx_m,
-                    in_win_ms=in_win_ms,
-                    occupy_timeout_ms=spec.occupy_timeout_ms,
-                    has_rate_limiter=scalar_has_rl,
-                    has_thread_rules=not skip_threads,
-                    rules_bk=flow_bk)
+            fn_occ = (flow_mod.flow_check_fast_occupy_sortfree if sortfree
+                      else flow_mod.flow_check_fast_occupy)
+            out = fn_occ(
+                rules.flow_table, state.flow_dyn, rules.flow_idx,
+                spec.second, state.second, state.alt_second,
+                state.threads, state.alt_threads, fview, now_idx_s,
+                rel_now_ms,
+                minute_spec=spec.minute,
+                main_minute=state.minute if spec.minute else None,
+                now_idx_m=now_idx_m,
+                in_win_ms=in_win_ms,
+                occupy_timeout_ms=spec.occupy_timeout_ms,
+                has_rate_limiter=scalar_has_rl,
+                has_thread_rules=not skip_threads,
+                rules_bk=flow_bk)
+            if sortfree:
+                flow_dyn, flow_ok, wait_ms, occupied, sf_ovf = out
+            else:
+                flow_dyn, flow_ok, wait_ms, occupied = out
         else:
-            flow_dyn, flow_ok, wait_ms = flow_mod.flow_check_fast(
+            fn_plain = (flow_mod.flow_check_fast_sortfree if sortfree
+                        else flow_mod.flow_check_fast)
+            out = fn_plain(
                 rules.flow_table, state.flow_dyn, rules.flow_idx, spec.second,
                 state.second, state.alt_second, state.threads,
                 state.alt_threads, fview, now_idx_s, rel_now_ms,
@@ -455,6 +474,10 @@ def decide_entries(
                 has_rate_limiter=scalar_has_rl,
                 has_thread_rules=not skip_threads,
                 rules_bk=flow_bk)
+            if sortfree:
+                flow_dyn, flow_ok, wait_ms, sf_ovf = out
+            else:
+                flow_dyn, flow_ok, wait_ms = out
             occupied = jnp.zeros_like(flow_ok)
         live3 = live2 & flow_ok
         # occupied (PriorityWait) events bypass the degrade slot — see the
@@ -471,7 +494,9 @@ def decide_entries(
             origin_rows=batch.origin_rows, context_ids=batch.context_ids,
             chain_rows=batch.chain_rows, acquire=batch.acquire, valid=live2,
             prioritized=batch.prioritized, cluster_fallback=cl_fb)
-        flow_dyn, flow_ok, wait_ms, occupied = flow_mod.flow_check(
+        fcheck = (flow_mod.flow_check_sortfree if sortfree
+                  else flow_mod.flow_check)
+        out = fcheck(
             rules.flow_table, state.flow_dyn, rules.flow_idx, spec.second,
             state.second, state.alt_second, state.threads, state.alt_threads,
             fview, now_idx_s, rel_now_ms,
@@ -482,6 +507,10 @@ def decide_entries(
             occupy_timeout_ms=spec.occupy_timeout_ms,
             enable_occupy=enable_occupy,
             has_thread_rules=not skip_threads)
+        if sortfree:
+            flow_dyn, flow_ok, wait_ms, occupied, sf_ovf = out
+        else:
+            flow_dyn, flow_ok, wait_ms, occupied = out
         live3 = live2 & flow_ok
 
         # occupied (PriorityWait) events bypass the degrade slot entirely —
@@ -661,7 +690,8 @@ def decide_entries(
         threads=threads, alt_threads=alt_threads,
         flow_dyn=flow_dyn, breakers=breakers, param_dyn=param_dyn,
         custom=custom_states)
-    return new_state, Verdicts(allow=allow, reason=reason, wait_ms=wait_ms)
+    return new_state, Verdicts(allow=allow, reason=reason, wait_ms=wait_ms,
+                               sf_overflow=sf_ovf if sortfree else None)
 
 
 def record_exits(
@@ -807,6 +837,7 @@ def decide_and_record_exits(
     skip_sys: bool = False,      # STATIC
     scalar_has_rl: bool = True,  # STATIC
     skip_threads: bool = False,  # STATIC (see decide_entries)
+    sortfree: bool = False,      # STATIC (see decide_entries)
 ) -> Tuple[SentinelState, Verdicts]:
     """Fused entry+exit step: one dispatch where serving loops would pay two.
 
@@ -825,7 +856,8 @@ def decide_and_record_exits(
         enable_occupy=enable_occupy, custom_slots=custom_slots,
         record_alt=record_alt, scalar_flow=scalar_flow,
         fast_flow=fast_flow, skip_auth=skip_auth, skip_sys=skip_sys,
-        scalar_has_rl=scalar_has_rl, skip_threads=skip_threads)
+        scalar_has_rl=scalar_has_rl, skip_threads=skip_threads,
+        sortfree=sortfree)
     state = record_exits(spec, rules, state, exit_batch, times,
                          record_alt=record_alt, skip_threads=skip_threads)
     return state, verdicts

@@ -35,6 +35,7 @@ Self-telemetry families (from ``Sentinel.obs`` — obs/; absent while
     sentinel_span_ring_wraps_total         spans/links lost to ring wrap
     sentinel_flight_pinned_total           SLO-pinned trace chains
     sentinel_flight_trigger_total{kind=...} deadline_miss/shed/p99/block_burst
+    sentinel_sortfree_bucket_overflow_total claim-cascade sorted fallbacks
 
 Every key in the fixed counter CATALOG (obs/counters.py) has a family
 here — tests/test_obs.py walks the catalog against the rendered scrape
@@ -137,6 +138,11 @@ class SentinelCollector:
             f"{ns}_flight_trigger",
             "Flight-recorder SLO triggers fired (post rate limiting)",
             labels=["kind"])
+        sf_ovf = CounterMetricFamily(
+            f"{ns}_sortfree_bucket_overflow",
+            "Sort-free claim-cascade overflows (elements that fell back "
+            "to the sorted branch; sustained growth = bucket table "
+            "undersized for the key distribution)")
         if not describe_only and obs is not None and obs.enabled:
             from sentinel_tpu.obs import counters as ck
             counts = obs.counters.snapshot()
@@ -156,8 +162,10 @@ class SentinelCollector:
                                  (ck.ROUTE_GENERAL, "general_sorted"),
                                  (ck.ROUTE_SPLIT, "split_fired"),
                                  (ck.ROUTE_FUSED, "fused_exit"),
-                                 (ck.ROUTE_MESHED, "meshed")):
+                                 (ck.ROUTE_MESHED, "meshed"),
+                                 (ck.ROUTE_SORTFREE, "sortfree")):
                 route.add_metric([fam_key], counts.get(key, 0))
+            sf_ovf.add_metric([], counts.get(ck.SORTFREE_OVERFLOW, 0))
             hits.add_metric([], counts.get(ck.CACHE_HIT, 0))
             misses.add_metric([], counts.get(ck.CACHE_MISS, 0))
             retries.add_metric([], counts.get(ck.CACHE_RETRY, 0))
@@ -190,7 +198,7 @@ class SentinelCollector:
                         [key[len(ck.FLIGHT_TRIGGER_PREFIX):]], v)
         yield from (p99, quant, req_quant, route, hits, misses, retries,
                     blocks, occupy, pipeline, frontend, fe_flush, wraps,
-                    flight_pinned, flight_trig)
+                    flight_pinned, flight_trig, sf_ovf)
 
     def collect(self):
         ns = self.namespace

@@ -9,10 +9,14 @@ dispatch stays within the 2% observability budget (benchmarks/ci_gate.py
   (:meth:`~sentinel_tpu.runtime.Sentinel.decide_raw_nowait` path
   selection): ``scalar`` / ``fast`` / ``fast_occupy`` /
   ``general_sorted``, plus ``split_fired`` when a mixed batch was
-  per-event split (``_decide_split_nowait``) and ``meshed`` when the
+  per-event split (``_decide_split_nowait``), ``meshed`` when the
   dispatch ran on a row-sharded engine (alongside its route counter:
   meshed_total/route_total attributes how much traffic the mesh path
-  carries).
+  carries), and ``sortfree`` when the dispatch's flow programs grouped
+  segments sort-free (alongside its route counter, same pattern).
+* ``sortfree.bucket_overflow`` — claim-cascade overflow total: elements
+  whose step fell back to the sorted branch (ops/sortfree.py); sustained
+  growth means the bucket table is undersized for the key distribution.
 * ``compile_cache.*`` — first-dispatch program accounting per (variant,
   geometry, statics) combo: ``hit`` / ``miss`` /
   ``first_fetch_retry`` (the guarded-fetch stall retries).
@@ -96,6 +100,16 @@ FLIGHT_TRIGGER_PREFIX = "flight.trigger."  # per-kind trigger tallies
 ROUTE_MESHED = "split_route.meshed"
 PIPE_MESHED = "pipeline.meshed_dispatch"
 
+# PR 10 — sort-free general path: dispatches whose flow programs grouped
+# segments via the hash-bucketed claim cascade (one per decide/split/
+# fused dispatch alongside its route counter, like ROUTE_MESHED), and
+# the per-step claim-cascade overflow tally (elements that took the
+# sorted fallback branch under lax.cond — sustained growth means the
+# bucket table is undersized for the live key distribution; see
+# docs/OPERATIONS.md "Sort-free general path")
+ROUTE_SORTFREE = "split_route.sortfree"
+SORTFREE_OVERFLOW = "sortfree.bucket_overflow"
+
 #: Fixed aggregation catalog (order is the wire format of the multihost
 #: counter vector — append only, never reorder).
 CATALOG = (
@@ -117,6 +131,7 @@ CATALOG = (
     FLIGHT_TRIGGER_PREFIX + "p99",
     FLIGHT_TRIGGER_PREFIX + "block_burst",
     ROUTE_MESHED, PIPE_MESHED,
+    ROUTE_SORTFREE, SORTFREE_OVERFLOW,
 )
 
 

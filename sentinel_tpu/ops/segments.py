@@ -116,7 +116,10 @@ def ranks_by_key(key: jnp.ndarray) -> jnp.ndarray:
 
 def ranks_per_slot(key2d: jnp.ndarray) -> jnp.ndarray:
     """:func:`ranks_by_key` over each SLOT column of a [B, K] pair-key
-    table → int32[B, K].
+    table → int32[B, K], as ONE batched stable sort over [K, B] (a
+    Python loop of K separate sorts here used to pay K dispatch+sort
+    passes; ``lax.sort`` batches over leading dims natively, and the
+    scan/scatter stages batch the same way).
 
     Valid whenever slot columns carry DISJOINT key groups — true for the
     rule-gather tables: a rule lives at exactly one (row, slot), so every
@@ -126,8 +129,19 @@ def ranks_per_slot(key2d: jnp.ndarray) -> jnp.ndarray:
     a sentinel key shared ACROSS slots (the invalid/padding group) ranks
     differently per slot than globally — callers must never consume
     sentinel ranks (both flow paths mask them)."""
-    K = key2d.shape[1]
-    return jnp.stack([ranks_by_key(key2d[:, k]) for k in range(K)], axis=1)
+    B, K = key2d.shape
+    kt = key2d.T                                             # [K, B]
+    iota = jnp.arange(B, dtype=jnp.int32)
+    idx = jnp.broadcast_to(iota, (K, B))
+    ks, order = lax.sort((kt, idx), num_keys=1, is_stable=True)
+    starts = jnp.concatenate(
+        [jnp.ones((K, 1), jnp.bool_), ks[:, 1:] != ks[:, :-1]], axis=1)
+    leader = lax.associative_scan(
+        jnp.maximum, jnp.where(starts, iota[None, :], jnp.int32(0)), axis=1)
+    rank_s = iota[None, :] - leader
+    out = jnp.zeros((K, B), jnp.int32).at[
+        jnp.arange(K, dtype=jnp.int32)[:, None], order].set(rank_s)
+    return out.T
 
 
 def padded_table_gather(idx_table: jnp.ndarray, rows: jnp.ndarray,

@@ -126,7 +126,7 @@ def state_shardings(spec: EngineSpec, mesh: Mesh,
 
 def verdict_shardings(mesh: Mesh) -> Verdicts:
     rep = NamedSharding(mesh, P())
-    return Verdicts(allow=rep, reason=rep, wait_ms=rep)
+    return Verdicts(allow=rep, reason=rep, wait_ms=rep, sf_overflow=rep)
 
 
 def pin_state(state: SentinelState,

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from sentinel_tpu.ops import segments as seg
+from sentinel_tpu.ops import sortfree as sfo
 from sentinel_tpu.stats import events as ev
 from sentinel_tpu.stats.window import (
     WindowSpec, WindowState, prev_window_sum_rows, window_sum_all,
@@ -319,6 +320,13 @@ def flow_check(
     # rule reads live concurrency → the [BK] thread-gauge gathers compile
     # away (the gauges themselves may be unmaintained then; see
     # pipeline.decide_entries skip_threads)
+    sortfree: bool = False,                    # STATIC: group segments via
+    # the hash-bucketed claim cascade + counting-sort permutation
+    # (ops/sortfree.py) instead of the n·log n composite-key sort; on
+    # claim overflow a lax.cond takes the sorted reference branch, so
+    # results are bit-identical either way (the runtime's
+    # SENTINEL_SORTFREE routing flips this; flow_check_sortfree also
+    # surfaces the overflow count)
 ) -> Tuple[FlowDynState, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """→ (dyn', allow bool[B], wait_ms int32[B], occupied bool[B]).
 
@@ -329,6 +337,51 @@ def flow_check(
     sleeps ``wait_ms`` and the pass is accounted to the future window — the
     recorder must log OCCUPIED_PASS, not PASS, for these events.
     """
+    dyn, allow, wait_ms, occupied, _ = _flow_check_impl(
+        table, dyn, rule_idx, spec, main_second, alt_second, main_threads,
+        alt_threads, batch, now_idx_s, rel_now_ms, minute_spec, main_minute,
+        now_idx_m, in_win_ms, occupy_timeout_ms, enable_occupy,
+        has_thread_rules, sortfree)
+    return dyn, allow, wait_ms, occupied
+
+
+def flow_check_sortfree(
+    table: FlowRuleTable,
+    dyn: FlowDynState,
+    rule_idx: jnp.ndarray,
+    spec: WindowSpec,
+    main_second: WindowState,
+    alt_second: WindowState,
+    main_threads: jnp.ndarray,
+    alt_threads: jnp.ndarray,
+    batch: FlowBatchView,
+    now_idx_s: jnp.ndarray,
+    rel_now_ms: jnp.ndarray,
+    minute_spec: Optional[WindowSpec] = None,
+    main_minute: Optional[WindowState] = None,
+    now_idx_m: Optional[jnp.ndarray] = None,
+    in_win_ms: Optional[jnp.ndarray] = None,
+    occupy_timeout_ms: int = 500,
+    enable_occupy: bool = True,
+    has_thread_rules: bool = True,
+) -> Tuple[FlowDynState, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """:func:`flow_check` with ``sortfree=True``, additionally returning
+    the claim-cascade overflow count (int32 scalar — elements that fell
+    back to the sorted branch this step; feeds the
+    ``sortfree.bucket_overflow`` counter)."""
+    return _flow_check_impl(
+        table, dyn, rule_idx, spec, main_second, alt_second, main_threads,
+        alt_threads, batch, now_idx_s, rel_now_ms, minute_spec, main_minute,
+        now_idx_m, in_win_ms, occupy_timeout_ms, enable_occupy,
+        has_thread_rules, True)
+
+
+def _flow_check_impl(
+    table, dyn, rule_idx, spec, main_second, alt_second, main_threads,
+    alt_threads, batch, now_idx_s, rel_now_ms, minute_spec, main_minute,
+    now_idx_m, in_win_ms, occupy_timeout_ms, enable_occupy,
+    has_thread_rules, sortfree,
+) -> Tuple[FlowDynState, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     B = batch.rows.shape[0]
     K = rule_idx.shape[1]
     NF = table.active.shape[0] - 1
@@ -432,7 +485,26 @@ def flow_check(
     row_seg = jnp.where(use_alt, sel_alt_row + R, sel_main_row)  # disjoint key space
     row_seg = jnp.where(is_rl_bk, 0, row_seg)
     row_seg = jnp.where(valid_bk, row_seg, 0)
-    order = seg.sort_by_keys(rj_seg, row_seg)
+    if sortfree:
+        # Sort-free grouping: the claim cascade + counting sort yields a
+        # STABLE key-grouping permutation; everything downstream (starts,
+        # prefix sums, greedy admission, RL fixed point, occupy fold,
+        # unsorts) is permutation-invariant across segments and
+        # stability-preserving within them, so the admitted bits match
+        # the sorted branch exactly (parity argument: ops/sortfree.py).
+        # Claim overflow takes the sorted branch via lax.cond — graceful
+        # fallback, never a wrong answer.
+        plan = sfo.build_pair_plan(rj_seg, row_seg, rj_seg == NF,
+                                   sfo.table_bits(B * K))
+        order = lax.cond(
+            plan.overflow,
+            lambda _: seg.sort_by_keys(rj_seg, row_seg),
+            lambda _: sfo.counting_order(plan.bucket, plan.num_buckets),
+            None)
+        sf_overflow = plan.overflow_count
+    else:
+        order = seg.sort_by_keys(rj_seg, row_seg)
+        sf_overflow = jnp.int32(0)
     rj_s = rj_seg[order]
     row_s = row_seg[order]
     acq_s = jnp.where(valid_bk, acq_bk, 0.0)[order]
@@ -604,7 +676,7 @@ def flow_check(
     wait_ms = jnp.max(pair_wait.reshape(B, K), axis=1)
     occupied = jnp.any(pair_occ.reshape(B, K), axis=1) & allow & batch.valid
     allow = allow | ~batch.valid
-    return dyn, allow, wait_ms.astype(jnp.int32), occupied
+    return dyn, allow, wait_ms.astype(jnp.int32), occupied, sf_overflow
 
 
 def flow_check_scalar(
@@ -636,6 +708,10 @@ def flow_check_scalar(
     # carry no prioritized events (this path never books); it only has
     # to SEE bookings committed by prioritized traffic dispatched around
     # it (runtime._decide_split_nowait's scalar side).
+    sortfree: bool = False,           # STATIC: compute per-slot arrival
+    # ranks by identity-bucketed scatter (ops/sortfree.ranks2d_ident —
+    # keys are already dense rule ids, so no hashing and no overflow)
+    # instead of the batched stable sort; exact, not probabilistic
 ) -> Tuple[FlowDynState, jnp.ndarray, jnp.ndarray]:
     """Scalar-path flow check → (dyn', allow bool[B], wait_ms int32[B]).
 
@@ -726,7 +802,10 @@ def flow_check_scalar(
     # per-slot ranks: slot columns carry disjoint rule sets (see
     # seg.ranks_per_slot; the NF sentinel group's per-slot ranks only
     # feed the npairs lane of the inactive rule)
-    rank = seg.ranks_per_slot(key.reshape(B, K)).reshape(-1)  # int32[BK]
+    if sortfree:
+        rank = sfo.ranks2d_ident(key.reshape(B, K), NF + 2).reshape(-1)
+    else:
+        rank = seg.ranks_per_slot(key.reshape(B, K)).reshape(-1)  # int32[BK]
 
     a_bk = jnp.repeat(acquire, K).astype(jnp.float32)
     limit_eff = jnp.where(applies, eff_limit, jnp.float32(3e38))
@@ -822,6 +901,9 @@ def flow_check_fast(
     has_rate_limiter: bool = True,    # STATIC: ruleset has RL/WU-RL rules
     has_thread_rules: bool = True,    # STATIC: see flow_check
     rules_bk: Optional[jnp.ndarray] = None,   # [B, K] pre-gathered rule ids
+    sortfree: bool = False,           # STATIC: per-slot ranks via the
+    # hashed claim cascade (ops/sortfree.ranks2d_hashed) with a lax.cond
+    # sorted fallback on claim overflow — bit-exact either way
 ) -> Tuple[FlowDynState, jnp.ndarray, jnp.ndarray]:
     """Fast GENERAL-path flow check → (dyn', allow bool[B], wait_ms int32[B]).
 
@@ -857,12 +939,31 @@ def flow_check_fast(
     * the rate limiter collapses to the same bounded per-rule rank budget
       ``max_k`` as the scalar path (RateLimiterController.java:30-90).
     """
-    dyn, allow, wait_ms, _ = _flow_check_fast_impl(
+    dyn, allow, wait_ms, _, _ = _flow_check_fast_impl(
         table, dyn, rule_idx, spec, main_second, alt_second, main_threads,
         alt_threads, batch, now_idx_s, rel_now_ms, minute_spec, main_minute,
         now_idx_m, has_rate_limiter, has_thread_rules, rules_bk,
-        enable_occupy=False, in_win_ms=None, occupy_timeout_ms=0)
+        enable_occupy=False, in_win_ms=None, occupy_timeout_ms=0,
+        sortfree=sortfree)
     return dyn, allow, wait_ms
+
+
+def flow_check_fast_sortfree(
+    table, dyn, rule_idx, spec, main_second, alt_second, main_threads,
+    alt_threads, batch, now_idx_s, rel_now_ms, minute_spec=None,
+    main_minute=None, now_idx_m=None, has_rate_limiter=True,
+    has_thread_rules=True, rules_bk=None,
+) -> Tuple[FlowDynState, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """:func:`flow_check_fast` with ``sortfree=True``, additionally
+    returning the claim-cascade overflow count (int32 scalar) →
+    (dyn', allow, wait_ms, sf_overflow)."""
+    dyn, allow, wait_ms, _, sf_overflow = _flow_check_fast_impl(
+        table, dyn, rule_idx, spec, main_second, alt_second, main_threads,
+        alt_threads, batch, now_idx_s, rel_now_ms, minute_spec, main_minute,
+        now_idx_m, has_rate_limiter, has_thread_rules, rules_bk,
+        enable_occupy=False, in_win_ms=None, occupy_timeout_ms=0,
+        sortfree=True)
+    return dyn, allow, wait_ms, sf_overflow
 
 
 def flow_check_fast_occupy(
@@ -885,6 +986,7 @@ def flow_check_fast_occupy(
     has_rate_limiter: bool = True,    # STATIC: see flow_check_fast
     has_thread_rules: bool = True,    # STATIC: see flow_check
     rules_bk: Optional[jnp.ndarray] = None,   # [B, K] pre-gathered rule ids
+    sortfree: bool = False,           # STATIC: see flow_check_fast
 ) -> Tuple[FlowDynState, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Occupy-capable fast general path → (dyn', allow, wait_ms, occupied).
 
@@ -919,20 +1021,40 @@ def flow_check_fast_occupy(
     """
     assert in_win_ms is not None, \
         "flow_check_fast_occupy needs in_win_ms (occupy wait math)"
+    dyn, allow, wait_ms, occupied, _ = _flow_check_fast_impl(
+        table, dyn, rule_idx, spec, main_second, alt_second, main_threads,
+        alt_threads, batch, now_idx_s, rel_now_ms, minute_spec, main_minute,
+        now_idx_m, has_rate_limiter, has_thread_rules, rules_bk,
+        enable_occupy=True, in_win_ms=in_win_ms,
+        occupy_timeout_ms=occupy_timeout_ms, sortfree=sortfree)
+    return dyn, allow, wait_ms, occupied
+
+
+def flow_check_fast_occupy_sortfree(
+    table, dyn, rule_idx, spec, main_second, alt_second, main_threads,
+    alt_threads, batch, now_idx_s, rel_now_ms, minute_spec=None,
+    main_minute=None, now_idx_m=None, in_win_ms=None, occupy_timeout_ms=500,
+    has_rate_limiter=True, has_thread_rules=True, rules_bk=None,
+) -> Tuple[FlowDynState, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """:func:`flow_check_fast_occupy` with ``sortfree=True``, additionally
+    returning the claim-cascade overflow count (int32 scalar) →
+    (dyn', allow, wait_ms, occupied, sf_overflow)."""
+    assert in_win_ms is not None, \
+        "flow_check_fast_occupy_sortfree needs in_win_ms (occupy wait math)"
     return _flow_check_fast_impl(
         table, dyn, rule_idx, spec, main_second, alt_second, main_threads,
         alt_threads, batch, now_idx_s, rel_now_ms, minute_spec, main_minute,
         now_idx_m, has_rate_limiter, has_thread_rules, rules_bk,
         enable_occupy=True, in_win_ms=in_win_ms,
-        occupy_timeout_ms=occupy_timeout_ms)
+        occupy_timeout_ms=occupy_timeout_ms, sortfree=True)
 
 
 def _flow_check_fast_impl(
     table, dyn, rule_idx, spec, main_second, alt_second, main_threads,
     alt_threads, batch, now_idx_s, rel_now_ms, minute_spec, main_minute,
     now_idx_m, has_rate_limiter, has_thread_rules, rules_bk,
-    enable_occupy, in_win_ms, occupy_timeout_ms,
-) -> Tuple[FlowDynState, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    enable_occupy, in_win_ms, occupy_timeout_ms, sortfree=False,
+) -> Tuple[FlowDynState, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     B = batch.rows.shape[0]
     K = rule_idx.shape[1]
     NF = table.active.shape[0] - 1
@@ -1075,7 +1197,18 @@ def _flow_check_fast_impl(
     key = jnp.where(valid_pair, key, NF * (RA + 1))
     # per-slot ranks: slot columns carry disjoint rule sets (see
     # seg.ranks_per_slot; sentinel ranks are never consumed)
-    rank = seg.ranks_per_slot(key)
+    if sortfree:
+        # hashed claim cascade per slot column; any column's claim
+        # overflow flips the whole rank table to the sorted reference
+        # via lax.cond — graceful fallback, never a wrong answer
+        rank_h, sf_ovf = sfo.ranks2d_hashed(key, NF * (RA + 1),
+                                            sfo.table_bits(B))
+        rank = lax.cond(sf_ovf > 0,
+                        lambda _: seg.ranks_per_slot(key),
+                        lambda _: rank_h, None)
+    else:
+        rank = seg.ranks_per_slot(key)
+        sf_ovf = jnp.int32(0)
 
     # ---- admission (closed forms) ----
     a_f = acq_of_rule                       # the uniform acquire, float32
@@ -1120,7 +1253,16 @@ def _flow_check_fast_impl(
             # admitted set is exactly the eligible-rank prefix under the
             # uniform acquire — one extra per-slot rank pass, no sort
             key_occ = jnp.where(eligible, key, NF * (RA + 1))
-            rank_occ = seg.ranks_per_slot(key_occ).astype(jnp.float32)
+            if sortfree:
+                r_occ_h, ovf_occ = sfo.ranks2d_hashed(
+                    key_occ, NF * (RA + 1), sfo.table_bits(B))
+                rank_occ = lax.cond(
+                    ovf_occ > 0,
+                    lambda _: seg.ranks_per_slot(key_occ),
+                    lambda _: r_occ_h, None).astype(jnp.float32)
+            else:
+                rank_occ = seg.ranks_per_slot(key_occ).astype(jnp.float32)
+                ovf_occ = jnp.int32(0)
             occ_adm = (((occ_base_p + rank_occ * a_f) + a_f <= limit_pair)
                        & eligible)
 
@@ -1153,18 +1295,21 @@ def _flow_check_fast_impl(
                                 occ_win[:, slot])
             return (occ_cnt.at[:, slot].set(new_cnt),
                     occ_win.at[:, slot].set(new_win),
-                    occ_adm & event_occ[:, None])
+                    occ_adm & event_occ[:, None],
+                    ovf_occ)
 
         def _no_occupy(_):
-            return occ_cnt, occ_win, jnp.zeros_like(pass_default)
+            return (occ_cnt, occ_win, jnp.zeros_like(pass_default),
+                    jnp.int32(0))
 
         # real control flow, like flow_check: a batch routed here only
         # because bookings were live (no prioritized events) skips the
         # whole attempt — it pays the landed fold and nothing else
-        new_occ_cnt, new_occ_win, occ_adm_p = jax.lax.cond(
+        new_occ_cnt, new_occ_win, occ_adm_p, sf_ovf_occ = jax.lax.cond(
             jnp.any(batch.prioritized), _occupy_attempt, _no_occupy, None)
         dyn = dyn._replace(occupied_count=new_occ_cnt,
                            occupied_window=new_occ_win)
+        sf_ovf = sf_ovf + sf_ovf_occ
     else:
         occ_adm_p = jnp.zeros_like(pass_default)
         wait_next = jnp.int32(0)
@@ -1203,7 +1348,7 @@ def _flow_check_fast_impl(
             latest_passed_ms=jnp.maximum(dyn.latest_passed_ms, new_latest))
 
     allow = allow | ~batch.valid
-    return dyn, allow, wait_ms.astype(jnp.int32), occupied
+    return dyn, allow, wait_ms.astype(jnp.int32), occupied, sf_ovf
 
 
 def _rl_closed_form(table: FlowRuleTable, dyn: FlowDynState,

@@ -108,6 +108,19 @@ def host_staging_enabled() -> bool:
     return _env_on("SENTINEL_HOST_STAGING")
 
 
+def sortfree_enabled() -> bool:
+    """Sort-free general path: the flow slots group admission segments
+    via the hash-bucketed claim cascade + scatter ranks (ops/sortfree.py)
+    instead of n·log n stable sorts — the default. Bit-exact with the
+    sorted reference by construction (claim overflow falls back to the
+    sorted branch under ``lax.cond``; the ``sortfree.bucket_overflow``
+    counter tracks how often). ``SENTINEL_SORTFREE=0`` is the escape
+    hatch — it reverts every path to the sorted reference machinery and
+    restores the pre-round-10 program cache keys (see
+    docs/OPERATIONS.md "Sort-free general path")."""
+    return _env_on("SENTINEL_SORTFREE")
+
+
 def pipeline_depth(default: int = 2) -> int:
     """The ``SENTINEL_PIPELINE_DEPTH`` knob, clamped to [1, 64]."""
     raw = os.environ.get(PIPELINE_DEPTH_ENV, "")
@@ -143,7 +156,7 @@ def _build_steps(spec: EngineSpec, custom_slots: tuple, shardings=None,
             custom_slots=custom_slots, record_alt=alt),
             static_argnames=("scalar_flow", "fast_flow", "skip_auth",
                              "skip_sys", "scalar_has_rl",
-                             "skip_threads"), **kw_sv, **kw_d1)
+                             "skip_threads", "sortfree"), **kw_sv, **kw_d1)
 
     def fused(occ, alt):
         # decide+exit in ONE program (engine/pipeline.py
@@ -154,7 +167,7 @@ def _build_steps(spec: EngineSpec, custom_slots: tuple, shardings=None,
             custom_slots=custom_slots, record_alt=alt),
             static_argnames=("scalar_flow", "fast_flow", "skip_auth",
                              "skip_sys", "scalar_has_rl",
-                             "skip_threads"), **kw_sv, **kw_d1)
+                             "skip_threads", "sortfree"), **kw_sv, **kw_d1)
 
     # jit objects are lazy (tracing happens on first call), so building all
     # variants is free; the *_noalt ones compile away the origin/chain
@@ -655,6 +668,10 @@ class Sentinel:
             and r.grade == flow_mod.GRADE_QPS for r in self._flow.rules)
         self._skip_auth = self._auth.num_active == 0
         self._skip_sys = not getattr(self, "_sys_rules", [])
+        # sort-free segment grouping (env-pinned per process, read at
+        # every reload so a test flipping the env var between Sentinels
+        # gets the expected variant)
+        self._sortfree = sortfree_enabled()
         # Thread-gauge elision: nothing loaded READS live concurrency →
         # the gauge-maintenance scatters compile away (the only readers:
         # THREAD-grade flow rules — DefaultController.java:50-76, system
@@ -2330,6 +2347,11 @@ class Sentinel:
             flags = {"skip_auth": self._skip_auth,
                      "skip_sys": self._skip_sys,
                      "skip_threads": self._skip_threads}
+            if self._sortfree:
+                # conditional key presence: with sortfree disabled the
+                # flags dict — hence every cached program key — is
+                # byte-identical to pre-round-10 builds
+                flags["sortfree"] = True
             if (no_alt_rows and no_origin_ids and not any_prio
                     and cluster_fallback is None and acq_uniform):
                 # scalar admission path (rules/flow.flow_check_scalar);
@@ -2375,6 +2397,8 @@ class Sentinel:
             else:
                 route = obs_keys.ROUTE_GENERAL
             obs.counters.add(route)
+            if "sortfree" in flags:
+                obs.counters.add(obs_keys.ROUTE_SORTFREE)
             if self.mesh is not None:
                 obs.counters.add(obs_keys.ROUTE_MESHED)
             t_disp = obs.spans.now_ns()
@@ -2393,6 +2417,10 @@ class Sentinel:
                 if tr:
                     obs.spans.record(tr, "decide.device", t_disp, t_end,
                                      n=n)
+                if verdicts.sf_overflow is not None:
+                    ovf = int(np.asarray(verdicts.sf_overflow))
+                    if ovf:
+                        obs.counters.add(obs_keys.SORTFREE_OVERFLOW, ovf)
                 if prio_np_full is not None:
                     granted = int(np.count_nonzero(
                         out.allow & (out.wait_ms > 0)
@@ -2631,6 +2659,8 @@ class Sentinel:
             flags = {"skip_auth": self._skip_auth,
                      "skip_sys": self._skip_sys,
                      "skip_threads": self._skip_threads}
+            if self._sortfree:
+                flags["sortfree"] = True   # see decide_raw_nowait
             # occupy re-verify under the lock: this batch's prioritized
             # events, or a concurrent prioritized batch since the
             # optimistic host check, keep occupy live — both sides then
@@ -2676,6 +2706,8 @@ class Sentinel:
         n_g = idx_g.shape[0]
         t_disp = 0
         if obs_on:
+            if "sortfree" in flags:
+                obs.counters.add(obs_keys.ROUTE_SORTFREE)
             t_disp = obs.spans.now_ns()
             if tr:
                 obs.spans.record(tr, "split.dispatch", t_d0, t_disp, n=n,
@@ -2703,6 +2735,13 @@ class Sentinel:
                         allow[idx_g] & (wait[idx_g] > 0) & prio_g))
                     if granted:
                         obs.counters.add(obs_keys.OCCUPY_GRANTED, granted)
+                ovf = 0
+                if v1.sf_overflow is not None:
+                    ovf += int(np.asarray(v1.sf_overflow))
+                if v2.sf_overflow is not None:
+                    ovf += int(np.asarray(v2.sf_overflow))
+                if ovf:
+                    obs.counters.add(obs_keys.SORTFREE_OVERFLOW, ovf)
             if brk is not None:
                 self._diff_and_fire_breakers(
                     brk[0], brk[1], np.asarray(brk[2][:-1]).tolist())
@@ -2820,6 +2859,8 @@ class Sentinel:
             flags = {"skip_auth": self._skip_auth,
                      "skip_sys": self._skip_sys,
                      "skip_threads": self._skip_threads}
+            if self._sortfree:
+                flags["sortfree"] = True   # see decide_raw_nowait
             if no_alt and no_origin_ids and not any_prio and acq_uniform:
                 flags["scalar_flow"] = True
                 flags["scalar_has_rl"] = self._scalar_has_rl
@@ -2851,6 +2892,8 @@ class Sentinel:
             else:
                 route = obs_keys.ROUTE_GENERAL
             obs.counters.add(obs_keys.ROUTE_FUSED)
+            if "sortfree" in flags:
+                obs.counters.add(obs_keys.ROUTE_SORTFREE)
             if self.mesh is not None:
                 obs.counters.add(obs_keys.ROUTE_MESHED)
             t_disp = obs.spans.now_ns()
@@ -2870,6 +2913,10 @@ class Sentinel:
                 if tr:
                     obs.spans.record(tr, "fused.device", t_disp, t_end,
                                      n=n)
+                if verdicts.sf_overflow is not None:
+                    ovf = int(np.asarray(verdicts.sf_overflow))
+                    if ovf:
+                        obs.counters.add(obs_keys.SORTFREE_OVERFLOW, ovf)
                 if prio_np_full is not None:
                     granted = int(np.count_nonzero(
                         out.allow & (out.wait_ms > 0)

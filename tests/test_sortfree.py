@@ -1,0 +1,472 @@
+"""Sort-free general path (round 10) — adversarial parity vs the sorted
+reference (docs/OPERATIONS.md "Sort-free general path").
+
+Three tiers, all seeded:
+
+* primitive parity — ``ops/sortfree.py`` claim cascade / scatter ranks /
+  counting order against numpy references and ``ops/segments.py``, over
+  the adversarial key shapes (all-duplicate, all-unique, Zipf-skewed),
+  plus a tiny-table collision-forcing case proving the overflow flag
+  fires instead of producing a wrong plan;
+* engine parity — ``decide_entries(..., sortfree=True)`` vs the sorted
+  path, bit-exact on verdicts AND every state leaf across randomized
+  origin-bearing traffic: rate-limiter segment collapse (paced rules),
+  live occupy bookings rolling through window rotations (the
+  test_fast_flow parity-pin pattern), and a SENTINEL_SORTFREE_BITS=2
+  run where the claim table overflows every step yet the lax.cond
+  sorted fallback keeps results bit-equal;
+* runtime parity — two Sentinels under SENTINEL_SORTFREE=1 vs =0 agree
+  verdict-for-verdict through the real dispatch (split routing, rule
+  reload carry), with the ``split_route.sortfree`` /
+  ``sortfree.bucket_overflow`` counters ticking only on the sort-free
+  engine.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.engine.pipeline import EntryBatch, decide_entries
+from sentinel_tpu.obs import counters as ck
+from sentinel_tpu.ops import segments as seg
+from sentinel_tpu.ops import sortfree as sfo
+
+# core-path subset: the CI quick tier (PRs) runs only these files
+pytestmark = pytest.mark.quick
+
+
+# ---------------------------------------------------------- primitives
+
+def _key_cases(rng, n=500):
+    """The adversarial shapes: one segment, n segments, heavy skew."""
+    return {
+        "all_dup": np.full(n, 7, np.int32),
+        "all_unique": rng.permutation(n).astype(np.int32) * 3 + 1,
+        "zipf": np.minimum(rng.zipf(1.3, n), 1 << 20).astype(np.int32),
+    }
+
+
+def _np_ranks(bucket):
+    counts, out = {}, np.empty(len(bucket), np.int32)
+    for i, b in enumerate(bucket):
+        out[i] = counts.get(b, 0)
+        counts[b] = out[i] + 1
+    return out
+
+
+@pytest.mark.parametrize("case", ["all_dup", "all_unique", "zipf"])
+def test_counting_order_groups_contiguously_and_stably(case):
+    """The counting permutation is exactly what the downstream segment
+    machinery assumes: a permutation, distinct keys contiguous, batch
+    arrival order inside each group — i.e. per-key subsequences identical
+    to the stable sorted reference's."""
+    rng = np.random.default_rng(42)
+    k1 = _key_cases(rng)[case]
+    k2 = ((k1.astype(np.int64) * 5 + rng.integers(0, 3, len(k1))) %
+          100_003).astype(np.int32)
+    sentinel = rng.random(len(k1)) < 0.1
+    plan = sfo.build_pair_plan(jnp.asarray(k1), jnp.asarray(k2),
+                               jnp.asarray(sentinel),
+                               sfo.table_bits(len(k1)))
+    assert not bool(plan.overflow), "default table overflowed — undersized"
+    order = np.asarray(sfo.counting_order(plan.bucket, plan.num_buckets))
+    n = len(k1)
+    assert sorted(order.tolist()) == list(range(n))     # permutation
+    keys = [("S",) if sentinel[i] else (int(k1[i]), int(k2[i]))
+            for i in order]
+    seen, prev = set(), None
+    for kk in keys:
+        if kk != prev:
+            assert kk not in seen, f"key {kk} split into two groups"
+            seen.add(kk)
+            prev = kk
+    per_key = {}
+    for idx in order:
+        per_key.setdefault(
+            ("S",) if sentinel[idx] else (int(k1[idx]), int(k2[idx])),
+            []).append(int(idx))
+    for kk, idxs in per_key.items():
+        assert idxs == sorted(idxs), f"group {kk} not arrival-stable"
+
+
+@pytest.mark.parametrize("chunk", [32, 256])
+def test_scatter_ranks_matches_numpy_reference(chunk):
+    """Chunked-scan arrival ranks == earlier-equal counts, including the
+    padded final chunk (n not a multiple of the chunk)."""
+    rng = np.random.default_rng(5)
+    for case, keys in _key_cases(rng, n=500).items():
+        bucket = (keys % 61).astype(np.int32)
+        got = np.asarray(sfo.scatter_ranks(jnp.asarray(bucket), 62,
+                                           chunk=chunk))
+        assert np.array_equal(got, _np_ranks(bucket)), case
+
+
+def test_ranks2d_matches_ranks_per_slot():
+    """Both sort-free ranks_per_slot forms — identity buckets (scalar
+    path) and per-column claim cascade (fast path) — equal the batched
+    sorted reference, sentinel column values included."""
+    rng = np.random.default_rng(6)
+    B, K = 96, 4
+    small = rng.integers(0, 9, (B, K)).astype(np.int32)    # keys < NF+2
+    ref = np.asarray(seg.ranks_per_slot(jnp.asarray(small)))
+    got = np.asarray(sfo.ranks2d_ident(jnp.asarray(small), 9))
+    assert np.array_equal(got, ref)
+
+    big = rng.integers(0, 50_000, (B, K)).astype(np.int32)
+    big[rng.random((B, K)) < 0.2] = 777_777                # sentinel key
+    ref = np.asarray(seg.ranks_per_slot(jnp.asarray(big)))
+    got, ovf = sfo.ranks2d_hashed(jnp.asarray(big), 777_777,
+                                  sfo.table_bits(B))
+    assert int(ovf) == 0
+    assert np.array_equal(np.asarray(got), ref)
+
+
+def test_tiny_table_overflows_instead_of_lying():
+    """More distinct keys than a bits=2 cascade can settle (3 rounds x 4
+    buckets): the plan must raise ``overflow`` — the caller's lax.cond
+    takes the sorted branch — never hand back a non-injective plan."""
+    k = np.arange(200, dtype=np.int32)
+    plan = sfo.build_pair_plan(jnp.asarray(k), jnp.asarray(k * 7 + 1),
+                               jnp.zeros(200, bool), bits=2)
+    assert bool(plan.overflow)
+    assert int(plan.overflow_count) > 0
+    # settled elements still got injective buckets: at most one distinct
+    # key per effective bucket among the settled (non-zero-defaulted) ids
+    bucket = np.asarray(plan.bucket)
+    ranks = np.asarray(sfo.scatter_ranks(plan.bucket, plan.num_buckets))
+    assert np.array_equal(ranks, _np_ranks(bucket))
+
+
+# ------------------------------------------------------- engine parity
+
+def make_sentinel(clock, **cfg_over):
+    cfg = stpu.load_config(max_resources=64, max_origins=32,
+                           max_flow_rules=32, max_degrade_rules=16,
+                           max_authority_rules=16, minute_enabled=True,
+                           **cfg_over)
+    return stpu.Sentinel(config=cfg, clock=clock)
+
+
+@pytest.fixture
+def clk():
+    return ManualClock(start_ms=1_785_000_000_000)
+
+
+def _rules():
+    """Every family the aggregation touches: default/origin-scoped QPS,
+    THREAD grade, warm-up, RATE LIMITER (the per-rule segment collapse
+    the issue pins), RELATE/CHAIN strategies, cluster fallback."""
+    return [
+        stpu.FlowRule(resource="qps", count=5.0),
+        stpu.FlowRule(resource="qps", count=3.0, limit_app="app-a"),
+        stpu.FlowRule(resource="thread", count=4.0,
+                      grade=stpu.GRADE_THREAD),
+        stpu.FlowRule(resource="warm", count=50.0,
+                      control_behavior=stpu.BEHAVIOR_WARM_UP,
+                      warm_up_period_sec=10),
+        stpu.FlowRule(resource="paced", count=10.0,
+                      control_behavior=stpu.BEHAVIOR_RATE_LIMITER,
+                      max_queueing_time_ms=400),
+        stpu.FlowRule(resource="paced", count=6.0, limit_app="app-a",
+                      control_behavior=stpu.BEHAVIOR_RATE_LIMITER,
+                      max_queueing_time_ms=300),
+        stpu.FlowRule(resource="rel", count=4.0,
+                      strategy=stpu.STRATEGY_RELATE, ref_resource="qps"),
+        stpu.FlowRule(resource="chain", count=1.0,
+                      strategy=stpu.STRATEGY_CHAIN,
+                      ref_resource="some_ctx"),
+        stpu.FlowRule(resource="clus", count=1.0, cluster_mode=True,
+                      cluster_flow_id=77),
+        stpu.FlowRule(resource="zero_rl", count=0.0,
+                      control_behavior=stpu.BEHAVIOR_RATE_LIMITER),
+    ]
+
+
+RESOURCES = ["qps", "thread", "warm", "paced", "rel", "chain", "clus",
+             "zero_rl", "free1"]
+
+
+def _origin_batch(sph, rng, n, origin_ids, ctx_ids, prio_frac=0.0):
+    spec = sph.spec
+    names = [RESOURCES[i] for i in rng.integers(0, len(RESOURCES), n)]
+    rows = np.array([sph.resources.get_or_create(r) for r in names],
+                    np.int32)
+    has_o = rng.random(n) > 0.33
+    oid = np.where(has_o, origin_ids[rng.integers(0, len(origin_ids), n)],
+                   0).astype(np.int32)
+    orow = np.full(n, spec.alt_rows, np.int32)
+    for i in np.nonzero(has_o)[0]:
+        orow[i] = sph._alt_row(int(rows[i]), 0, int(oid[i]))
+    has_c = rng.random(n) > 0.5
+    cid = np.where(has_c, ctx_ids[rng.integers(0, len(ctx_ids), n)],
+                   0).astype(np.int32)
+    crow = np.full(n, spec.alt_rows, np.int32)
+    for i in np.nonzero(has_c)[0]:
+        crow[i] = sph._alt_row(int(rows[i]), 1, int(cid[i]))
+    return EntryBatch(
+        rows=jnp.asarray(rows),
+        origin_ids=jnp.asarray(oid),
+        origin_rows=jnp.asarray(orow),
+        context_ids=jnp.asarray(cid),
+        chain_rows=jnp.asarray(crow),
+        acquire=jnp.ones(n, jnp.int32),
+        is_in=jnp.asarray(rng.random(n) > 0.3),
+        prioritized=jnp.asarray(rng.random(n) < prio_frac),
+        valid=jnp.asarray(rng.random(n) > 0.15))
+
+
+def _assert_state_equal(s1, s2, tag=""):
+    for a, b in zip(jax.tree.leaves(s1), jax.tree.leaves(s2)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            f"state leaf diverged {tag}"
+
+
+def _parity_run(sph, clk, steps, seed, fast_flow=False, n=64):
+    """Sorted vs sort-free decide_entries, same traffic on both states:
+    verdicts AND every state leaf bit-equal each step; returns the total
+    claim-cascade overflow so callers can assert it stayed 0 (default
+    table) or fired (collision-forcing table)."""
+    spec = sph.spec
+    sorted_step = jax.jit(functools.partial(
+        decide_entries, spec, enable_occupy=True, record_alt=True,
+        fast_flow=fast_flow))
+    sf_step = jax.jit(functools.partial(
+        decide_entries, spec, enable_occupy=True, record_alt=True,
+        fast_flow=fast_flow, sortfree=True))
+    origin_ids = np.array([sph.origins.pin("app-a"),
+                           sph.origins.pin("app-b")], np.int32)
+    ctx_ids = np.array([sph.contexts.pin("some_ctx")], np.int32)
+    rng = np.random.default_rng(seed)
+    s1 = s2 = sph._state
+    sysv = jnp.asarray(np.array([0.1, 0.1], np.float32))
+    saw_booking, total_ovf = False, 0
+    for step in range(steps):
+        b = _origin_batch(sph, rng, n, origin_ids, ctx_ids, prio_frac=0.3)
+        times = sph._time_scalars(clk.now_ms())
+        s1, v1 = sorted_step(sph._ruleset, s1, b, times, sysv)
+        s2, v2 = sf_step(sph._ruleset, s2, b, times, sysv)
+        assert v1.sf_overflow is None          # old pytree when off
+        assert v2.sf_overflow is not None
+        total_ovf += int(np.asarray(v2.sf_overflow))
+        for f in ("allow", "wait_ms", "reason"):
+            assert np.array_equal(np.asarray(getattr(v1, f)),
+                                  np.asarray(getattr(v2, f))), \
+                f"{f} diverged at step {step}"
+        _assert_state_equal(s1, s2, f"at step {step}")
+        saw_booking = saw_booking or bool(
+            (np.asarray(s1.flow_dyn.occupied_count) > 0).any())
+        clk.advance_ms(int(rng.integers(20, 400)))
+    assert saw_booking, "no occupy booking exercised — weak test"
+    return total_ovf
+
+
+def test_sortfree_general_parity_prio_occupy(clk):
+    """Sorted vs sort-free GENERAL path: origin/chain rows, rate-limiter
+    segment collapse, live occupy bookings across window rotations —
+    bit-equal, zero overflow at the default table size."""
+    sph = make_sentinel(clk)
+    sph.load_flow_rules(_rules())
+    assert _parity_run(sph, clk, steps=16, seed=101) == 0
+
+
+def test_sortfree_fast_parity_prio_occupy(clk):
+    """Same parity pin for the FAST path (per-slot hashed ranks + the
+    second hashed pass inside the occupy attempt)."""
+    sph = make_sentinel(clk)
+    sph.load_flow_rules(_rules())
+    assert _parity_run(sph, clk, steps=16, seed=102, fast_flow=True) == 0
+
+
+def test_sortfree_scalar_parity(clk):
+    """Scalar path (identity buckets — exact by construction, no
+    overflow possible): origin-free batches, verdicts and state
+    bit-equal."""
+    sph = make_sentinel(clk)
+    sph.load_flow_rules(_rules())
+    spec = sph.spec
+    sorted_step = jax.jit(functools.partial(
+        decide_entries, spec, enable_occupy=False, record_alt=False,
+        scalar_flow=True))
+    sf_step = jax.jit(functools.partial(
+        decide_entries, spec, enable_occupy=False, record_alt=False,
+        scalar_flow=True, sortfree=True))
+    rng = np.random.default_rng(103)
+    s1 = s2 = sph._state
+    sysv = jnp.asarray(np.array([0.1, 0.1], np.float32))
+    for step in range(10):
+        n = 64
+        names = [RESOURCES[i] for i in rng.integers(0, len(RESOURCES), n)]
+        rows = np.array([sph.resources.get_or_create(r) for r in names],
+                        np.int32)
+        b = EntryBatch(
+            rows=jnp.asarray(rows),
+            origin_ids=jnp.zeros(n, jnp.int32),
+            origin_rows=jnp.full(n, spec.alt_rows, jnp.int32),
+            context_ids=jnp.zeros(n, jnp.int32),
+            chain_rows=jnp.full(n, spec.alt_rows, jnp.int32),
+            acquire=jnp.ones(n, jnp.int32),
+            is_in=jnp.ones(n, jnp.bool_),
+            prioritized=jnp.zeros(n, jnp.bool_),
+            valid=jnp.asarray(rng.random(n) > 0.1))
+        times = sph._time_scalars(clk.now_ms())
+        s1, v1 = sorted_step(sph._ruleset, s1, b, times, sysv)
+        s2, v2 = sf_step(sph._ruleset, s2, b, times, sysv)
+        assert np.array_equal(np.asarray(v1.allow), np.asarray(v2.allow))
+        assert np.array_equal(np.asarray(v1.wait_ms),
+                              np.asarray(v2.wait_ms))
+        _assert_state_equal(s1, s2, f"at step {step}")
+        clk.advance_ms(int(rng.integers(20, 400)))
+
+
+def test_sortfree_collision_forcing_falls_back_bit_equal(clk, monkeypatch):
+    """SENTINEL_SORTFREE_BITS=1: 3 rounds x 2 buckets settle at most 6
+    distinct keys, fewer than a 64-event mixed batch carries (in the
+    general pair plan AND per fast-path slot column), so the cascade
+    overflows — the lax.cond sorted fallback must keep verdicts and
+    state bit-equal while the overflow count (the
+    ``sortfree.bucket_overflow`` feed) actually fires. The env knob is
+    read at trace time; the jitted partials here are fresh, so the tiny
+    table really is compiled in."""
+    monkeypatch.setenv("SENTINEL_SORTFREE_BITS", "1")
+    sph = make_sentinel(clk)
+    sph.load_flow_rules(_rules())
+    ovf = _parity_run(sph, clk, steps=8, seed=104)
+    assert ovf > 0, "tiny table never overflowed — fallback not exercised"
+    ovf_fast = _parity_run(sph, clk, steps=8, seed=105, fast_flow=True)
+    assert ovf_fast > 0
+
+
+# ------------------------------------------------------ runtime parity
+
+RT_RULES = [
+    stpu.FlowRule(resource="api", count=100.0),
+    stpu.FlowRule(resource="api", count=3.0, limit_app="app-a"),
+    stpu.FlowRule(resource="paced", count=10.0,
+                  control_behavior=stpu.BEHAVIOR_RATE_LIMITER,
+                  max_queueing_time_ms=400),
+]
+
+
+def _rt_sentinel(clock, env, monkeypatch, **cfg_over):
+    """A Sentinel built under SENTINEL_SORTFREE=env (the flag is read at
+    ruleset build, so it must be set before construction/reload)."""
+    monkeypatch.setenv("SENTINEL_SORTFREE", env)
+    kw = dict(max_resources=64, max_origins=32, max_flow_rules=32,
+              max_degrade_rules=16, max_authority_rules=16,
+              host_fast_path=False)
+    kw.update(cfg_over)
+    cfg = stpu.load_config(**kw)
+    sph = stpu.Sentinel(config=cfg, clock=clock)
+    sph.load_flow_rules(RT_RULES)
+    return sph
+
+
+def test_runtime_env_toggle_parity_and_counters(monkeypatch):
+    """Two live engines, SENTINEL_SORTFREE=0 vs =1, identical traffic
+    through the REAL dispatch: uniform batches (fast/scalar route), a
+    mixed origin batch (split route), and a mid-run rule reload (carry
+    path) — verdict-for-verdict equal. The sortfree engine ticks
+    ``split_route.sortfree`` once per dispatch alongside its route
+    counter; the sorted engine never does."""
+    clk0 = ManualClock(start_ms=1_785_000_000_000)
+    clk1 = ManualClock(start_ms=1_785_000_000_000)
+    sph0 = _rt_sentinel(clk0, "0", monkeypatch)
+    sph1 = _rt_sentinel(clk1, "1", monkeypatch)
+    assert not sph0._sortfree and sph1._sortfree
+    rng = np.random.default_rng(7)
+    n = 8192
+    origins = ["app-a" if x else "" for x in (rng.random(n) < 0.1)]
+    dispatches = 0
+    try:
+        for round_ in range(3):
+            for _ in range(2):                       # uniform → fast/scalar
+                v0 = sph0.entry_batch(["api"] * 64)
+                v1 = sph1.entry_batch(["api"] * 64)
+                assert np.array_equal(np.asarray(v0.allow),
+                                      np.asarray(v1.allow))
+                assert np.array_equal(np.asarray(v0.wait_ms),
+                                      np.asarray(v1.wait_ms))
+                dispatches += 1
+                clk0.advance_ms(35)
+                clk1.advance_ms(35)
+            v0 = sph0.entry_batch(["api"] * n, origins=origins)  # split
+            v1 = sph1.entry_batch(["api"] * n, origins=origins)
+            assert np.array_equal(np.asarray(v0.allow),
+                                  np.asarray(v1.allow))
+            assert np.array_equal(np.asarray(v0.wait_ms),
+                                  np.asarray(v1.wait_ms))
+            dispatches += 1
+            clk0.advance_ms(120)
+            clk1.advance_ms(120)
+            if round_ == 1:                          # reload carry
+                # the flag is re-read at every reload: restore each
+                # engine's env before its reload or both would flip to
+                # whatever was set last
+                monkeypatch.setenv("SENTINEL_SORTFREE", "0")
+                sph0.load_flow_rules(RT_RULES)
+                monkeypatch.setenv("SENTINEL_SORTFREE", "1")
+                sph1.load_flow_rules(RT_RULES)
+                assert not sph0._sortfree and sph1._sortfree
+        c0 = sph0.obs.counters.snapshot()
+        c1 = sph1.obs.counters.snapshot()
+        assert c0.get(ck.ROUTE_SORTFREE, 0) == 0
+        assert c1.get(ck.ROUTE_SORTFREE, 0) == dispatches
+        assert c1.get(ck.SORTFREE_OVERFLOW, 0) == 0  # default table
+    finally:
+        sph0.close()
+        sph1.close()
+
+
+def test_runtime_overflow_counter_via_tiny_table(monkeypatch):
+    """Through-the-runtime overflow: non-uniform ``acquire`` defeats the
+    fast-path precondition, so the dispatch takes the GENERAL route and
+    runs the pair-key claim cascade — traced under
+    SENTINEL_SORTFREE_BITS=1 (max 6 settled keys) against more distinct
+    (rule, row) pairs than that, on a distinct geometry whose jitted
+    steps aren't in the process-wide spec cache yet. Verdicts must stay
+    equal to the sorted engine while ``sortfree.bucket_overflow``
+    accumulates."""
+    monkeypatch.setenv("SENTINEL_SORTFREE_BITS", "1")
+    clk0 = ManualClock(start_ms=1_785_000_000_000)
+    clk1 = ManualClock(start_ms=1_785_000_000_000)
+    over = dict(max_resources=56, max_origins=28)
+    sph0 = _rt_sentinel(clk0, "0", monkeypatch, **over)
+    sph1 = _rt_sentinel(clk1, "1", monkeypatch, **over)
+    names = [f"svc{i}" for i in range(8)]
+    # reload re-reads the env flag: restore each engine's setting first
+    for sph, env in ((sph0, "0"), (sph1, "1")):
+        monkeypatch.setenv("SENTINEL_SORTFREE", env)
+        sph.load_flow_rules(
+            [stpu.FlowRule(resource=nm, count=4.0) for nm in names]
+            + [stpu.FlowRule(resource=nm, count=2.0, limit_app="app-a")
+               for nm in names[:4]])
+    assert not sph0._sortfree and sph1._sortfree
+    rng = np.random.default_rng(8)
+    n = 256
+    res = [names[i] for i in rng.integers(0, len(names), n)]
+    origins = ["app-a" if x else "" for x in (rng.random(n) < 0.4)]
+    acquire = [int(a) for a in rng.integers(1, 3, n)]
+    try:
+        for _ in range(3):
+            v0 = sph0.entry_batch(res, origins=origins, acquire=acquire)
+            v1 = sph1.entry_batch(res, origins=origins, acquire=acquire)
+            assert np.array_equal(np.asarray(v0.allow),
+                                  np.asarray(v1.allow))
+            assert np.array_equal(np.asarray(v0.wait_ms),
+                                  np.asarray(v1.wait_ms))
+            clk0.advance_ms(90)
+            clk1.advance_ms(90)
+        assert sph1.obs.counters.get(ck.ROUTE_GENERAL) > 0, \
+            "fixture no longer takes the general route — weak test"
+        assert sph1.obs.counters.get(ck.SORTFREE_OVERFLOW) > 0, \
+            "tiny claim table never overflowed through the runtime"
+        assert sph0.obs.counters.get(ck.SORTFREE_OVERFLOW) == 0
+    finally:
+        sph0.close()
+        sph1.close()
