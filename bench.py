@@ -158,6 +158,18 @@ def measure_serving(jax) -> dict:
     return out
 
 
+def _tuned_provenance(spec, mesh):
+    """Round-11 tuned-config provenance for the artifact (sentinel_tpu/
+    tune): fingerprint-checked against this run's spec/mesh. A broken
+    artifact must never take the headline down — degrade to an error
+    field instead."""
+    try:
+        from sentinel_tpu.tune import provenance
+        return provenance(spec, mesh)
+    except Exception as exc:      # noqa: BLE001
+        return {"tuned": False, "error": repr(exc)}
+
+
 def main() -> None:
     import jax
 
@@ -397,7 +409,14 @@ def main() -> None:
             "SENTINEL_HOST_STAGING", "SENTINEL_FRONTEND_BATCH",
             "SENTINEL_FRONTEND_DEADLINE_MS", "SENTINEL_FRONTEND_BUDGET_MS",
             "SENTINEL_FRONTEND_IDLE_MS", "SENTINEL_FRONTEND_QUEUE",
+            "SENTINEL_SORTFREE", "SENTINEL_SORTFREE_BITS",
+            "SENTINEL_SORTFREE_CHUNK", "SENTINEL_TUNED_CONFIG",
         ) if k in os.environ},
+        # round 11 — tuned-config provenance: whether a
+        # SENTINEL_TUNED_CONFIG artifact applied to this run (fingerprint
+        # checked against THIS spec/mesh), and its per-knob values, so a
+        # BASELINE.md chip row is reproducible without the machine
+        "tuned_config": _tuned_provenance(spec, mesh),
         # serving layout that produced the headline (n_devices=1 on the
         # single-chip run — the comparison row the weak-scaling curve and
         # sharded artifacts are read against), plus the transfer knobs

@@ -93,6 +93,16 @@ claims is the accelerator's, carried informationally by the bench
 artifacts ``general`` vs ``general_sortfree`` and their
 ``aggregation_ms`` keys). ``CI_GATE_SORTFREE=0`` skips. See the
 comment block above ``SORTFREE_ENV_FLAG``.
+
+Gate (j) — the autotune gate (r11): a tiny CPU sweep (2 knobs × small
+grids, short rungs) through ``sentinel_tpu.tune.run_sweep`` must
+CONVERGE with every trial passing the verdict bit-parity spot-check
+and pin a ``TUNED.json``; the pinned config, loaded back through the
+real ``SENTINEL_TUNED_CONFIG`` startup path, must then produce
+bit-identical verdicts below the batcher and ≥ ``TUNE_MIN_RATIO`` of
+the default config's throughput through the full serving replay.
+``CI_GATE_TUNE=0`` skips. See the comment block above
+``TUNE_ENV_FLAG``.
 """
 
 from __future__ import annotations
@@ -1119,6 +1129,117 @@ def measure_sortfree() -> dict:
     return out
 
 
+# Gate (j) — the autotune gate (r11): sentinel_tpu/tune/ promoted the
+# scattered env knobs into a typed registry plus a measurement-driven
+# sweep (coordinate descent + successive halving over REAL serving
+# episodes), so the gate pins the whole loop end to end:
+#   sweep:    run_sweep over 2 knobs × tiny grids at short rungs on the
+#             CPU backend. It must CONVERGE (every trial ran; no parity
+#             failure) and write the TUNED.json artifact. Every trial's
+#             verdict bit-parity spot-check vs the default config must
+#             pass (tune.parity_fail == 0) — the tuner is a PERF tool
+#             and must never pin a config that changes a verdict.
+#   pin:      the artifact is then loaded back the way production
+#             would: SENTINEL_TUNED_CONFIG set for a fresh serving
+#             replay, with the provenance probe asserting the startup
+#             path genuinely resolved it (fingerprint matched, knobs
+#             applied) rather than silently falling back to defaults.
+#   parity:   the pinned config's trace-knob slice must produce a
+#             byte-identical verdict stream below the batcher
+#             (_verdict_signature, the same comparable every trial
+#             used).
+#   ratio:    tuned/default settled-request throughput through the full
+#             serving replay, best-of-N interleaved so machine drift
+#             cancels, must stay ≥ TUNE_MIN_RATIO — the tuner's whole
+#             contract is "never worse than defaults"; a winner that
+#             loses to the baseline it beat during search means the
+#             scoring plumbing (obs-sourced decisions_per_s / p99) or
+#             the artifact application path regressed.
+# CI_GATE_TUNE=0 skips the whole gate.
+TUNE_ENV_FLAG = "CI_GATE_TUNE"
+TUNE_MIN_RATIO = 0.95
+
+
+def measure_tune() -> dict:
+    import shutil
+    import tempfile
+
+    sys.path.insert(0, str(HERE.parent))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from benchmarks import serving_bench
+    from sentinel_tpu.obs import counters as obs_keys
+    from sentinel_tpu.tune import artifact as tune_artifact
+    from sentinel_tpu.tune import knobs as tune_knobs
+    from sentinel_tpu.tune import run_sweep
+    from sentinel_tpu.tune.runner import _verdict_signature
+
+    tmp = tempfile.mkdtemp(prefix="sentinel-tune-gate-")
+    out_path = os.path.join(tmp, "TUNED.json")
+    try:
+        sweep = run_sweep(
+            envs=("SENTINEL_PIPELINE_DEPTH", "SENTINEL_FRONTEND_BUDGET_MS"),
+            grids={"SENTINEL_PIPELINE_DEPTH": (1, 2),
+                   "SENTINEL_FRONTEND_BUDGET_MS": (1, 3)},
+            workload="steady", seed=11, rate_rps=800.0, slo_p99_ms=150.0,
+            rung_ms=(150, 300), out_path=out_path)
+        res = sweep["result"]
+        out = {
+            "converged": bool(res.converged),
+            "trials": sweep["trials"],
+            "parity_checks": sweep["parity_checks"],
+            "parity_fail": sweep["counters"].get(
+                obs_keys.TUNE_PARITY_FAIL, 0),
+            "best_config": dict(res.best_config),
+            "artifact_written": sweep["artifact"] is not None,
+        }
+        if sweep["artifact"] is None:
+            return out
+
+        # pinned-config bit-parity below the batcher: same comparable
+        # every trial used, over the winner's trace-knob slice
+        trace_cfg = tune_knobs.trace_knobs(sweep["artifact"]["knobs"])
+        out["pinned_bit_parity"] = (
+            _verdict_signature(trace_cfg, seed=5, steps=3, events=64)
+            == _verdict_signature({}, seed=5, steps=3, events=64))
+
+        # pinned vs default through the full serving replay — the pinned
+        # run loads the artifact via the REAL startup path (env pin), so
+        # this also covers resolve_startup + the frontend kwarg fill
+        prev = os.environ.get(tune_artifact.TUNED_CONFIG_ENV)
+
+        def episode(pin: bool) -> float:
+            if pin:
+                os.environ[tune_artifact.TUNED_CONFIG_ENV] = out_path
+            else:
+                os.environ.pop(tune_artifact.TUNED_CONFIG_ENV, None)
+            try:
+                if pin and "artifact_loaded" not in out:
+                    prov = tune_artifact.provenance()
+                    out["artifact_loaded"] = bool(prov.get("tuned"))
+                m = serving_bench.run_workload(
+                    "steady", seed=11, duration_ms=300.0, rate_rps=800.0)
+            finally:
+                if prev is None:
+                    os.environ.pop(tune_artifact.TUNED_CONFIG_ENV, None)
+                else:
+                    os.environ[tune_artifact.TUNED_CONFIG_ENV] = prev
+            return float(m.get("decisions_per_s") or 0.0)
+
+        best = {}
+        for rep in range(3):
+            order = [("tuned", True), ("default", False)]
+            for key, pin in (order if rep % 2 == 0 else order[::-1]):
+                best[key] = max(best.get(key, 0.0), episode(pin))
+        out["tuned_decisions_per_s"] = best["tuned"]
+        out["default_decisions_per_s"] = best["default"]
+        out["tuned_vs_default_ratio"] = (
+            best["tuned"] / best["default"] if best["default"] else 0.0)
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> int:
     best = max(measure_once() for _ in range(3))
     cal = calibrate()
@@ -1133,6 +1254,8 @@ def main() -> int:
               if os.environ.get(MESHED_ENV_FLAG, "1") != "0" else None)
     sortfree = (measure_sortfree()
                 if os.environ.get(SORTFREE_ENV_FLAG, "1") != "0" else None)
+    tune = (measure_tune()
+            if os.environ.get(TUNE_ENV_FLAG, "1") != "0" else None)
     ratios = {k.replace("_s_per_step", "_ratio"): v / cal
               for k, v in prep.items()}
     if "--update" in sys.argv:
@@ -1165,6 +1288,11 @@ def main() -> int:
              "sortfree": ({k: (round(v, 4) if isinstance(v, float) else v)
                            for k, v in sortfree.items()}
                           if sortfree is not None else None),
+             # informational: gate (j) is convergence + parity (binary)
+             # plus the fixed TUNE_MIN_RATIO band, not re-baselined
+             "tune": ({k: (round(v, 4) if isinstance(v, float) else v)
+                       for k, v in tune.items()}
+                      if tune is not None else None),
              "calibration_s": cal}, indent=1))
         print(f"baseline updated: floor={best / 2:.0f} (measured {best:.0f}) "
               f"on {fingerprint()}; host-prep ratios "
@@ -1194,6 +1322,9 @@ def main() -> int:
         "sortfree": ({k: (round(v, 4) if isinstance(v, float) else v)
                       for k, v in sortfree.items()}
                      if sortfree is not None else "skipped"),
+        "tune": ({k: (round(v, 4) if isinstance(v, float) else v)
+                  for k, v in tune.items()}
+                 if tune is not None else "skipped"),
     }
     print(json.dumps(out))
     rc = 0
@@ -1300,6 +1431,41 @@ def main() -> int:
                   f"per-element host loop, lost fusion, or an "
                   f"accidental device sync in ops/sortfree.py",
                   file=sys.stderr)
+            rc = 1
+    if tune is not None:
+        if not tune["converged"] or not tune["artifact_written"]:
+            print(f"TUNE-GATE REGRESSION: the tiny CPU sweep failed to "
+                  f"converge or pin its TUNED.json (converged="
+                  f"{tune['converged']}, artifact_written="
+                  f"{tune['artifact_written']}, trials={tune['trials']}) "
+                  f"— the search/runner/artifact loop is broken",
+                  file=sys.stderr)
+            rc = 1
+        if tune["parity_fail"] != 0 or not tune.get("pinned_bit_parity",
+                                                    True):
+            print(f"TUNE-PARITY REGRESSION: verdict bit-parity broke "
+                  f"(tune.parity_fail={tune['parity_fail']}, pinned "
+                  f"config parity={tune.get('pinned_bit_parity')}) — a "
+                  f"tuned config changed a VERDICT; the tuner must only "
+                  f"ever move perf knobs", file=sys.stderr)
+            rc = 1
+        if tune["artifact_written"] and not tune.get("artifact_loaded"):
+            print("TUNE-MECHANISM REGRESSION: SENTINEL_TUNED_CONFIG "
+                  "pointed at the freshly pinned artifact but the "
+                  "startup path did not resolve it (provenance says "
+                  "tuned=false) — the load/fingerprint plumbing is dead "
+                  "and every 'tuned' run silently uses defaults",
+                  file=sys.stderr)
+            rc = 1
+        tr = tune.get("tuned_vs_default_ratio")
+        if tune["artifact_written"] and (tr is None
+                                         or tr < TUNE_MIN_RATIO):
+            print(f"TUNE-PERF REGRESSION: tuned/default throughput ratio "
+                  f"{tr if tr is None else round(tr, 3)} < "
+                  f"{TUNE_MIN_RATIO} through the serving replay — the "
+                  f"pinned winner loses to the defaults it beat during "
+                  f"search; the obs-sourced scoring or the artifact "
+                  f"application path regressed", file=sys.stderr)
             rc = 1
     if trace["pinned_records"] == 0 or "deadline_miss" not in trace["kinds"]:
         print(f"TRACE-CAPTURE REGRESSION: {trace['induced_misses']} induced "

@@ -53,6 +53,8 @@ KNOB_ENVS = (
     "SENTINEL_FRONTEND_BATCH", "SENTINEL_FRONTEND_DEADLINE_MS",
     "SENTINEL_FRONTEND_BUDGET_MS", "SENTINEL_FRONTEND_IDLE_MS",
     "SENTINEL_FRONTEND_QUEUE",
+    "SENTINEL_SORTFREE", "SENTINEL_SORTFREE_BITS", "SENTINEL_SORTFREE_CHUNK",
+    "SENTINEL_TUNED_CONFIG",
     "SERVING_DURATION_MS", "SERVING_RATE", "SERVING_SEED",
 )
 
@@ -196,6 +198,15 @@ def run_workload(name: str, *, seed: int = DEFAULT_SEED,
         "batcher": {"batch_max": batch_max, "deadline_ms": deadline_ms,
                     "budget_ms": budget_ms, "idle_ms": idle_ms,
                     "depth": depth, "queue_max": queue_max},
+        # obs-sourced scoring surface (round 11 — what the autotuner
+        # trials read: the engine's OWN request histogram + pipeline
+        # counters, not the replay's wall clocks above)
+        "p99_obs_ms": sph.obs.hist_request.percentile_ms(0.99),
+        "settled_obs": sph.obs.hist_request.count,
+        "pipe_stall": c.get(obs_keys.PIPE_STALL),
+        "pipe_depth_sum": c.get(obs_keys.PIPE_DEPTH),
+        "decisions_per_s": (sph.obs.hist_request.count
+                            / (duration_ms / 1e3) if duration_ms else 0.0),
     }
     # worst-request trace dump: the slowest request's causal chain as a
     # Chrome-trace document (load serving_bench.json, pull
@@ -233,9 +244,14 @@ def main() -> int:
         res = run_workload(name, **over)
         results[name] = res
         print(json.dumps(res))
+    from sentinel_tpu.tune import provenance as tuned_provenance
     artifact = {
         "schema": "serving_bench/1",
         "env_knobs": env_knobs(),
+        # round 11: did a SENTINEL_TUNED_CONFIG artifact apply, from
+        # where, under which fingerprint, with which per-knob values —
+        # so a BASELINE.md row is reproducible off-machine
+        "tuned_config": tuned_provenance(),
         "defaults": {"duration_ms": DEFAULT_DURATION_MS,
                      "rate_rps": DEFAULT_RATE, "seed": DEFAULT_SEED},
         "workloads": results,

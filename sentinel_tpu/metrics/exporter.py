@@ -36,6 +36,9 @@ Self-telemetry families (from ``Sentinel.obs`` — obs/; absent while
     sentinel_flight_pinned_total           SLO-pinned trace chains
     sentinel_flight_trigger_total{kind=...} deadline_miss/shed/p99/block_burst
     sentinel_sortfree_bucket_overflow_total claim-cascade sorted fallbacks
+    sentinel_tune_total{event=...}         autotuner lifecycle: config_loaded/
+                                           fingerprint_fallback/knob_rejected/
+                                           trial/parity_fail
 
 Every key in the fixed counter CATALOG (obs/counters.py) has a family
 here — tests/test_obs.py walks the catalog against the rendered scrape
@@ -143,6 +146,11 @@ class SentinelCollector:
             "Sort-free claim-cascade overflows (elements that fell back "
             "to the sorted branch; sustained growth = bucket table "
             "undersized for the key distribution)")
+        tune = CounterMetricFamily(
+            f"{ns}_tune",
+            "Autotuner lifecycle: config_loaded / fingerprint_fallback "
+            "/ knob_rejected at startup, trial / parity_fail during a "
+            "sweep", labels=["event"])
         if not describe_only and obs is not None and obs.enabled:
             from sentinel_tpu.obs import counters as ck
             counts = obs.counters.snapshot()
@@ -196,9 +204,15 @@ class SentinelCollector:
                 if key.startswith(ck.FLIGHT_TRIGGER_PREFIX):
                     flight_trig.add_metric(
                         [key[len(ck.FLIGHT_TRIGGER_PREFIX):]], v)
+            for key, ev in ((ck.TUNE_LOADED, "config_loaded"),
+                            (ck.TUNE_FALLBACK, "fingerprint_fallback"),
+                            (ck.TUNE_KNOB_REJECTED, "knob_rejected"),
+                            (ck.TUNE_TRIAL, "trial"),
+                            (ck.TUNE_PARITY_FAIL, "parity_fail")):
+                tune.add_metric([ev], counts.get(key, 0))
         yield from (p99, quant, req_quant, route, hits, misses, retries,
                     blocks, occupy, pipeline, frontend, fe_flush, wraps,
-                    flight_pinned, flight_trig, sf_ovf)
+                    flight_pinned, flight_trig, sf_ovf, tune)
 
     def collect(self):
         ns = self.namespace

@@ -46,6 +46,12 @@ dispatch stays within the 2% observability budget (benchmarks/ci_gate.py
   (chains persisted to the ``<app>-trace`` log) and
   ``trigger.{deadline_miss, shed, p99, block_burst}`` (which SLO
   trigger fired, after per-kind rate limiting).
+* ``tune.*`` — the serving autotuner (sentinel_tpu/tune/):
+  ``config_loaded`` / ``fingerprint_fallback`` (startup resolution of
+  the ``SENTINEL_TUNED_CONFIG`` artifact), ``knob_rejected`` (unknown
+  or out-of-clamp ``SENTINEL_*`` env keys found at construction),
+  ``trial`` (sweep episodes scored against this engine's obs) and
+  ``parity_fail`` (verdict bit-parity spot-check failures).
 
 :data:`CATALOG` is the fixed, ordered multihost-aggregatable key set:
 every process packs its snapshot into one int64 vector
@@ -110,6 +116,19 @@ PIPE_MESHED = "pipeline.meshed_dispatch"
 ROUTE_SORTFREE = "split_route.sortfree"
 SORTFREE_OVERFLOW = "sortfree.bucket_overflow"
 
+# PR 11 — serving autotuner (sentinel_tpu/tune/): startup resolution of
+# the SENTINEL_TUNED_CONFIG artifact (loaded vs fingerprint-mismatch
+# fallback to defaults), the knob-registry validation warnings (unknown
+# or out-of-clamp SENTINEL_* env keys — one tick per finding at Sentinel
+# construction), and sweep health (trials run on this engine's obs,
+# verdict bit-parity spot-check failures — any nonzero parity_fail
+# disqualifies the sweep)
+TUNE_LOADED = "tune.config_loaded"
+TUNE_FALLBACK = "tune.fingerprint_fallback"
+TUNE_KNOB_REJECTED = "tune.knob_rejected"
+TUNE_TRIAL = "tune.trial"
+TUNE_PARITY_FAIL = "tune.parity_fail"
+
 #: Fixed aggregation catalog (order is the wire format of the multihost
 #: counter vector — append only, never reorder).
 CATALOG = (
@@ -132,6 +151,8 @@ CATALOG = (
     FLIGHT_TRIGGER_PREFIX + "block_burst",
     ROUTE_MESHED, PIPE_MESHED,
     ROUTE_SORTFREE, SORTFREE_OVERFLOW,
+    TUNE_LOADED, TUNE_FALLBACK, TUNE_KNOB_REJECTED,
+    TUNE_TRIAL, TUNE_PARITY_FAIL,
 )
 
 

@@ -64,7 +64,7 @@ from sentinel_tpu.rules import flow as flow_mod
 from sentinel_tpu.rules import param_flow as pf_mod
 from sentinel_tpu.rules import system as sys_mod
 from sentinel_tpu.core.callbacks import StatisticCallbackRegistry
-from sentinel_tpu.core.logs import BlockStatLogger
+from sentinel_tpu.core.logs import BlockStatLogger, record_log
 from sentinel_tpu.obs import RuntimeObs
 from sentinel_tpu.obs import counters as obs_keys
 from sentinel_tpu.stats import events as ev
@@ -485,6 +485,30 @@ class Sentinel:
         # process epoch: wraparound-safe int32 relative time base
         self.epoch_ms = self.clock.now_ms()
 
+        # Round 11 — tuned-config startup resolution + knob-registry
+        # validation (sentinel_tpu/tune). ``SENTINEL_TUNED_CONFIG``
+        # names a sweep-produced TUNED.json; a fingerprint-matching
+        # artifact fills in every knob whose env var the operator left
+        # UNSET (explicit env wins per knob — the override path), a
+        # mismatch resolves to {} and serving proceeds on defaults.
+        # Events (artifact load/fallback + unknown/out-of-clamp
+        # SENTINEL_* env keys) are routed to RecordLog and the tune.*
+        # counters once self.obs exists below.
+        from sentinel_tpu import tune as tune_mod
+        self._tuned, self._tune_events = tune_mod.resolve_startup(
+            spec=self.spec, mesh=mesh)
+        # SORTFREE_BITS/CHUNK are read from env inside the traced flow
+        # programs (ops/sortfree.py) — the one knob pair with no
+        # injection path — so a tuned value pins the (still-unset) env
+        # var for this process; first engine wins, and the pin is logged
+        for _env in ("SENTINEL_SORTFREE_BITS", "SENTINEL_SORTFREE_CHUNK"):
+            if _env in self._tuned and _env not in os.environ:
+                os.environ[_env] = str(self._tuned[_env])
+                self._tune_events.append((
+                    None,   # log-only: the artifact load already ticked
+                    f"pinned {_env}={self._tuned[_env]} from tuned "
+                    f"config (trace-time knob, applied via env)"))
+
         self._lock = threading.RLock()
         # main row → alt rows it ever hashed to; consulted on row eviction so
         # the recycled row's origin/context stats are cleared too
@@ -542,6 +566,16 @@ class Sentinel:
         # instrumentation site below guards on the single `obs.enabled`
         # flag (SENTINEL_OBS_DISABLE); sampling via SENTINEL_TRACE_SAMPLE.
         self.obs = RuntimeObs(clock=self.clock)
+        # surface the startup tune events (artifact load / fingerprint
+        # fallback / rejected env knobs) now that telemetry exists:
+        # RecordLog line + one counter tick each (key None = log-only)
+        if self._tune_events:
+            rl = record_log()
+            for _key, _msg in self._tune_events:
+                (rl.info if _key == obs_keys.TUNE_LOADED
+                 else rl.warning)("tune: %s", _msg)
+                if _key is not None:
+                    self.obs.counters.add(_key)
         # services registered for Sentinel.close() (metric timer,
         # exporter, ...): stopped once, LIFO, idempotently
         self._shutdown_hooks: List = []
@@ -568,13 +602,18 @@ class Sentinel:
         self._breaker_firing = False
 
         # dispatch-cost knobs (read once at construction): buffer donation
-        # on the jitted steps and host staging reuse for batch columns
-        self._donate = donation_enabled()
-        self._staging_on = host_staging_enabled()
+        # on the jitted steps and host staging reuse for batch columns.
+        # self._tuned only carries knobs whose env var is UNSET, so the
+        # get() fallback to the env helper preserves env precedence
+        self._donate = bool(self._tuned.get("SENTINEL_DONATE",
+                                            donation_enabled()))
+        self._staging_on = bool(self._tuned.get("SENTINEL_HOST_STAGING",
+                                                host_staging_enabled()))
         # padded batch size → _StagingRing; ring depth covers the deepest
         # supported dispatch pipeline plus the split path's two builds
         self._staging: dict = {}
-        self._staging_depth = max(4, 2 * pipeline_depth() + 2)
+        self._staging_depth = max(4, 2 * int(self._tuned.get(
+            PIPELINE_DEPTH_ENV, pipeline_depth())) + 2)
 
         (self._jit_decide, self._jit_decide_prio,
          self._jit_decide_noalt, self._jit_decide_prio_noalt,
@@ -670,8 +709,10 @@ class Sentinel:
         self._skip_sys = not getattr(self, "_sys_rules", [])
         # sort-free segment grouping (env-pinned per process, read at
         # every reload so a test flipping the env var between Sentinels
-        # gets the expected variant)
-        self._sortfree = sortfree_enabled()
+        # gets the expected variant; the tuned-config override applies
+        # only while the env var is unset — see resolve_startup)
+        self._sortfree = bool(getattr(self, "_tuned", {}).get(
+            "SENTINEL_SORTFREE", sortfree_enabled()))
         # Thread-gauge elision: nothing loaded READS live concurrency →
         # the gauge-maintenance scatters compile away (the only readers:
         # THREAD-grade flow rules — DefaultController.java:50-76, system
@@ -1194,8 +1235,18 @@ class Sentinel:
         tier over this runtime (kwargs pass through: batch_max,
         deadline_ms, budget_ms, idle_ms, queue_max, depth, ...). The
         batcher self-registers with :meth:`register_shutdown`, so
-        :meth:`close` tears it down. One batcher per event loop."""
+        :meth:`close` tears it down. One batcher per event loop.
+
+        Tuned-config application (round 11): any of those kwargs the
+        caller leaves unset is filled from the ``SENTINEL_TUNED_CONFIG``
+        artifact resolved at construction — but only for knobs whose env
+        var is also unset (explicit kwarg > explicit env > artifact >
+        the batcher's built-in defaults)."""
         from sentinel_tpu.frontend import AdaptiveBatcher
+        from sentinel_tpu.tune import FRONTEND_KWARG_ENVS
+        for kw, env in FRONTEND_KWARG_ENVS:
+            if kw not in kwargs and env in self._tuned:
+                kwargs[kw] = self._tuned[env]
         return AdaptiveBatcher(self, **kwargs)
 
     # ------------------------------------------------------------------
@@ -1214,6 +1265,26 @@ class Sentinel:
         return jnp.asarray(np.array(
             [idx_s, idx_m, self._rel_ms(now_ms),
              now_ms % s.second.win_ms], np.int32))
+
+    def _restamp_if_stale_locked(self, at_ms: Optional[int], now: int,
+                                 times):
+        """Safe-late re-stamp for event-time (``at_ms``) dispatches,
+        atomic with ``_seen_idx`` — callers hold ``_lock``. A stamp a
+        full window ring older than anything already dispatched would
+        re-own a physical bucket a newer write holds: the device-side
+        refresh zeroes that bucket's LIVE counts, resurrecting spent
+        admission budget mid-window (real over-admission, caught by
+        test_fastpath's deterministic overadmit harness). The fast-path
+        flush pre-checks the same condition, but reads ``_seen_idx``
+        outside this lock — a decide landing between its check and this
+        dispatch makes the stale stamp dangerous, so the authoritative
+        check lives here."""
+        if (at_ms is not None
+                and self._seen_idx - self.spec.second.index_of(now)
+                >= self.spec.second.buckets):
+            now = self.clock.now_ms()
+            times = self._time_scalars(now)
+        return now, times
 
     # ------------------------------------------------------------------
     # Per-call API
@@ -2326,6 +2397,7 @@ class Sentinel:
             # or a reload racing here could land stale pairs on the new table
             if batch.param_rules is not None and param_gen != self._param_gen:
                 batch = batch._replace(param_rules=None, param_keys=None)
+            now, times = self._restamp_if_stale_locked(at_ms, now, times)
             self._drain_evictions_locked()
             self._seen_idx = max(self._seen_idx,
                                  self.spec.second.index_of(now))
@@ -2844,6 +2916,7 @@ class Sentinel:
         load1, cpu = self._cpu.sample()
         sys_scalars = jnp.asarray(np.array([load1, cpu], np.float32))
         with self._lock:
+            now, times = self._restamp_if_stale_locked(at_ms, now, times)
             self._drain_evictions_locked()
             self._seen_idx = max(self._seen_idx,
                                  self.spec.second.index_of(now))
@@ -2985,6 +3058,7 @@ class Sentinel:
         now = self.clock.now_ms() if at_ms is None else at_ms
         times = self._time_scalars(now)
         with self._lock:
+            now, times = self._restamp_if_stale_locked(at_ms, now, times)
             self._seen_idx = max(self._seen_idx,
                                  self.spec.second.index_of(now))
             unpin = None

@@ -94,8 +94,13 @@ class DispatchPipeline:
         # pipeline.meshed_dispatch counter so the scrape can attribute
         # pipeline traffic to the mesh path without reading the runtime
         self._meshed = sentinel.mesh is not None
-        self.depth = (pipeline_depth() if depth is None
-                      else max(1, int(depth)))
+        if depth is None:
+            # default depth: the engine's tuned-config resolution
+            # (round 11 — SENTINEL_TUNED_CONFIG, env-unset knobs only)
+            # falls back to the SENTINEL_PIPELINE_DEPTH env clamp
+            tuned = getattr(sentinel, "_tuned", None) or {}
+            depth = tuned.get(PIPELINE_DEPTH_ENV, pipeline_depth())
+        self.depth = max(1, int(depth))
         self._lock = threading.Lock()
         # (seq, PendingVerdicts) in submission order
         self._inflight: "collections.deque" = collections.deque()

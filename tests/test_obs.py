@@ -447,17 +447,22 @@ def test_catalog_vector_roundtrip():
     assert ck.vector_counts(longer) == back
 
 
-def test_catalog_is_append_only_with_r10_keys_last():
+def test_catalog_is_append_only_with_r11_keys_last():
     """The multihost allgather aggregates CATALOG by POSITION (prefix
     compatibility with older peers), so the catalog may only ever grow at
-    the tail. Pin the newest (round-10 sortfree) keys to the end, with the
-    round-9 mesh keys immediately above them — an insertion above either
-    pair (or a re-ordering) would silently mis-attribute every counter on
-    a mixed-version fleet."""
-    assert ck.CATALOG[-2:] == (ck.ROUTE_SORTFREE, ck.SORTFREE_OVERFLOW)
-    assert ck.CATALOG[-4:-2] == (ck.ROUTE_MESHED, ck.PIPE_MESHED)
+    the tail. Pin the newest (round-11 tune) keys to the end, with the
+    round-10 sortfree and round-9 mesh keys immediately above them — an
+    insertion above any group (or a re-ordering) would silently
+    mis-attribute every counter on a mixed-version fleet."""
+    assert ck.CATALOG[-5:] == (ck.TUNE_LOADED, ck.TUNE_FALLBACK,
+                               ck.TUNE_KNOB_REJECTED, ck.TUNE_TRIAL,
+                               ck.TUNE_PARITY_FAIL)
+    assert ck.CATALOG[-7:-5] == (ck.ROUTE_SORTFREE, ck.SORTFREE_OVERFLOW)
+    assert ck.CATALOG[-9:-7] == (ck.ROUTE_MESHED, ck.PIPE_MESHED)
     assert ck.ROUTE_SORTFREE == "split_route.sortfree"
     assert ck.SORTFREE_OVERFLOW == "sortfree.bucket_overflow"
     assert ck.ROUTE_MESHED == "split_route.meshed"
     assert ck.PIPE_MESHED == "pipeline.meshed_dispatch"
+    assert ck.TUNE_LOADED == "tune.config_loaded"
+    assert ck.TUNE_KNOB_REJECTED == "tune.knob_rejected"
     assert len(ck.CATALOG) == len(set(ck.CATALOG))

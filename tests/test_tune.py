@@ -1,0 +1,365 @@
+"""Round 11: the serving autotuner (sentinel_tpu/tune/).
+
+Policy-core tests run the pure search under ManualClock with synthetic
+response surfaces — no engine, no env. Integration tests pin the
+artifact round-trip, the fingerprint-mismatch fallback (including at
+Sentinel construction, with its counter), the knob-registry validation
+warnings, the registry-vs-read-site clamp agreement (anti-drift), and
+``Sentinel.frontend()``'s tuned-kwarg precedence.
+"""
+
+import json
+
+import pytest
+
+import sentinel_tpu as stpu
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.obs import counters as obs_keys
+from sentinel_tpu.tune import artifact as art_mod
+from sentinel_tpu.tune import knobs as knobs_mod
+from sentinel_tpu.tune.search import (
+    DISQUALIFIED, TrialOutcome, TuneSearch, score_outcome,
+)
+
+T0 = 1_785_000_000_000
+
+
+def spec_for(env, **over):
+    return knobs_mod.KNOB_BY_ENV[env]._replace(**over)
+
+
+def make_sph(clk, **over):
+    kw = dict(max_resources=64, max_origins=16, max_flow_rules=16,
+              max_degrade_rules=8, max_authority_rules=8)
+    kw.update(over)
+    return stpu.Sentinel(config=stpu.load_config(**kw), clock=clk)
+
+
+# ------------------------------------------------------------- policy core
+
+def test_synthetic_surface_convergence():
+    """Coordinate descent over two knobs with a known optimum: the
+    search must land on it, and the incumbent-vs-winner rule must leave
+    the baseline reachable in the memo (best >= baseline)."""
+    clk = ManualClock(start_ms=T0)
+    space = [spec_for("SENTINEL_PIPELINE_DEPTH", values=(1, 2, 4, 8)),
+             spec_for("SENTINEL_FRONTEND_BATCH", values=(64, 128, 256))]
+
+    def surface(cfg, episode_ms, rung):
+        # unimodal: best at depth=4, batch=128; longer episodes only
+        # sharpen the same ordering
+        d, b = cfg["SENTINEL_PIPELINE_DEPTH"], cfg["SENTINEL_FRONTEND_BATCH"]
+        dps = 1000.0 - 30.0 * abs(d - 4) - 0.5 * abs(b - 128)
+        clk.advance_ms(episode_ms)
+        return TrialOutcome(decisions_per_s=dps, p99_ms=10.0)
+
+    res = TuneSearch(space, slo_p99_ms=50.0, clock=clk,
+                     rung_ms=(100, 300)).run(surface)
+    assert res.converged
+    assert res.best_config == {"SENTINEL_PIPELINE_DEPTH": 4,
+                               "SENTINEL_FRONTEND_BATCH": 128}
+    assert (res.best_outcome.decisions_per_s
+            >= res.baseline_outcome.decisions_per_s)
+    # history timestamps come from the injected clock, strictly advancing
+    stamps = [r.t_ms for r in res.history]
+    assert stamps == sorted(stamps) and stamps[0] > T0
+
+
+def test_slo_constraint_dominates_throughput():
+    """A config with higher decisions/s but a busted p99 must lose to a
+    compliant one (lexicographic objective)."""
+    clk = ManualClock(start_ms=T0)
+    space = [spec_for("SENTINEL_FRONTEND_BATCH", values=(64, 512))]
+
+    def surface(cfg, episode_ms, rung):
+        if cfg["SENTINEL_FRONTEND_BATCH"] == 512:
+            return TrialOutcome(decisions_per_s=5000.0, p99_ms=80.0)
+        return TrialOutcome(decisions_per_s=1000.0, p99_ms=9.0)
+
+    res = TuneSearch(space, slo_p99_ms=50.0, clock=clk,
+                     rung_ms=(100,)).run(surface)
+    assert res.best_config["SENTINEL_FRONTEND_BATCH"] != 512
+    hi = score_outcome(TrialOutcome(5000.0, 80.0), 50.0)
+    lo = score_outcome(TrialOutcome(1000.0, 9.0), 50.0)
+    assert hi < 0 < lo
+
+
+def test_successive_halving_elimination_order():
+    """rung 0 must cut the worst half (keeping >= 2 before the final
+    rung), and only finalists pay the rung-1 budget."""
+    clk = ManualClock(start_ms=T0)
+    space = [spec_for("SENTINEL_PIPELINE_DEPTH", values=(1, 2, 4, 8))]
+    rungs_seen = {}
+
+    def surface(cfg, episode_ms, rung):
+        d = cfg["SENTINEL_PIPELINE_DEPTH"]
+        rungs_seen.setdefault(d, set()).add(episode_ms)
+        return TrialOutcome(decisions_per_s=float(100 * d), p99_ms=5.0)
+
+    res = TuneSearch(space, slo_p99_ms=50.0, clock=clk,
+                     rung_ms=(100, 400), eta=2).run(surface)
+    assert res.converged and res.best_config["SENTINEL_PIPELINE_DEPTH"] == 8
+    elim0, elim1 = res.eliminations
+    assert elim0.env == "SENTINEL_PIPELINE_DEPTH" and elim0.rung == 0
+    # score is monotone in depth: rung 0 cuts exactly the bottom half,
+    # the final rung then crowns the winner
+    assert set(elim0.eliminated) == {1, 2} and set(elim0.survivors) == {8, 4}
+    assert elim1.rung == 1 and elim1.survivors == (8,)
+    # eliminated values never ran the expensive rung (depth=2 is the
+    # built-in default, so the baseline run pays rung 1 for it anyway);
+    # survivors did
+    assert 400 not in rungs_seen[1]
+    for d in (2, 4, 8):
+        assert 400 in rungs_seen[d]
+
+
+def test_parity_failure_disqualifies_and_blocks_convergence():
+    clk = ManualClock(start_ms=T0)
+    space = [spec_for("SENTINEL_SORTFREE", values=(True, False))]
+
+    def surface(cfg, episode_ms, rung):
+        bad = cfg["SENTINEL_SORTFREE"] is False
+        return TrialOutcome(decisions_per_s=9999.0 if bad else 100.0,
+                            p99_ms=5.0, parity_ok=not bad)
+
+    res = TuneSearch(space, slo_p99_ms=50.0, clock=clk,
+                     rung_ms=(100,)).run(surface)
+    assert res.best_config["SENTINEL_SORTFREE"] is True
+    assert not res.converged          # a parity failure anywhere = no pin
+    assert any(r.score == DISQUALIFIED for r in res.history)
+
+
+def test_trial_memoization_by_config_and_budget():
+    """The incumbent re-measured at an already-paid (config, budget) is
+    free — the baseline at the final rung must not re-run."""
+    clk = ManualClock(start_ms=T0)
+    space = [spec_for("SENTINEL_PIPELINE_DEPTH", values=(2, 4))]
+    calls = []
+
+    def surface(cfg, episode_ms, rung):
+        calls.append((cfg["SENTINEL_PIPELINE_DEPTH"], episode_ms))
+        return TrialOutcome(decisions_per_s=100.0, p99_ms=5.0)
+
+    TuneSearch(space, slo_p99_ms=50.0, clock=clk,
+               rung_ms=(100, 300)).run(surface)
+    assert len(calls) == len(set(calls))
+
+
+# ---------------------------------------------------------------- artifact
+
+def test_tuned_json_round_trip(tmp_path):
+    p = str(tmp_path / "TUNED.json")
+    fp = {"backend": "cpu", "device_kind": "cpu", "n_devices_visible": 1,
+          "host_cores": 4,
+          "mesh": {"n_devices": 1, "axis": None, "sharded": False}}
+    doc = art_mod.save_tuned(
+        p, fingerprint=fp,
+        knob_values={"SENTINEL_PIPELINE_DEPTH": 4,
+                     "SENTINEL_FRONTEND_BATCH": 999999},  # above clamp
+        score={"decisions_per_s": 1200.0, "p99_ms": 8.0},
+        baseline={"decisions_per_s": 1000.0, "p99_ms": 9.0},
+        slo_p99_ms=50.0, workload={"name": "steady", "seed": 11},
+        trials=12, parity_checks=3)
+    assert doc["knobs"]["SENTINEL_FRONTEND_BATCH"] == 1 << 16  # clamped
+    back = art_mod.load_tuned(p)
+    assert back == doc
+    assert art_mod.overrides_for(back, fp) == doc["knobs"]
+
+
+def test_load_tuned_rejects_bad_schema_and_unknown_knobs(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps({"schema": "something/9", "knobs": {}}))
+    with pytest.raises(ValueError, match="schema"):
+        art_mod.load_tuned(str(p))
+    p.write_text(json.dumps({"schema": art_mod.SCHEMA,
+                             "knobs": {"SENTINEL_EVIL": 1},
+                             "fingerprint": {}}))
+    with pytest.raises(ValueError, match="SENTINEL_EVIL"):
+        art_mod.load_tuned(str(p))
+
+
+def test_fingerprint_mismatch_falls_back_to_defaults(tmp_path):
+    p = str(tmp_path / "TUNED.json")
+    fp = art_mod.fingerprint()
+    wrong = dict(fp, device_kind="TPU v9")
+    art_mod.save_tuned(
+        p, fingerprint=wrong,
+        knob_values={"SENTINEL_PIPELINE_DEPTH": 8},
+        score={}, baseline={}, slo_p99_ms=50.0, workload={}, trials=1,
+        parity_checks=1)
+    doc = art_mod.load_tuned(p)
+    assert art_mod.overrides_for(doc, fp) is None
+    overrides, events = art_mod.resolve_startup(
+        environ={art_mod.TUNED_CONFIG_ENV: p})
+    assert overrides == {}
+    keys = [k for k, _ in events]
+    assert obs_keys.TUNE_FALLBACK in keys
+    prov = art_mod.provenance(environ={art_mod.TUNED_CONFIG_ENV: p})
+    assert prov["tuned"] is False and "device_kind" in str(
+        prov["fingerprint_mismatch"])
+
+
+def test_env_beats_artifact_per_knob(tmp_path):
+    p = str(tmp_path / "TUNED.json")
+    art_mod.save_tuned(
+        p, fingerprint=art_mod.fingerprint(),
+        knob_values={"SENTINEL_PIPELINE_DEPTH": 8,
+                     "SENTINEL_FRONTEND_BATCH": 128},
+        score={}, baseline={}, slo_p99_ms=50.0, workload={}, trials=1,
+        parity_checks=1)
+    overrides, events = art_mod.resolve_startup(environ={
+        art_mod.TUNED_CONFIG_ENV: p,
+        "SENTINEL_PIPELINE_DEPTH": "2",      # operator pin: env wins
+    })
+    assert overrides == {"SENTINEL_FRONTEND_BATCH": 128}
+    assert obs_keys.TUNE_LOADED in [k for k, _ in events]
+
+
+def test_sentinel_startup_loads_and_falls_back(tmp_path, monkeypatch):
+    """End to end at construction: a matching artifact fills _tuned and
+    ticks tune.config_loaded; a mismatched one leaves defaults and ticks
+    tune.fingerprint_fallback."""
+    good = str(tmp_path / "good.json")
+    art_mod.save_tuned(
+        good, fingerprint=art_mod.fingerprint(),
+        knob_values={"SENTINEL_PIPELINE_DEPTH": 4},
+        score={}, baseline={}, slo_p99_ms=50.0, workload={}, trials=1,
+        parity_checks=1)
+    monkeypatch.setenv(art_mod.TUNED_CONFIG_ENV, good)
+    sph = make_sph(ManualClock(start_ms=T0))
+    try:
+        assert sph._tuned == {"SENTINEL_PIPELINE_DEPTH": 4}
+        assert sph.obs.counters.get(obs_keys.TUNE_LOADED) == 1
+        from sentinel_tpu.serving import DispatchPipeline
+        assert DispatchPipeline(sph).depth == 4
+    finally:
+        sph.close()
+
+    bad = str(tmp_path / "bad.json")
+    doc = json.loads(open(good).read())
+    doc["fingerprint"]["host_cores"] = 10_000
+    open(bad, "w").write(json.dumps(doc))
+    monkeypatch.setenv(art_mod.TUNED_CONFIG_ENV, bad)
+    sph = make_sph(ManualClock(start_ms=T0))
+    try:
+        assert sph._tuned == {}
+        assert sph.obs.counters.get(obs_keys.TUNE_FALLBACK) == 1
+        from sentinel_tpu.serving import DispatchPipeline
+        from sentinel_tpu.runtime import pipeline_depth
+        assert DispatchPipeline(sph).depth == pipeline_depth()
+    finally:
+        sph.close()
+
+
+def test_frontend_kwarg_precedence(tmp_path, monkeypatch):
+    """kwarg > env > artifact for Sentinel.frontend()'s batcher knobs."""
+    p = str(tmp_path / "TUNED.json")
+    art_mod.save_tuned(
+        p, fingerprint=art_mod.fingerprint(),
+        knob_values={"SENTINEL_FRONTEND_BATCH": 128,
+                     "SENTINEL_FRONTEND_DEADLINE_MS": 40,
+                     "SENTINEL_FRONTEND_BUDGET_MS": 5},
+        score={}, baseline={}, slo_p99_ms=50.0, workload={}, trials=1,
+        parity_checks=1)
+    monkeypatch.setenv(art_mod.TUNED_CONFIG_ENV, p)
+    monkeypatch.setenv("SENTINEL_FRONTEND_DEADLINE_MS", "15")  # env pin
+    sph = make_sph(ManualClock(start_ms=T0))
+    try:
+        fe = sph.frontend(budget_ms=7)       # explicit kwarg pin
+        try:
+            assert fe.batch_max == 128       # artifact (unset elsewhere)
+            assert fe.deadline_ms == 15      # env beats artifact
+            assert fe.budget_ms == 7         # kwarg beats both
+        finally:
+            fe.close()
+    finally:
+        sph.close()
+
+
+# ---------------------------------------------------------- env validation
+
+def test_validate_environ_findings():
+    warns = knobs_mod.validate_environ({
+        "SENTINEL_FRONTEND_BATHC": "512",        # typo → did-you-mean
+        "SENTINEL_PIPELINE_DEPTH": "999",        # out of [1, 64]
+        "SENTINEL_DONATE": "nope",               # non-canonical bool
+        "SENTINEL_TRACE_SAMPLE": "abc",          # operational, bad float
+        "SENTINEL_FRONTEND_BATCH": "256",        # fine → silent
+        "SENTINEL_OBS_DISABLE": "1",             # operational → silent
+        "UNRELATED": "x",                        # not SENTINEL_ → ignored
+    })
+    assert len(warns) == 4
+    joined = "\n".join(warns)
+    assert "did you mean SENTINEL_FRONTEND_BATCH?" in joined
+    assert "SENTINEL_PIPELINE_DEPTH" in joined and "[1, 64]" in joined
+    assert "boolean spelling" in joined
+    assert "SENTINEL_TRACE_SAMPLE" in joined
+
+
+def test_startup_warns_on_bad_env_knob(monkeypatch):
+    monkeypatch.setenv("SENTINEL_FRONTEND_DEADLINE_MS", "0")  # below clamp
+    sph = make_sph(ManualClock(start_ms=T0))
+    try:
+        assert sph.obs.counters.get(obs_keys.TUNE_KNOB_REJECTED) >= 1
+    finally:
+        sph.close()
+
+
+# ------------------------------------------------------------- anti-drift
+
+def test_registry_matches_runtime_clamps(monkeypatch):
+    """Every KnobSpec's parse() must agree with the real read-site helper
+    under extreme env values — the registry can't silently drift."""
+    from sentinel_tpu.frontend.batcher import (
+        frontend_batch_max, frontend_budget_ms, frontend_deadline_ms,
+        frontend_idle_ms,
+    )
+    from sentinel_tpu.ops.sortfree import chunk_size, table_bits
+    from sentinel_tpu.runtime import (
+        donation_enabled, host_staging_enabled, pipeline_depth,
+        sortfree_enabled,
+    )
+    numeric = {
+        "SENTINEL_PIPELINE_DEPTH": pipeline_depth,
+        "SENTINEL_FRONTEND_BATCH": frontend_batch_max,
+        "SENTINEL_FRONTEND_DEADLINE_MS": frontend_deadline_ms,
+        "SENTINEL_FRONTEND_BUDGET_MS": frontend_budget_ms,
+        "SENTINEL_FRONTEND_IDLE_MS": frontend_idle_ms,
+        "SENTINEL_SORTFREE_BITS": lambda: table_bits(4096),
+        "SENTINEL_SORTFREE_CHUNK": chunk_size,
+    }
+    for env, helper in numeric.items():
+        spec = knobs_mod.KNOB_BY_ENV[env]
+        for raw in ("-1000000", "0", "3", "999999999"):
+            monkeypatch.setenv(env, raw)
+            expect, _ok = spec.parse(raw)
+            if env == "SENTINEL_SORTFREE_BITS" and raw == "0":
+                # table_bits clamps the override to >= 1, spec agrees
+                expect = 1
+            assert helper() == expect, (env, raw)
+        monkeypatch.delenv(env)
+        if spec.default is not None:
+            assert helper() == spec.default, env
+    booleans = {
+        "SENTINEL_DONATE": donation_enabled,
+        "SENTINEL_HOST_STAGING": host_staging_enabled,
+        "SENTINEL_SORTFREE": sortfree_enabled,
+    }
+    for env, helper in booleans.items():
+        spec = knobs_mod.KNOB_BY_ENV[env]
+        for raw in ("0", "off", "FALSE", "1", "on", "weird"):
+            monkeypatch.setenv(env, raw)
+            expect, _ok = spec.parse(raw)
+            assert helper() == expect, (env, raw)
+        monkeypatch.delenv(env)
+        assert helper() == spec.default, env
+
+
+def test_env_overrides_context_restores():
+    import os
+    key = "SENTINEL_PIPELINE_DEPTH"
+    assert key not in os.environ
+    with knobs_mod.env_overrides({key: 7, "SENTINEL_DONATE": False}):
+        assert os.environ[key] == "7"
+        assert os.environ["SENTINEL_DONATE"] == "0"
+    assert key not in os.environ and "SENTINEL_DONATE" not in os.environ
