@@ -154,6 +154,25 @@ def measure_serving(jax) -> dict:
         name: {"n": len(v),
                "mean": round(statistics.fmean(v) / 1000, 1)}
         for name, v in sorted(stages.items())}
+
+    # round 12 — telemetry overhead for the artifact trail: the cost of
+    # ONE hot-resource telemetry tick + readback (obs/telemetry.py)
+    # against the serving step it rides beside at 1 Hz; the enforced
+    # on/off step-time ratio lives in ci_gate gate (k)
+    telem = getattr(sph, "telemetry", None)
+    if telem is not None and telem.enabled:
+        telem.poll()                             # compile the tick once
+        t0 = time.perf_counter()
+        for _ in range(10):
+            telem.poll()
+        tick_ms = (time.perf_counter() - t0) / 10 * 1000
+        out["telemetry"] = {
+            "k": telem.k,
+            "tick_ms": round(tick_ms, 3),
+            "tick_vs_sync_step": round(
+                tick_ms / out["sync_step_ms"], 4) if out["sync_step_ms"]
+                else None,
+        }
     sph.close()
     return out
 
@@ -411,6 +430,7 @@ def main() -> None:
             "SENTINEL_FRONTEND_IDLE_MS", "SENTINEL_FRONTEND_QUEUE",
             "SENTINEL_SORTFREE", "SENTINEL_SORTFREE_BITS",
             "SENTINEL_SORTFREE_CHUNK", "SENTINEL_TUNED_CONFIG",
+            "SENTINEL_TELEMETRY_K", "SENTINEL_TELEMETRY_DISABLE",
         ) if k in os.environ},
         # round 11 — tuned-config provenance: whether a
         # SENTINEL_TUNED_CONFIG artifact applied to this run (fingerprint

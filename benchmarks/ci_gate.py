@@ -103,6 +103,18 @@ bit-identical verdicts below the batcher and ≥ ``TUNE_MIN_RATIO`` of
 the default config's throughput through the full serving replay.
 ``CI_GATE_TUNE=0`` skips. See the comment block above
 ``TUNE_ENV_FLAG``.
+
+Gate (k) — the hot-resource telemetry gate (r12): a planted-hot-key
+Zipf mix through the FULL serving path (engine + ``start_transport`` +
+dashboard server) must surface the planted keys in ``/obs/topk.json``
+(hottest planted key ranked FIRST — the sharded top-K is exact, not
+approximate) AND in the ``<app>-metric`` log read back through
+``MetricSearcher``, with a non-empty per-second timeline; and the obs
+overhead probe re-run with the telemetry ticker ON (5 Hz, harsher than
+the production 1 Hz) must stay inside the same fixed
+``OBS_OVERHEAD_MAX`` band — telemetry must not cost what obs/ saved.
+``CI_GATE_TELEMETRY=0`` skips. See the comment block above
+``TELEMETRY_ENV_FLAG``.
 """
 
 from __future__ import annotations
@@ -1240,6 +1252,156 @@ def measure_tune() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+# Gate (k) — the hot-resource telemetry gate (r12). Two halves:
+#   surface:  a planted-hot-key Zipf mix through the FULL serving path
+#             (real Sentinel + start_transport + the dashboard server)
+#             must surface the planted keys in the dashboard's
+#             /obs/topk.json proxy of the agent's ``topk`` command AND
+#             in the <app>-metric log the telemetry writer rides
+#             (metrics/searcher.py read-back). Binary: the whole
+#             device-tick → async-readback → transport → dashboard
+#             chain either works or the gate fails.
+#   overhead: the obs-overhead probe re-run with the telemetry TICKER
+#             running on the instrumented engine (device tick + async
+#             readback overlapped with the dispatch loop) — the
+#             instrumented/uninstrumented step-time ratio must stay
+#             inside the SAME fixed band (OBS_OVERHEAD_MAX, 1.02):
+#             telemetry must not cost what obs/ saved. Machine speed
+#             cancels in the ratio.
+# CI_GATE_TELEMETRY=0 skips the whole gate.
+TELEMETRY_ENV_FLAG = "CI_GATE_TELEMETRY"
+
+
+def measure_telemetry() -> dict:
+    import tempfile
+    import time as _time
+    import urllib.request
+
+    import numpy as np
+
+    sys.path.insert(0, str(HERE.parent))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import sentinel_tpu as stpu
+    from sentinel_tpu.core.clock import ManualClock
+    from sentinel_tpu.dashboard import Dashboard
+    from sentinel_tpu.dashboard.server import DashboardServer
+    from sentinel_tpu.metrics.searcher import MetricSearcher
+    from sentinel_tpu.obs import OBS_DISABLE_ENV
+    from sentinel_tpu.transport import start_transport
+
+    T0 = 1_785_000_000_000
+    out: dict = {}
+
+    # ---- surface half: planted hot keys end to end -------------------
+    tmp = tempfile.mkdtemp(prefix="sentinel-telemetry-gate-")
+    clk = ManualClock(start_ms=T0)
+    sph = stpu.Sentinel(stpu.load_config(
+        max_resources=64, max_flow_rules=16, max_degrade_rules=16,
+        max_authority_rules=16, host_fast_path=False,
+        metric_log_dir=tmp), clock=clk)
+    rt = start_transport(sph, host="127.0.0.1", port=0)
+    dash = DashboardServer(Dashboard(password="", clock=clk,
+                                     agent_timeout_s=30.0),
+                           host="127.0.0.1", port=0)
+    dport = dash.start(fetch=False)
+    try:
+        # drive LATE in the wall second so the traffic is still inside
+        # the rolling window when the completed second lands
+        clk.advance_ms(600)
+        rng = np.random.default_rng(12)
+        for z in rng.zipf(1.4, size=200):       # Zipf background
+            try:
+                sph.entry(f"bg-{min(int(z) - 1, 24)}").exit()
+            except stpu.BlockException:
+                pass
+        for name, n in (("planted-hot-a", 120), ("planted-hot-b", 60)):
+            for _ in range(n):
+                sph.entry(name).exit()
+        clk.advance_ms(500)                     # completes second T0/1000
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{dport}/obs/topk.json"
+                f"?ip=127.0.0.1&port={rt.port}&tick=1",
+                timeout=30) as r:
+            body = json.loads(r.read().decode("utf-8"))
+        data = body.get("data") or {}
+        hot_names = [h["resource"] for h in data.get("hot", [])]
+        out["topk_top3"] = hot_names[:3]
+        out["planted_in_topk"] = (
+            body.get("success", False)
+            and "planted-hot-a" in hot_names
+            and "planted-hot-b" in hot_names)
+        out["planted_rank1"] = bool(hot_names
+                                    and hot_names[0] == "planted-hot-a")
+        out["timeline_len"] = len(data.get("timeline", []))
+        out["drops"] = data.get("drops", -1)
+        out["knobs"] = {"k": data.get("k"),
+                        "n_shards": data.get("n_shards")}
+        seen = {n.resource for n in MetricSearcher(
+            tmp, sph.telemetry.base_name).find(T0 - 1000, T0 + 10_000)}
+        out["metric_log_resources"] = len(seen)
+        out["planted_in_metric_log"] = "planted-hot-a" in seen
+    finally:
+        dash.stop()
+        rt.stop()
+        sph.close()
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # ---- overhead half: obs-overhead probe, telemetry ticker ON ------
+    def build(disable_obs: bool):
+        prev = os.environ.get(OBS_DISABLE_ENV)
+        if disable_obs:
+            os.environ[OBS_DISABLE_ENV] = "1"
+        else:
+            os.environ.pop(OBS_DISABLE_ENV, None)
+        try:
+            s = stpu.Sentinel(stpu.load_config(
+                max_resources=64, max_origins=32, max_flow_rules=32,
+                max_degrade_rules=16, max_authority_rules=16,
+                host_fast_path=False))
+        finally:
+            if prev is None:
+                os.environ.pop(OBS_DISABLE_ENV, None)
+            else:
+                os.environ[OBS_DISABLE_ENV] = prev
+        s.load_flow_rules([
+            stpu.FlowRule(resource="api", count=1e9),
+            stpu.FlowRule(resource="api", count=1e9, limit_app="app-a"),
+        ])
+        return s
+
+    B, STEPS, REPEATS = 8192, 6, 8
+    rng = np.random.default_rng(11)
+    resources = ["api"] * B
+    origins = ["app-a" if x else "" for x in (rng.random(B) < 0.1)]
+    pair = [("on", build(False)), ("off", build(True))]
+    assert pair[0][1].telemetry.enabled
+    assert not pair[1][1].obs.enabled
+    # 5 Hz — HARSHER than the production 1 Hz cadence, so the band holds
+    # margin: the tick's brief engine-lock hold and the async readback
+    # both overlap the timed dispatch loop several times per region
+    pair[0][1].telemetry.start(interval_sec=0.2)
+    best: dict = {}
+    for _key, s in pair:                    # warm compiles + caches
+        for _ in range(2):
+            s.entry_batch_nowait(resources, origins=origins).result()
+    for rep in range(REPEATS):
+        for key, s in (pair if rep % 2 == 0 else pair[::-1]):
+            t0 = _time.perf_counter()
+            for _ in range(STEPS):
+                s.entry_batch_nowait(resources, origins=origins).result()
+            dt = (_time.perf_counter() - t0) / STEPS
+            best[key] = min(best.get(key, dt), dt)
+    out["telemetry_ticks"] = pair[0][1].telemetry.snapshot()["ticks"]
+    for _key, s in pair:
+        s.close()
+    out["telemetry_on_s_per_step"] = best["on"]
+    out["telemetry_off_s_per_step"] = best["off"]
+    out["telemetry_overhead_ratio"] = best["on"] / best["off"]
+    return out
+
+
 def main() -> int:
     best = max(measure_once() for _ in range(3))
     cal = calibrate()
@@ -1256,6 +1418,9 @@ def main() -> int:
                 if os.environ.get(SORTFREE_ENV_FLAG, "1") != "0" else None)
     tune = (measure_tune()
             if os.environ.get(TUNE_ENV_FLAG, "1") != "0" else None)
+    telemetry = (measure_telemetry()
+                 if os.environ.get(TELEMETRY_ENV_FLAG, "1") != "0"
+                 else None)
     ratios = {k.replace("_s_per_step", "_ratio"): v / cal
               for k, v in prep.items()}
     if "--update" in sys.argv:
@@ -1293,6 +1458,12 @@ def main() -> int:
              "tune": ({k: (round(v, 4) if isinstance(v, float) else v)
                        for k, v in tune.items()}
                       if tune is not None else None),
+             # informational: gate (k) is binary (surface) plus the
+             # fixed OBS_OVERHEAD_MAX band, not re-baselined per machine
+             "telemetry": ({k: (round(v, 6) if isinstance(v, float)
+                                else v)
+                            for k, v in telemetry.items()}
+                           if telemetry is not None else None),
              "calibration_s": cal}, indent=1))
         print(f"baseline updated: floor={best / 2:.0f} (measured {best:.0f}) "
               f"on {fingerprint()}; host-prep ratios "
@@ -1325,6 +1496,9 @@ def main() -> int:
         "tune": ({k: (round(v, 4) if isinstance(v, float) else v)
                   for k, v in tune.items()}
                  if tune is not None else "skipped"),
+        "telemetry": ({k: (round(v, 6) if isinstance(v, float) else v)
+                       for k, v in telemetry.items()}
+                      if telemetry is not None else "skipped"),
     }
     print(json.dumps(out))
     rc = 0
@@ -1466,6 +1640,43 @@ def main() -> int:
                   f"pinned winner loses to the defaults it beat during "
                   f"search; the obs-sourced scoring or the artifact "
                   f"application path regressed", file=sys.stderr)
+            rc = 1
+    if telemetry is not None:
+        if not telemetry["planted_in_topk"]:
+            print(f"TELEMETRY-GATE REGRESSION: planted hot keys missing "
+                  f"from /obs/topk.json (top3={telemetry['topk_top3']}) "
+                  f"— the device top-K → async readback → topk command "
+                  f"→ dashboard chain is broken somewhere",
+                  file=sys.stderr)
+            rc = 1
+        elif not telemetry["planted_rank1"]:
+            print(f"TELEMETRY-GATE REGRESSION: the hottest planted key "
+                  f"is not ranked first (top3={telemetry['topk_top3']}) "
+                  f"— the sharded top-K merge ordering regressed",
+                  file=sys.stderr)
+            rc = 1
+        if not telemetry["planted_in_metric_log"]:
+            print(f"TELEMETRY-GATE REGRESSION: planted hot keys never "
+                  f"reached the <app>-metric log "
+                  f"({telemetry['metric_log_resources']} resources read "
+                  f"back) — the per-second persistence ride on the "
+                  f"metric writer/searcher is dead", file=sys.stderr)
+            rc = 1
+        if telemetry["timeline_len"] == 0:
+            print("TELEMETRY-GATE REGRESSION: the per-second timeline "
+                  "ring surfaced zero completed seconds through the "
+                  "dashboard probe — the device ring append or its "
+                  "readback is dead", file=sys.stderr)
+            rc = 1
+        tratio = telemetry["telemetry_overhead_ratio"]
+        if tratio > OBS_OVERHEAD_MAX:
+            print(f"TELEMETRY-OVERHEAD REGRESSION: instrumented/"
+                  f"uninstrumented step-time ratio {tratio:.4f} > "
+                  f"{OBS_OVERHEAD_MAX} with the telemetry ticker ON "
+                  f"(5 Hz probe cadence) — the telemetry tick is "
+                  f"leaking cost into the dispatch path (lock hold too "
+                  f"long, a sync readback, or per-tick recompiles)",
+                  file=sys.stderr)
             rc = 1
     if trace["pinned_records"] == 0 or "deadline_miss" not in trace["kinds"]:
         print(f"TRACE-CAPTURE REGRESSION: {trace['induced_misses']} induced "

@@ -55,6 +55,7 @@ KNOB_ENVS = (
     "SENTINEL_FRONTEND_QUEUE",
     "SENTINEL_SORTFREE", "SENTINEL_SORTFREE_BITS", "SENTINEL_SORTFREE_CHUNK",
     "SENTINEL_TUNED_CONFIG",
+    "SENTINEL_TELEMETRY_K", "SENTINEL_TELEMETRY_DISABLE",
     "SERVING_DURATION_MS", "SERVING_RATE", "SERVING_SEED",
 )
 
@@ -136,6 +137,13 @@ def run_workload(name: str, *, seed: int = DEFAULT_SEED,
     _warm(sph, batch_max, reqs[0].resource if reqs else "warm/0")
     sph.obs.counters.clear()
     sph.obs.hist_request.clear()
+    # round 12 — the hot-resource telemetry ticker rides the replay at
+    # its production 1 Hz cadence (obs/telemetry.py); health + hot view
+    # land in the artifact below, the on/off overhead ratio is gated by
+    # ci_gate gate (k)
+    telem = getattr(sph, "telemetry", None)
+    if telem is not None and telem.enabled:
+        telem.start(interval_sec=1.0)
 
     lat = LogHistogram()
     stats = {"shed": 0, "allowed": 0, "blocked": 0, "deadline_miss": 0}
@@ -208,6 +216,14 @@ def run_workload(name: str, *, seed: int = DEFAULT_SEED,
         "decisions_per_s": (sph.obs.hist_request.count
                             / (duration_ms / 1e3) if duration_ms else 0.0),
     }
+    if telem is not None and telem.enabled:
+        telem.poll()                     # land anything still in flight
+        tsnap = telem.snapshot()
+        out["telemetry"] = {
+            "k": tsnap["k"], "ticks": tsnap["ticks"],
+            "drops": tsnap["drops"],
+            "hot": [h["resource"] for h in tsnap["hot"][:8]],
+        }
     # worst-request trace dump: the slowest request's causal chain as a
     # Chrome-trace document (load serving_bench.json, pull
     # workloads.<name>.worst_request.trace into ui.perfetto.dev) — must

@@ -116,6 +116,18 @@ class SentinelApiClient:
             params["trace"] = trace
         return json.loads(self._get(ip, port, "obs", params) or "{}")
 
+    def fetch_topk(self, ip: str, port: int,
+                   timeline: int = 60, tick: bool = False) -> Dict[str, Any]:
+        """Hot-resource telemetry snapshot (``topk`` command —
+        obs/telemetry.py): current top-K by rolling pass+block QPS plus
+        the per-second engine-wide timeline. ``tick=True`` forces one
+        device tick + readback first (operator poke when the background
+        ticker is off)."""
+        params = {"timeline": str(timeline)}
+        if tick:
+            params["tick"] = "1"
+        return json.loads(self._get(ip, port, "topk", params) or "{}")
+
     def fetch_trace(self, ip: str, port: int,
                     trace_id: str = "") -> Dict[str, Any]:
         """Request-scoped trace export (``trace`` command): with an id, a

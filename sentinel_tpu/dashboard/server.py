@@ -501,6 +501,18 @@ class _Handler(BaseHTTPRequestHandler):
             except AgentUnreachable as exc:
                 self._json(_fail(str(exc)))
             return
+        if method == "GET" and path == "/obs/topk.json":
+            # hot-resource telemetry: device-side sharded top-K + the
+            # per-second timeline ring (obs/telemetry.py via the agent's
+            # ``topk`` command)
+            try:
+                self._json(_ok(d.client.fetch_topk(
+                    q.get("ip", ""), int(q.get("port", "0") or 0),
+                    timeline=int(q.get("timeline", "60") or 60),
+                    tick=q.get("tick", "") in ("1", "true"))))
+            except AgentUnreachable as exc:
+                self._json(_fail(str(exc)))
+            return
         if method == "GET" and path == "/obs/traces.json":
             # request-scoped tracing: ?id= proxies one causal chain as a
             # Chrome-trace-event document; without id, the flight

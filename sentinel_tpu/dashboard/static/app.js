@@ -84,6 +84,7 @@ const VIEW_TITLES = {
   metrics: "Realtime Metrics", resources: "Resource View",
   machines: "Machine List", cluster: "Cluster Management",
   tree: "Node Tree", telemetry: "Runtime Telemetry",
+  hotkeys: "Hot Resources",
   flow: "Flow Rules", degrade: "Degrade Rules", paramFlow: "Param Flow Rules",
   system: "System Rules", authority: "Authority Rules",
   gatewayFlow: "Gateway Flow Rules", gatewayApi: "API Definitions",
@@ -128,6 +129,7 @@ function renderSidebar() {
   }
   const menu = [["metrics", "Realtime Metrics"], ["resources", "Resource View"],
                 ["tree", "Node Tree"], ["telemetry", "Telemetry"],
+                ["hotkeys", "Hot Resources"],
                 ["machines", "Machine List"], ["cluster", "Cluster"]];
   navEl.appendChild(h("h4", {}, "Monitor"));
   for (const [v, label] of menu) {
@@ -156,6 +158,7 @@ function render() {
   if (S.view === "cluster") return viewCluster(c);
   if (S.view === "tree") return viewTree(c);
   if (S.view === "telemetry") return viewTelemetry(c);
+  if (S.view === "hotkeys") return viewHotKeys(c);
   return viewRules(c, S.view);
 }
 
@@ -518,6 +521,90 @@ async function viewTelemetry(c) {
               h("td", { class: "num" }, String(e.count)),
             ])))])
         : h("span", { class: "dim" }, "no sampled block events yet"),
+    ]));
+  }
+  await refresh();
+  setRefresh(refresh, 5000);
+}
+
+// ------------------------------------------------------------------ hot keys
+// Device-resident hot-resource telemetry (agent `topk` command →
+// /obs/topk.json): sharded top-K by rolling pass+block QPS + the
+// engine-wide per-second timeline ring (obs/telemetry.py).
+async function viewHotKeys(c) {
+  await loadMachines();
+  const sel = machineSelector(() => refresh());
+  const body = h("div", {});
+  c.appendChild(h("div", { class: "card" }, [
+    h("h3", {}, [h("span", {}, `Hot Resources — ${S.app}`),
+                 h("span", { class: "toolbar" }, [
+                   h("span", { class: "sub" }, "machine"), sel])]),
+    body,
+  ]));
+  async function refresh() {
+    if (!S.machineSel) {
+      body.innerHTML = "";
+      body.appendChild(h("span", { class: "dim" }, "no healthy machine"));
+      return;
+    }
+    const [ip, port] = S.machineSel.split(":");
+    const j = await api(`/obs/topk.json?ip=${ip}&port=${port}&timeline=60`);
+    body.innerHTML = "";
+    if (!j || !j.success) {
+      body.appendChild(h("span", { class: "bad" }, j ? j.msg : "error"));
+      return;
+    }
+    const d = j.data || {};
+    if (!d.enabled) {
+      body.appendChild(h("span", { class: "dim" },
+        "telemetry disabled on this agent (SENTINEL_TELEMETRY_DISABLE " +
+        "or SENTINEL_OBS_DISABLE)"));
+      return;
+    }
+    body.appendChild(h("span", { class: "sub" },
+      `k=${d.k} · ${d.n_shards} shard(s) × ${d.rows_per_shard} rows · ` +
+      `ticks ${d.ticks} · readback drops ${d.drops}`));
+    const hot = d.hot || [];
+    body.appendChild(h("div", { class: "card" }, [
+      h("h3", {}, [h("span", {}, "Top-K by rolling QPS"),
+        h("span", { class: "sub" },
+          "device-side lax.top_k merged across row shards (exact)")]),
+      hot.length
+        ? h("table", {}, [h("thead", {}, h("tr", {},
+            ["resource", "row", "qps", "load", "pass", "block", "success",
+             "exception"].map(t => h("th", {}, t)))),
+            h("tbody", {}, hot.map(r => h("tr", {}, [
+              h("td", {}, r.resource),
+              h("td", { class: "num" }, String(r.row)),
+              h("td", { class: "num" }, String(r.qps)),
+              h("td", { class: "num" }, String(r.load)),
+              h("td", { class: "num" }, String(r.pass)),
+              h("td", { class: "num" }, String(r.block)),
+              h("td", { class: "num" }, String(r.success)),
+              h("td", { class: "num" }, String(r.exception)),
+            ])))])
+        : h("span", { class: "dim" }, "no hot resources yet"),
+    ]));
+    const tl = d.timeline || [];
+    body.appendChild(h("div", { class: "card" }, [
+      h("h3", {}, [h("span", {}, "Per-second timeline"),
+        h("span", { class: "sub" },
+          "engine-wide aggregates from the device ring buffer " +
+          "(newest last)")]),
+      tl.length
+        ? h("table", {}, [h("thead", {}, h("tr", {},
+            ["time", "pass", "block", "success", "exception",
+             "occupied", "rt sum (ms)"].map(t => h("th", {}, t)))),
+            h("tbody", {}, tl.slice(-30).map(e => h("tr", {}, [
+              h("td", {}, new Date(e.sec * 1000).toTimeString().slice(0, 8)),
+              h("td", { class: "num" }, String(e.pass)),
+              h("td", { class: "num" }, String(e.block)),
+              h("td", { class: "num" }, String(e.success)),
+              h("td", { class: "num" }, String(e.exception)),
+              h("td", { class: "num" }, String(e.occupied_pass)),
+              h("td", { class: "num" }, Number(e.rt_sum).toFixed(1)),
+            ])))])
+        : h("span", { class: "dim" }, "no timeline seconds yet"),
     ]));
   }
   await refresh();

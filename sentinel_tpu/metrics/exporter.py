@@ -39,6 +39,18 @@ Self-telemetry families (from ``Sentinel.obs`` — obs/; absent while
     sentinel_tune_total{event=...}         autotuner lifecycle: config_loaded/
                                            fingerprint_fallback/knob_rejected/
                                            trial/parity_fail
+    sentinel_resource_qps{resource=...}    hot-resource rolling QPS — top-K
+                                           labels ONLY (obs/telemetry.py)
+    sentinel_telemetry_total{event=...}    telemetry health: tick/readback_drop
+    sentinel_exporter_label_overflow_total samples dropped at the label cap
+
+Label-cardinality guard: the per-resource gauge families cap the number
+of distinct ``resource`` label values per scrape
+(:data:`LABEL_CARDINALITY_CAP`, constructor-overridable). Beyond the cap
+the hottest rows (by pass+block) win, the rest are dropped and counted
+(``exporter.label_overflow``) — per-resource labels can never explode
+the scrape, no matter how many resources register. The telemetry family
+is bounded by construction (top-K ≤ 128 labels).
 
 Every key in the fixed counter CATALOG (obs/counters.py) has a family
 here — tests/test_obs.py walks the catalog against the rendered scrape
@@ -54,6 +66,11 @@ from prometheus_client import start_http_server
 from prometheus_client.core import CounterMetricFamily, GaugeMetricFamily
 from prometheus_client.registry import REGISTRY
 
+#: Default per-family cap on distinct ``resource`` label values per
+#: scrape. Prometheus guidance keeps label cardinality in the hundreds;
+#: at 1M registered resources an uncapped scrape would be megabytes.
+LABEL_CARDINALITY_CAP = 512
+
 
 class SentinelCollector:
     """Register with ``prometheus_client``'s registry; each scrape pulls one
@@ -68,9 +85,11 @@ class SentinelCollector:
         ("threads", "concurrency", "Live in-flight count"),
     )
 
-    def __init__(self, sentinel, namespace: str = "sentinel"):
+    def __init__(self, sentinel, namespace: str = "sentinel",
+                 label_cap: int = LABEL_CARDINALITY_CAP):
         self.sentinel = sentinel
         self.namespace = namespace
+        self.label_cap = max(1, int(label_cap))
 
     def describe(self):
         """Static family list so Registry.register doesn't trigger a full
@@ -151,6 +170,20 @@ class SentinelCollector:
             "Autotuner lifecycle: config_loaded / fingerprint_fallback "
             "/ knob_rejected at startup, trial / parity_fail during a "
             "sweep", labels=["event"])
+        res_qps = GaugeMetricFamily(
+            f"{ns}_resource_qps",
+            "Hot-resource rolling pass+block QPS — top-K labels only "
+            "(the device-merged hot set, obs/telemetry.py)",
+            labels=["resource"])
+        telem = CounterMetricFamily(
+            f"{ns}_telemetry",
+            "Hot-resource telemetry health: tick (device reads "
+            "dispatched) / readback_drop (async readback fell behind)",
+            labels=["event"])
+        label_ovf = CounterMetricFamily(
+            f"{ns}_exporter_label_overflow",
+            "Resource-labeled scrape samples dropped at the "
+            "label-cardinality cap")
         if not describe_only and obs is not None and obs.enabled:
             from sentinel_tpu.obs import counters as ck
             counts = obs.counters.snapshot()
@@ -210,9 +243,20 @@ class SentinelCollector:
                             (ck.TUNE_TRIAL, "trial"),
                             (ck.TUNE_PARITY_FAIL, "parity_fail")):
                 tune.add_metric([ev], counts.get(key, 0))
+            for key, ev in ((ck.TELEMETRY_TICK, "tick"),
+                            (ck.TELEMETRY_DROP, "readback_drop")):
+                telem.add_metric([ev], counts.get(key, 0))
+            label_ovf.add_metric(
+                [], counts.get(ck.EXPORTER_LABEL_OVERFLOW, 0))
+            # bounded by construction: at most telemetry.k ≤ MAX_K labels
+            telemetry = getattr(self.sentinel, "telemetry", None)
+            if telemetry is not None and telemetry.enabled:
+                for h in telemetry.hot_entries():
+                    res_qps.add_metric([h["resource"]], float(h["qps"]))
         yield from (p99, quant, req_quant, route, hits, misses, retries,
                     blocks, occupy, pipeline, frontend, fe_flush, wraps,
-                    flight_pinned, flight_trig, sf_ovf, tune)
+                    flight_pinned, flight_trig, sf_ovf, tune,
+                    res_qps, telem, label_ovf)
 
     def collect(self):
         ns = self.namespace
@@ -225,6 +269,21 @@ class SentinelCollector:
             labels=["resource"])
 
         totals = self.sentinel.all_node_totals()
+        # label-cardinality guard: never more than label_cap distinct
+        # resource labels per family — keep the hottest rows (pass+block,
+        # name-tiebroken for a deterministic scrape), drop and COUNT the
+        # cold tail (exporter.label_overflow)
+        dropped = len(totals) - self.label_cap
+        if dropped > 0:
+            totals = sorted(
+                totals,
+                key=lambda it: (-(it[2].get("pass", 0)
+                                  + it[2].get("block", 0)), it[0]),
+            )[:self.label_cap]
+            obs = getattr(self.sentinel, "obs", None)
+            if obs is not None:
+                from sentinel_tpu.obs import counters as ck
+                obs.counters.add(ck.EXPORTER_LABEL_OVERFLOW, dropped)
         for name, _row, t in totals:
             for key, fam in gauges.items():
                 fam.add_metric([name], float(t.get(key, 0) or 0))
@@ -249,8 +308,10 @@ class PrometheusExporter:
     ``/metrics`` on its own port (``MetricExporterInit`` analog)."""
 
     def __init__(self, sentinel, *, registry=REGISTRY,
-                 namespace: str = "sentinel"):
-        self.collector = SentinelCollector(sentinel, namespace)
+                 namespace: str = "sentinel",
+                 label_cap: int = LABEL_CARDINALITY_CAP):
+        self.collector = SentinelCollector(sentinel, namespace,
+                                           label_cap=label_cap)
         self.registry = registry
         self._server = None
         registry.register(self.collector)

@@ -1,0 +1,58 @@
+"""Worker for the cluster-wide hot-view merge test (multihost/obs_agg.py
+``aggregate_topk``).
+
+Spawned by :func:`sentinel_tpu.multihost.launch.launch`. Each process
+builds its OWN local engine (independent per-host engines — the ROADMAP
+cluster-health-view topology, not the row-sharded SPMD engine), drives a
+deterministic per-process traffic mix with one process-specific hot key
+plus one key hot on EVERY host, runs one telemetry poll, and joins the
+collective top-K allgather. The coordinator prints one
+``TOPK_JSON:``-prefixed line with the merged hot view —
+``tests/test_multihost.py`` asserts the per-host keys surface and the
+shared key's load is the cross-host sum.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+NOW0 = 10_000_000
+HOT_N = 30        # per-process hot key entries
+SHARED_N = 20     # entries every process sends to the shared key
+COLD_N = 2
+TOPK_K = 8
+
+
+def main(argv) -> int:
+    import sentinel_tpu as stpu
+    from sentinel_tpu import multihost
+    from sentinel_tpu.core.clock import ManualClock
+    from sentinel_tpu.core.errors import BlockException
+    from sentinel_tpu.multihost.obs_agg import aggregate_topk
+
+    with multihost.initialize() as rt:
+        p = rt.process_index
+        cfg = stpu.load_config(max_resources=64, max_flow_rules=16,
+                               max_degrade_rules=16, host_fast_path=False)
+        s = stpu.Sentinel(cfg, clock=ManualClock(start_ms=NOW0))
+        for name, n in ((f"hot-{p}", HOT_N), ("shared-hot", SHARED_N),
+                        (f"cold-{p}", COLD_N)):
+            for _ in range(n):
+                try:
+                    s.entry(name).exit()
+                except BlockException:   # rule-free: never taken
+                    pass
+        s.clock.advance_ms(50)
+        s.telemetry.poll()
+        agg = aggregate_topk(s, k=TOPK_K)
+        agg["local_hot"] = s.telemetry.hot_entries()
+        if p == 0:
+            print("TOPK_JSON:" + json.dumps(agg), flush=True)
+        rt.barrier("topk-done")
+        s.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -13,10 +13,16 @@ renders totals. With one process (tests, reference jobs) the allgather
 degenerates to an identity reshape, so the same code path runs 1-process
 and N-process unchanged.
 
-This is a COLLECTIVE: every process in the mesh must call
-:func:`aggregate_counters` the same number of times, in the same order
-relative to other collectives (the multihost SPMD rule — see
-multihost/ingest.py). Never call it from only the coordinator.
+:func:`aggregate_topk` does the same for the hot-resource telemetry
+layer (obs/telemetry.py): each host's top-K rides one fixed-shape
+allgather (padded utf-8 names + int64 load/pass/block) and merges by
+resource name into a cluster-wide hot view — the first concrete piece of
+the ROADMAP cluster health view.
+
+These are COLLECTIVES: every process in the mesh must call them the same
+number of times, in the same order relative to other collectives (the
+multihost SPMD rule — see multihost/ingest.py). Never call them from
+only the coordinator.
 """
 
 from __future__ import annotations
@@ -63,6 +69,78 @@ def aggregate_counters(sentinel) -> Dict[str, object]:
         "process_index": int(jax.process_index()),
         "per_process": per_process,
         "total": total,
+    }
+
+
+#: Fixed per-entry name payload of the top-K allgather (utf-8, truncated
+#: — wire format like CATALOG: changing it breaks cross-revision merges).
+TOPK_NAME_BYTES = 64
+
+
+def _topk_payload(sentinel, k: int):
+    """This process's hot set as fixed-shape allgather payload:
+    ``(uint8[k, TOPK_NAME_BYTES] names, int64[k, 3] load/pass/block)``,
+    empty slots marked by load == -1."""
+    names = np.zeros((k, TOPK_NAME_BYTES), np.uint8)
+    stats = np.full((k, 3), -1, np.int64)
+    telemetry = getattr(sentinel, "telemetry", None)
+    entries = telemetry.hot_entries(k) if telemetry is not None else []
+    for i, h in enumerate(entries[:k]):
+        raw = h["resource"].encode("utf-8")[:TOPK_NAME_BYTES]
+        names[i, :len(raw)] = np.frombuffer(raw, np.uint8)
+        stats[i] = (h["load"], h["pass"], h["block"])
+    return names, stats
+
+
+def aggregate_topk(sentinel, k: Optional[int] = None) -> Dict[str, object]:
+    """Allgather-merge every host's hot-resource top-K into ONE
+    cluster-wide hot view (collective — call on ALL processes, with the
+    same ``k``; defaults to this engine's ``telemetry.k``, which matches
+    fleet-wide when the knob env is uniform).
+
+    Per-host engines are independent (each serves its own traffic), so
+    the cluster view sums load/pass/block per resource NAME across hosts
+    and re-ranks — a resource hot on several hosts outranks one spiking
+    on a single host. Returns ``{"process_count", "process_index", "k",
+    "hot": [{resource, load, pass, block, hosts}, ...]}`` (top-k,
+    identical on every process)."""
+    import jax
+
+    telemetry = getattr(sentinel, "telemetry", None)
+    if k is None:
+        k = telemetry.k if telemetry is not None else 16
+    k = max(1, int(k))
+    names, stats = _topk_payload(sentinel, k)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        g_names = np.asarray(multihost_utils.process_allgather(
+            names, tiled=False)).reshape(-1, k, TOPK_NAME_BYTES)
+        g_stats = np.asarray(multihost_utils.process_allgather(
+            stats, tiled=False)).reshape(-1, k, 3)
+    else:
+        g_names, g_stats = names[None], stats[None]
+    merged: Dict[str, List[int]] = {}
+    hosts: Dict[str, int] = {}
+    for p in range(g_stats.shape[0]):
+        for i in range(k):
+            load = int(g_stats[p, i, 0])
+            if load < 0:
+                continue
+            raw = bytes(g_names[p, i]).rstrip(b"\x00")
+            name = raw.decode("utf-8", errors="replace")
+            cur = merged.setdefault(name, [0, 0, 0])
+            cur[0] += load
+            cur[1] += int(g_stats[p, i, 1])
+            cur[2] += int(g_stats[p, i, 2])
+            hosts[name] = hosts.get(name, 0) + 1
+    ranked = sorted(merged.items(), key=lambda it: (-it[1][0], it[0]))[:k]
+    return {
+        "process_count": int(g_stats.shape[0]),
+        "process_index": int(jax.process_index()),
+        "k": k,
+        "hot": [{"resource": name, "load": s[0], "pass": s[1],
+                 "block": s[2], "hosts": hosts[name]}
+                for name, s in ranked],
     }
 
 

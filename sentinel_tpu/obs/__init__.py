@@ -18,7 +18,11 @@ Env knobs (read at ``RuntimeObs`` construction):
   deterministic stride, see obs/spans.py);
 * ``SENTINEL_FLIGHT_DISABLE`` / ``SENTINEL_FLIGHT_WINDOW_MS`` /
   ``SENTINEL_FLIGHT_P99_MS`` / ``SENTINEL_FLIGHT_BLOCK_BURST`` — the
-  SLO flight recorder (obs/flight.py).
+  SLO flight recorder (obs/flight.py);
+* ``SENTINEL_TELEMETRY_K`` / ``SENTINEL_TELEMETRY_DISABLE`` — the
+  device-resident hot-resource telemetry layer (obs/telemetry.py,
+  ``Sentinel.telemetry``) — its tick runs on its own thread, not here:
+  RuntimeObs itself stays thread-free.
 
 Surfaces: the Prometheus collector (metrics/exporter.py), the ``obs``
 transport command (transport/handlers.py), the dashboard

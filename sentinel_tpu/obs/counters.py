@@ -52,6 +52,14 @@ dispatch stays within the 2% observability budget (benchmarks/ci_gate.py
   or out-of-clamp ``SENTINEL_*`` env keys found at construction),
   ``trial`` (sweep episodes scored against this engine's obs) and
   ``parity_fail`` (verdict bit-parity spot-check failures).
+* ``telemetry.*`` — the device-resident hot-resource telemetry layer
+  (obs/telemetry.py): ``tick`` (device reads dispatched) and
+  ``readback_drop`` (ticks dropped because async host readback fell
+  behind — the drop-and-count policy that keeps telemetry off the
+  dispatch path).
+* ``exporter.label_overflow`` — Prometheus label-cardinality guard
+  (metrics/exporter.py): resource-labeled samples dropped at the
+  per-family label cap.
 
 :data:`CATALOG` is the fixed, ordered multihost-aggregatable key set:
 every process packs its snapshot into one int64 vector
@@ -129,6 +137,18 @@ TUNE_KNOB_REJECTED = "tune.knob_rejected"
 TUNE_TRIAL = "tune.trial"
 TUNE_PARITY_FAIL = "tune.parity_fail"
 
+# PR 12 — device-resident hot-resource telemetry (obs/telemetry.py):
+# ``tick`` counts telemetry reads dispatched over the live window state,
+# ``readback_drop`` counts ticks skipped because the asynchronous host
+# readback fell PENDING_MAX behind (drop-and-count: the dispatch path is
+# never blocked on a telemetry sync — sustained growth means the
+# telemetry thread is starved). ``label_overflow`` is the exporter's
+# label-cardinality guard (metrics/exporter.py): per-resource label
+# values beyond the cap are dropped from the scrape and counted here.
+TELEMETRY_TICK = "telemetry.tick"
+TELEMETRY_DROP = "telemetry.readback_drop"
+EXPORTER_LABEL_OVERFLOW = "exporter.label_overflow"
+
 #: Fixed aggregation catalog (order is the wire format of the multihost
 #: counter vector — append only, never reorder).
 CATALOG = (
@@ -153,6 +173,7 @@ CATALOG = (
     ROUTE_SORTFREE, SORTFREE_OVERFLOW,
     TUNE_LOADED, TUNE_FALLBACK, TUNE_KNOB_REJECTED,
     TUNE_TRIAL, TUNE_PARITY_FAIL,
+    TELEMETRY_TICK, TELEMETRY_DROP, EXPORTER_LABEL_OVERFLOW,
 )
 
 

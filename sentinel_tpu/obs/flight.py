@@ -112,6 +112,11 @@ class FlightRecorder:
         self._block_n = 0
         self.writer = None
         self.base_name: Optional[str] = None
+        # hot-set pinning (obs/telemetry.py sets this to its flight_hot):
+        # a trigger snapshots the top hot resources AT TRIGGER TIME into
+        # the record, so a pinned SLO-miss chain names what was hot when
+        # it happened. Must be cheap and lock-light (host list copy).
+        self.hot_provider = None
         self._closed = False
 
     # ---- persistence wiring (bootstrap / tests) ----------------------
@@ -153,6 +158,12 @@ class FlightRecorder:
         counters = self._obs.counters
         counters.add(obs_keys.FLIGHT_TRIGGER_PREFIX + kind)
         now_ms = int(self._obs_now_ms())
+        hot: List[Dict] = []
+        if self.hot_provider is not None:
+            try:
+                hot = list(self.hot_provider())
+            except Exception:   # telemetry must not break a pin
+                hot = []
         pinned = 0
         for r in roots:
             causal = spans.causal(r)
@@ -164,6 +175,7 @@ class FlightRecorder:
                 "spans": causal["spans"][:MAX_CHAIN_SPANS],
                 "links": causal["links"],
                 "truncated": len(causal["spans"]) > MAX_CHAIN_SPANS,
+                "hot": hot,
             }
             with self._lock:
                 self._recent.append(rec)

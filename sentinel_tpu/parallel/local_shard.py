@@ -179,6 +179,21 @@ def place_batch(batch, mesh: Mesh):
         batch)
 
 
+def topk_layout(spec: EngineSpec, mesh: Optional[Mesh]):
+    """→ ``(n_shards, rows_per_shard)`` for the telemetry top-K merge
+    (obs/telemetry.py). THE row-ownership contract of the sharded merge:
+    shard ``i`` owns the contiguous global rows
+    ``[i*rows_per_shard, (i+1)*rows_per_shard)`` — exactly how GSPMD
+    partitions a ``P("rows")`` axis-0 sharding — so a local top-k index
+    maps to its global row as ``local + axis_index * rows_per_shard``.
+    Kept here (not in the telemetry module) so the layout can never
+    drift from the state sharding it must mirror."""
+    if mesh is None:
+        return 1, spec.rows
+    n = int(mesh.shape[MESH_AXIS])
+    return n, spec.rows // n
+
+
 def mesh_topology(spec: EngineSpec, mesh: Optional[Mesh],
                   state_sh: Optional[SentinelState] = None) -> dict:
     """Artifact-ready description of the serving layout: device count,

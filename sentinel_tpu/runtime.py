@@ -580,6 +580,15 @@ class Sentinel:
         # exporter, ...): stopped once, LIFO, idempotently
         self._shutdown_hooks: List = []
         self._closed = False
+        # Round 12 — device-resident hot-resource telemetry (obs/
+        # telemetry.py): a jitted tick over the live sharded window state
+        # (per-shard top-K merged device-side + the ENTRY-row per-second
+        # timeline ring) with asynchronous host readback on its own
+        # thread. Constructed here (after the shutdown registry — it
+        # self-registers) but the ticker only starts when the transport
+        # bootstrap (or an operator) calls telemetry.start().
+        from sentinel_tpu.obs.telemetry import HotTelemetry
+        self.telemetry = HotTelemetry(self)
         self.callbacks = StatisticCallbackRegistry()
         # circuit-breaker transition observers (EventObserverRegistry).
         # Event-driven: every decide/exit step that can move breaker state

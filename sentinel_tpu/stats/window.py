@@ -135,6 +135,17 @@ def rolling_totals(spec: WindowSpec, state: WindowState, now_idx: jnp.ndarray) -
     return jnp.sum(jnp.where(mask[:, :, None], state.counters, 0), axis=1)
 
 
+def rolling_load(spec: WindowSpec, state: WindowState,
+                 now_idx: jnp.ndarray) -> jnp.ndarray:
+    """Rolling pass+block total per row → int32[R] — the hot-resource
+    ranking key of the telemetry tick (obs/telemetry.py): one masked
+    sweep over two lanes instead of :func:`rolling_totals`' full event
+    axis when only the ranking is needed."""
+    mask = valid_mask(spec, state.stamps, now_idx)        # [R, B]
+    sub = state.counters[:, :, ev.PASS] + state.counters[:, :, ev.BLOCK]
+    return jnp.sum(jnp.where(mask, sub, 0), axis=1)
+
+
 def rt_totals(spec: WindowSpec, state: WindowState, now_idx: jnp.ndarray) -> jnp.ndarray:
     """RT sum over live buckets for every row → float32[R]."""
     if not spec.track_rt:

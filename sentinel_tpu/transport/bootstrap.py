@@ -79,6 +79,15 @@ def start_transport(sentinel, *, host: str = "0.0.0.0", port: int = 8719,
                                        sentinel.cfg.app_name)
             obs.flight.configure(sentinel.cfg.metric_dir(),
                                  sentinel.cfg.app_name)
+        # hot-resource telemetry (obs/telemetry.py): top-K second lines
+        # ride the same rotation as <app>-metric; the telemetry ticker is
+        # its own thread (device tick + async readback must overlap the
+        # dispatch pipeline, not serialize behind metric_timer.tick())
+        telemetry = getattr(sentinel, "telemetry", None)
+        if telemetry is not None and telemetry.enabled:
+            telemetry.configure(sentinel.cfg.metric_dir(),
+                                sentinel.cfg.app_name)
+            telemetry.start()
     cstate = register_default_handlers(
         center, sentinel, metric_searcher=metric_searcher,
         extra_info=extra, writable_registry=writable_registry,

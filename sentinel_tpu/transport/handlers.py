@@ -279,6 +279,25 @@ def register_default_handlers(
                 return CommandResponse.of_failure("invalid trace id", 400)
         return CommandResponse.of_success(json.dumps(payload))
 
+    def cmd_topk(req: CommandRequest) -> CommandResponse:
+        """Hot-resource telemetry snapshot (obs/telemetry.py): the last
+        drained device top-K (per-resource rolling pass/block/qps) plus
+        the engine-wide per-second timeline tail. Params: ``timeline``
+        (max timeline entries, default 60), ``tick`` (``1`` → run one
+        poll inline first — the pull-only path for agents without the
+        telemetry ticker running)."""
+        telemetry = getattr(s, "telemetry", None)
+        if telemetry is None:
+            return CommandResponse.of_failure("telemetry unavailable", 404)
+        try:
+            timeline_limit = int(req.param("timeline", "60") or 60)
+        except ValueError:
+            return CommandResponse.of_failure("invalid limit", 400)
+        if req.param("tick", "") in ("1", "true"):
+            telemetry.poll()
+        return CommandResponse.of_success(json.dumps(
+            telemetry.snapshot(timeline_limit=timeline_limit)))
+
     def cmd_trace(req: CommandRequest) -> CommandResponse:
         """Request-scoped trace export (docs/OBSERVABILITY.md "Request
         tracing"). Params: ``id`` (a trace id → that chain's causal
@@ -418,6 +437,7 @@ def register_default_handlers(
         ("jsonTree", "node tree (json)", cmd_json_tree),
         ("systemStatus", "system adaptive status", cmd_system_status),
         ("obs", "runtime self-telemetry snapshot", cmd_obs),
+        ("topk", "hot-resource top-K snapshot", cmd_topk),
         ("trace", "causal trace chain as chrome-trace JSON", cmd_trace),
         ("getClusterMode", "get cluster mode", cmd_get_cluster_mode),
         ("setClusterMode", "set cluster mode", cmd_set_cluster_mode),

@@ -163,6 +163,8 @@ OPERATIONAL_ENVS: Dict[str, Optional[type]] = {
     "SENTINEL_FLIGHT_WINDOW_MS": int,
     "SENTINEL_FLIGHT_P99_MS": float,
     "SENTINEL_FLIGHT_BLOCK_BURST": int,
+    "SENTINEL_TELEMETRY_K": int,
+    "SENTINEL_TELEMETRY_DISABLE": None,
     "SENTINEL_FIRST_LOAD_TIMEOUT_S": float,
     "SENTINEL_FIRST_LOAD_RETRIES": int,
     "SENTINEL_COMPILE_CACHE": None,

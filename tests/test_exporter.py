@@ -103,3 +103,57 @@ def test_describe_avoids_collect_on_register(sph):
         assert calls == []          # register used describe(), not collect()
     finally:
         exp.close()
+
+
+def test_label_cardinality_cap_keeps_hottest_and_counts(sph):
+    """PR 12 guard: per-resource label values per scrape never exceed
+    the cap — the hottest rows (pass+block) win, the cold tail is
+    dropped and counted (``exporter.label_overflow``)."""
+    from sentinel_tpu.obs import counters as ck
+
+    registry = CollectorRegistry()
+    exp = PrometheusExporter(sph, registry=registry, label_cap=4)
+    try:
+        for i in range(10):          # r00 coldest … r09 hottest
+            for _ in range(i + 1):
+                with sph.entry(f"r{i:02d}"):
+                    pass
+        text = _scrape(registry)
+        # 11 label candidates (10 resources + the entry aggregate, which
+        # is always hottest): cap=4 keeps entry + r09..r07, drops 7
+        for i in range(7, 10):
+            assert f'sentinel_pass_qps{{resource="r{i:02d}"}}' in text
+        for i in range(0, 7):
+            assert f'sentinel_pass_qps{{resource="r{i:02d}"}}' not in text
+        assert sph.obs.counters.get(ck.EXPORTER_LABEL_OVERFLOW) == 7
+        # the guard's own counter rides the same scrape family
+        assert "sentinel_exporter_label_overflow_total 7.0" in text
+        # second scrape keeps the SAME deterministic hot rows
+        text2 = _scrape(registry)
+        assert 'resource="r09"' in text2 and 'resource="r00"' not in text2
+    finally:
+        exp.close()
+        sph.close()
+
+
+def test_resource_qps_family_is_topk_bounded(sph):
+    """``sentinel_resource_qps`` carries the telemetry hot set — at most
+    ``telemetry.k`` labels no matter how many resources exist."""
+    registry = CollectorRegistry()
+    exp = PrometheusExporter(sph, registry=registry)
+    try:
+        for i in range(30):
+            for _ in range(2 if i else 9):
+                with sph.entry(f"res-{i:02d}"):
+                    pass
+        sph.telemetry.poll()
+        text = _scrape(registry)
+        lines = [ln for ln in text.splitlines()
+                 if ln.startswith("sentinel_resource_qps{")]
+        assert lines and len(lines) <= sph.telemetry.k
+        assert any('resource="res-00"' in ln for ln in lines)
+        # telemetry health family exports the tick count
+        assert "sentinel_telemetry_total{event=\"tick\"} 1.0" in text
+    finally:
+        exp.close()
+        sph.close()

@@ -206,3 +206,48 @@ def test_two_process_parity_with_single_process_8dev():
     # statuses must all be present or the parity proves nothing
     statuses = {d[0] for d in one["decisions"]}
     assert {0, 1, STATUS["bad"], STATUS["no_rule"]} <= statuses
+
+
+# ---------------------------------------------------------------------------
+# cluster-wide hot view: 2-process allgather top-K merge
+# ---------------------------------------------------------------------------
+
+TOPK_ARGV = ["-m", "sentinel_tpu.multihost._topk_worker"]
+
+
+def _topk_payload(num_processes: int, devices_per_process: int) -> dict:
+    results = launch(TOPK_ARGV, num_processes,
+                     devices_per_process=devices_per_process, timeout_s=240)
+    for r in results:
+        for line in r.stdout.splitlines():
+            if line.startswith("TOPK_JSON:"):
+                return json.loads(line.split(":", 1)[1])
+    raise AssertionError(
+        "no TOPK_JSON payload in worker output:\n"
+        + "\n".join(r.stdout + r.stderr for r in results))
+
+
+def test_two_process_topk_merges_cluster_hot_view():
+    """obs_agg.aggregate_topk: each host's device top-K allgathers and
+    merges by name — per-host hot keys surface, and a key hot on BOTH
+    hosts sums its load across them and outranks either single-host
+    key."""
+    from sentinel_tpu.multihost import _topk_worker as w
+
+    agg = _topk_payload(2, 4)
+    assert agg["process_count"] == 2
+    hot = {h["resource"]: h for h in agg["hot"]}
+    # the shared key sums across hosts and ranks first
+    assert agg["hot"][0]["resource"] == "shared-hot"
+    assert hot["shared-hot"]["load"] == 2 * w.SHARED_N
+    assert hot["shared-hot"]["hosts"] == 2
+    # each host's private hot key surfaces in the merged view
+    for p in range(2):
+        assert hot[f"hot-{p}"]["load"] == w.HOT_N
+        assert hot[f"hot-{p}"]["hosts"] == 1
+    # deterministic rank: shared (40) > hot-0 == hot-1 (30, name-tiebrk)
+    names = [h["resource"] for h in agg["hot"]]
+    assert names[:3] == ["shared-hot", "hot-0", "hot-1"]
+    # each worker's LOCAL view saw only its own keys
+    local = {h["resource"] for h in agg["local_hot"]}
+    assert "hot-0" in local and "hot-1" not in local

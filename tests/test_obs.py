@@ -447,18 +447,24 @@ def test_catalog_vector_roundtrip():
     assert ck.vector_counts(longer) == back
 
 
-def test_catalog_is_append_only_with_r11_keys_last():
+def test_catalog_is_append_only_with_r12_keys_last():
     """The multihost allgather aggregates CATALOG by POSITION (prefix
     compatibility with older peers), so the catalog may only ever grow at
-    the tail. Pin the newest (round-11 tune) keys to the end, with the
-    round-10 sortfree and round-9 mesh keys immediately above them — an
-    insertion above any group (or a re-ordering) would silently
-    mis-attribute every counter on a mixed-version fleet."""
-    assert ck.CATALOG[-5:] == (ck.TUNE_LOADED, ck.TUNE_FALLBACK,
-                               ck.TUNE_KNOB_REJECTED, ck.TUNE_TRIAL,
-                               ck.TUNE_PARITY_FAIL)
-    assert ck.CATALOG[-7:-5] == (ck.ROUTE_SORTFREE, ck.SORTFREE_OVERFLOW)
-    assert ck.CATALOG[-9:-7] == (ck.ROUTE_MESHED, ck.PIPE_MESHED)
+    the tail. Pin the newest (round-12 telemetry/exporter) keys to the
+    end, with the round-11 tune, round-10 sortfree and round-9 mesh keys
+    immediately above them — an insertion above any group (or a
+    re-ordering) would silently mis-attribute every counter on a
+    mixed-version fleet."""
+    assert ck.CATALOG[-3:] == (ck.TELEMETRY_TICK, ck.TELEMETRY_DROP,
+                               ck.EXPORTER_LABEL_OVERFLOW)
+    assert ck.CATALOG[-8:-3] == (ck.TUNE_LOADED, ck.TUNE_FALLBACK,
+                                 ck.TUNE_KNOB_REJECTED, ck.TUNE_TRIAL,
+                                 ck.TUNE_PARITY_FAIL)
+    assert ck.CATALOG[-10:-8] == (ck.ROUTE_SORTFREE, ck.SORTFREE_OVERFLOW)
+    assert ck.CATALOG[-12:-10] == (ck.ROUTE_MESHED, ck.PIPE_MESHED)
+    assert ck.TELEMETRY_TICK == "telemetry.tick"
+    assert ck.TELEMETRY_DROP == "telemetry.readback_drop"
+    assert ck.EXPORTER_LABEL_OVERFLOW == "exporter.label_overflow"
     assert ck.ROUTE_SORTFREE == "split_route.sortfree"
     assert ck.SORTFREE_OVERFLOW == "sortfree.bucket_overflow"
     assert ck.ROUTE_MESHED == "split_route.meshed"
