@@ -56,6 +56,9 @@ KNOB_ENVS = (
     "SENTINEL_SORTFREE", "SENTINEL_SORTFREE_BITS", "SENTINEL_SORTFREE_CHUNK",
     "SENTINEL_TUNED_CONFIG",
     "SENTINEL_TELEMETRY_K", "SENTINEL_TELEMETRY_DISABLE",
+    "SENTINEL_HOT_ROWS", "SENTINEL_SKETCH_BITS", "SENTINEL_SKETCH_ROWS",
+    "SENTINEL_TIER_TICK_MS", "SENTINEL_TIERING_DISABLE",
+    "SENTINEL_TIER_COLD_MAX",
     "SERVING_DURATION_MS", "SERVING_RATE", "SERVING_SEED",
 )
 
@@ -144,6 +147,12 @@ def run_workload(name: str, *, seed: int = DEFAULT_SEED,
     telem = getattr(sph, "telemetry", None)
     if telem is not None and telem.enabled:
         telem.start(interval_sec=1.0)
+    # round 15 — the tiering ticker rides the replay at its configured
+    # cadence (SENTINEL_TIER_TICK_MS) so large-universe workloads
+    # exercise real demotion/promotion; snapshot lands in the artifact
+    tiering = getattr(sph, "tiering", None)
+    if tiering is not None and tiering.enabled:
+        tiering.start()
 
     lat = LogHistogram()
     stats = {"shed": 0, "allowed": 0, "blocked": 0, "deadline_miss": 0}
@@ -224,6 +233,11 @@ def run_workload(name: str, *, seed: int = DEFAULT_SEED,
             "drops": tsnap["drops"],
             "hot": [h["resource"] for h in tsnap["hot"][:8]],
         }
+    # round 15 — tiered-state health rides every artifact: hit/miss
+    # classification, migration counts + latency, cold-tier occupancy
+    tiering = getattr(sph, "tiering", None)
+    if tiering is not None and tiering.enabled:
+        out["tiering"] = tiering.snapshot()
     # worst-request trace dump: the slowest request's causal chain as a
     # Chrome-trace document (load serving_bench.json, pull
     # workloads.<name>.worst_request.trace into ui.perfetto.dev) — must

@@ -107,6 +107,21 @@ class Registry:
             if rid is not None:
                 self._pinned.discard(rid)
 
+    def evict_name(self, name: str) -> bool:
+        """Targeted eviction (the tiering ticker's proactive demotion):
+        drop ``name``'s row to the free list and queue it for the next
+        invalidation drain, exactly as an LRU overflow would. Refuses
+        pinned or unknown names."""
+        with self._lock:
+            rid = self._name_to_id.get(name)
+            if rid is None or rid in self._pinned:
+                return False
+            del self._name_to_id[name]
+            self._id_to_name[rid] = None
+            self._evicted_pending.append(rid)
+            self._free.append(rid)
+            return True
+
     def drain_evicted(self) -> List[int]:
         """Row ids recycled since the last drain; caller must invalidate their
         window state before the rows serve a new resource's decisions."""

@@ -43,8 +43,8 @@ from sentinel_tpu.rules import system as sys_mod
 from sentinel_tpu.stats import events as ev
 from sentinel_tpu.stats.window import (
     WindowSpec, WindowState, add_one_row, add_rows, add_rows_hist,
-    add_rows_multi, add_rows_vec, hist_add_fits, init_window,
-    invalidate_rows, refresh_all, refresh_rows,
+    add_rows_multi, add_rows_vec, extract_rows, hist_add_fits, init_window,
+    invalidate_rows, refresh_all, refresh_rows, restore_rows,
 )
 
 
@@ -953,3 +953,82 @@ def invalidate_resource_rows(spec: EngineSpec, state: SentinelState,
     return state._replace(second=second, minute=minute, threads=threads,
                           alt_second=alt_second, alt_threads=alt_threads,
                           flow_dyn=flow_dyn)
+
+
+class ResourceRowSlice(NamedTuple):
+    """One batch of demoted rows' complete per-row state — everything
+    :func:`invalidate_resource_rows` destroys, gathered FIRST so the cold
+    tier (sentinel_tpu/tiering/) can hold it host-side and a later
+    promotion restores the row bit-identically. Window stamps and occupy
+    target windows are absolute indices, so the payload needs no
+    rebasing at restore time. ``alt_*`` leaves carry the hashed
+    (resource × origin/context) slots the demoted resources touched —
+    keyed by (kind, key id) host-side so promotion can re-hash them to
+    the NEW row's slots."""
+
+    second: WindowState            # [K, ...] per-row second-window slice
+    minute: WindowState            # [K, ...] ([K, 0...] when disabled)
+    threads: jnp.ndarray           # int32[K]
+    occ_cnt: jnp.ndarray           # float32[K, B+1] occupy booking ring
+    occ_win: jnp.ndarray           # int32[K, B+1]
+    alt_second: WindowState        # [KA, ...] alt-window slices
+    alt_threads: jnp.ndarray       # int32[KA]
+
+
+def extract_resource_rows(spec: EngineSpec, state: SentinelState,
+                          rows: jnp.ndarray,
+                          alt_rows: jnp.ndarray) -> ResourceRowSlice:
+    """Gather the demotion payload for ``rows`` (+ their ``alt_rows``)
+    out of the live state. Pure gathers into FRESH output buffers — safe
+    to dispatch under the engine lock and read back asynchronously while
+    later steps donate the state (the telemetry-tick discipline)."""
+    r = rows.clip(0, spec.rows - 1)
+    ra = alt_rows.clip(0, spec.alt_rows - 1)
+    if spec.minute:
+        minute = extract_rows(spec.minute, state.minute, rows)
+    else:   # minute ring disabled: placeholder slice (ignored at restore)
+        minute = extract_rows(spec.second, state.minute,
+                              jnp.zeros_like(rows))
+    return ResourceRowSlice(
+        second=extract_rows(spec.second, state.second, rows),
+        minute=minute,
+        threads=state.threads[r],
+        occ_cnt=state.flow_dyn.occupied_count[r],
+        occ_win=state.flow_dyn.occupied_window[r],
+        alt_second=extract_rows(spec.second, state.alt_second, alt_rows),
+        alt_threads=state.alt_threads[ra])
+
+
+def restore_resource_rows(spec: EngineSpec, state: SentinelState,
+                          rows: jnp.ndarray, payload: ResourceRowSlice,
+                          alt_rows: jnp.ndarray) -> SentinelState:
+    """Scatter a promotion payload into freshly (re)allocated ``rows``.
+
+    The inverse of :func:`extract_resource_rows` modulo two documented
+    asymmetries: (a) ``alt_rows`` here are the NEW rows' hashed slots
+    (host-side re-hash of the payload's (kind, key id) identities — a
+    collision with a live pair overwrites that pair's short-window alt
+    stats, the same bounded merging the hash table already implies); and
+    (b) occupy bookings that straddled a rule reload while cold must be
+    settled HOST-side first (tiering/coldtier.py replays the reload's
+    ``settle_occupied`` with the reload's own ``now_idx``, so the
+    restored ring is bit-identical to the ring the row would hold had it
+    stayed resident). Padding rows >= R / alt >= RA drop."""
+    second = restore_rows(spec.second, state.second, rows, payload.second)
+    minute = state.minute
+    if spec.minute:
+        minute = restore_rows(spec.minute, state.minute, rows,
+                              payload.minute)
+    flow_dyn = state.flow_dyn._replace(
+        occupied_count=state.flow_dyn.occupied_count.at[rows].set(
+            payload.occ_cnt, mode="drop"),
+        occupied_window=state.flow_dyn.occupied_window.at[rows].set(
+            payload.occ_win, mode="drop"))
+    return state._replace(
+        second=second, minute=minute,
+        threads=state.threads.at[rows].set(payload.threads, mode="drop"),
+        alt_second=restore_rows(spec.second, state.alt_second, alt_rows,
+                                payload.alt_second),
+        alt_threads=state.alt_threads.at[alt_rows].set(
+            payload.alt_threads, mode="drop"),
+        flow_dyn=flow_dyn)

@@ -294,8 +294,14 @@ class AdaptiveBatcher:
         self.depth = self._pipe.depth
         # name → pre-interned row (PR 4 host-prep fast path); grows to at
         # most the resource universe, same staleness class as any
-        # name→row cache (see entry_batch_nowait docstring)
+        # name→row cache (see entry_batch_nowait docstring). Round 15:
+        # demotions prune their entries so the cache is bounded by the
+        # hot tier, not the (now unbounded) key universe, and a demoted
+        # key's next request re-interns — the promotion trigger.
         self._rows: Dict[str, int] = {}
+        tiering = getattr(sentinel, "tiering", None)
+        if tiering is not None and tiering.enabled:
+            tiering.add_demote_listener(self._on_demoted)
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._wake: Optional[asyncio.Event] = None
         self._slots: Optional[asyncio.Semaphore] = None
@@ -487,6 +493,12 @@ class AdaptiveBatcher:
             for i, row in zip(miss_idx, fresh):
                 cache[reqs[i].resource] = int(row)
                 rows[i] = row
+        if n > len(miss_idx):
+            # cache hits are resident by construction (demotion pruned);
+            # count them so the tier hit rate covers the cached path too
+            tiering = getattr(self._s, "tiering", None)
+            if tiering is not None:
+                tiering.note_hot_hits(n - len(miss_idx))
         acquire = np.fromiter((r.count for r in reqs), np.int32, count=n)
         prio = np.fromiter((r.prioritized for r in reqs), np.bool_, count=n)
         origins = ([r.origin for r in reqs]
@@ -498,6 +510,14 @@ class AdaptiveBatcher:
     # ------------------------------------------------------------------
     # settle / fan-out
     # ------------------------------------------------------------------
+
+    def _on_demoted(self, names) -> None:
+        """Tiering demote listener (engine lock held — O(names) only):
+        drop demoted keys from the name→row cache so their next request
+        misses, re-interns, and triggers promotion."""
+        cache = self._rows
+        for name in names:
+            cache.pop(name, None)
 
     def _pipe_settled(self, seq: int, verdicts) -> None:
         """DispatchPipeline on_settle hook (any settling thread, pipeline

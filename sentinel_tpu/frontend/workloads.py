@@ -40,6 +40,55 @@ import numpy as np
 ZIPF_S = 1.1
 ZIPF_UNIVERSE = 1_000_000
 
+#: Largest universe the exact inverse-CDF path materializes (one f64
+#: weight per rank). Above this, :func:`_zipf_ranks` switches to the
+#: hybrid head-table + continuous-tail sampler so a 64M-key universe
+#: (the round-15 tiering gate) costs O(head), not O(universe), memory.
+ZIPF_EXACT_MAX = 1_000_000
+_ZIPF_HEAD = 1 << 16
+
+
+def _zipf_ranks(rng, n: int, s: float, universe: int) -> np.ndarray:
+    """``n`` Zipf(s) ranks in ``[1, universe]``.
+
+    ``universe <= ZIPF_EXACT_MAX``: exact inverse-CDF over the full
+    materialized weight vector — bit-identical to the pre-round-15
+    generator, so recorded bench baselines stay comparable.
+
+    Larger universes: the first ``_ZIPF_HEAD`` ranks keep their exact
+    discrete CDF (the head is where all the probability mass and all
+    the hot-tier behavior live); the tail is drawn from the continuous
+    power-law surrogate on ``[head+1, universe+1)`` via closed-form
+    inverse CDF ``x = (u·(b^(1-s) − a^(1-s)) + a^(1-s))^(1/(1-s))``
+    and floored to a rank. Nothing of size ``universe`` is ever
+    allocated, and tail ranks stay long-tailed (a CI-sized run sees
+    nearly every tail draw as a first-sight key — exactly the cold
+    traffic the tiering gate needs)."""
+    if universe <= ZIPF_EXACT_MAX:
+        weights = 1.0 / np.power(
+            np.arange(1, universe + 1, dtype=np.float64), s)
+        cdf = np.cumsum(weights)
+        cdf /= cdf[-1]
+        return np.searchsorted(cdf, rng.random(n), side="right") + 1
+    head = _ZIPF_HEAD
+    head_cdf = np.cumsum(
+        1.0 / np.power(np.arange(1, head + 1, dtype=np.float64), s))
+    head_mass = head_cdf[-1]
+    a, b = float(head + 1), float(universe + 1)
+    one_m_s = 1.0 - s
+    tail_mass = (b ** one_m_s - a ** one_m_s) / one_m_s
+    u = rng.random(n) * (head_mass + tail_mass)
+    ranks = np.empty(n, np.int64)
+    in_head = u < head_mass
+    ranks[in_head] = np.searchsorted(
+        head_cdf, u[in_head], side="right") + 1
+    ut = (u[~in_head] - head_mass) / tail_mass
+    x = (ut * (b ** one_m_s - a ** one_m_s)
+         + a ** one_m_s) ** (1.0 / one_m_s)
+    ranks[~in_head] = np.minimum(
+        np.floor(x).astype(np.int64), universe)
+    return ranks
+
 
 class Request(NamedTuple):
     """One scheduled request: fire at ``t_ms`` after stream start."""
@@ -138,13 +187,12 @@ def zipf_hot(seed: int, duration_ms: float = 1000.0,
     """Zipf(s) popularity over ``universe`` ranks: rank k drawn with
     probability ∝ 1/k^s via inverse-CDF, so the head is hot and the
     tail is long (a CI-sized run touches only a few hundred distinct
-    resources out of the 1M universe)."""
+    resources out of the default 1M universe). ``universe`` scales to
+    the tens of millions without materializing a key list — see
+    :func:`_zipf_ranks`."""
     rng = np.random.default_rng(seed)
     ts = _arrivals(rng, duration_ms, rate_rps)
-    weights = 1.0 / np.power(np.arange(1, universe + 1, dtype=np.float64), s)
-    cdf = np.cumsum(weights)
-    cdf /= cdf[-1]
-    ranks = np.searchsorted(cdf, rng.random(len(ts)), side="right") + 1
+    ranks = _zipf_ranks(rng, len(ts), s, universe)
     return [Request(t, f"zipf/r{int(k)}", 1, False, "")
             for t, k in zip(ts, ranks)]
 

@@ -184,6 +184,12 @@ class SentinelCollector:
             f"{ns}_exporter_label_overflow",
             "Resource-labeled scrape samples dropped at the "
             "label-cardinality cap")
+        tier = CounterMetricFamily(
+            f"{ns}_tier_total",
+            "Tiered-state lifecycle: hot_hit / cold_miss intern "
+            "classifications, promoted / demoted row migrations, "
+            "sketch_overflow halvings (tiering/manager.py)",
+            labels=["event"])
         if not describe_only and obs is not None and obs.enabled:
             from sentinel_tpu.obs import counters as ck
             counts = obs.counters.snapshot()
@@ -248,6 +254,12 @@ class SentinelCollector:
                 telem.add_metric([ev], counts.get(key, 0))
             label_ovf.add_metric(
                 [], counts.get(ck.EXPORTER_LABEL_OVERFLOW, 0))
+            for key, ev in ((ck.TIER_HOT_HIT, "hot_hit"),
+                            (ck.TIER_COLD_MISS, "cold_miss"),
+                            (ck.TIER_PROMOTED, "promoted"),
+                            (ck.TIER_DEMOTED, "demoted"),
+                            (ck.TIER_SKETCH_OVERFLOW, "sketch_overflow")):
+                tier.add_metric([ev], counts.get(key, 0))
             # bounded by construction: at most telemetry.k ≤ MAX_K labels
             telemetry = getattr(self.sentinel, "telemetry", None)
             if telemetry is not None and telemetry.enabled:
@@ -256,7 +268,7 @@ class SentinelCollector:
         yield from (p99, quant, req_quant, route, hits, misses, retries,
                     blocks, occupy, pipeline, frontend, fe_flush, wraps,
                     flight_pinned, flight_trig, sf_ovf, tune,
-                    res_qps, telem, label_ovf)
+                    res_qps, telem, label_ovf, tier)
 
     def collect(self):
         ns = self.namespace

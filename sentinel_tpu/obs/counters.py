@@ -149,6 +149,26 @@ TELEMETRY_TICK = "telemetry.tick"
 TELEMETRY_DROP = "telemetry.readback_drop"
 EXPORTER_LABEL_OVERFLOW = "exporter.label_overflow"
 
+# PR 15 — tiered resource state (sentinel_tpu/tiering/): ``hot_hit`` /
+# ``cold_miss`` classify interns of keys the tier system already knows
+# (resident row vs cold-tier restore — brand-new keys tick NEITHER, so
+# the hit rate measures hot-tier sizing rather than keyspace size);
+# ``promoted`` / ``demoted`` count row migrations between the device hot
+# tier and the host cold tier (``demoted`` ticks on the invalidation
+# drain as each recycled row's state is snapshotted out; with tiering
+# disabled the drain is the pre-round-15 lossy invalidate and only
+# ``occupy.evicted`` ticks);
+# ``sketch_overflow`` counts count-min table halvings (estimates are
+# relative, halving preserves the hot/cold ranking — sustained growth
+# just means a long-lived process, not a fault). Exported as
+# ``sentinel_tier_total{event=...}``; see docs/OPERATIONS.md
+# "Tiered resource state (round 15)".
+TIER_HOT_HIT = "tier.hot_hit"
+TIER_COLD_MISS = "tier.cold_miss"
+TIER_PROMOTED = "tier.promoted"
+TIER_DEMOTED = "tier.demoted"
+TIER_SKETCH_OVERFLOW = "tier.sketch_overflow"
+
 #: Fixed aggregation catalog (order is the wire format of the multihost
 #: counter vector — append only, never reorder).
 CATALOG = (
@@ -174,6 +194,8 @@ CATALOG = (
     TUNE_LOADED, TUNE_FALLBACK, TUNE_KNOB_REJECTED,
     TUNE_TRIAL, TUNE_PARITY_FAIL,
     TELEMETRY_TICK, TELEMETRY_DROP, EXPORTER_LABEL_OVERFLOW,
+    TIER_HOT_HIT, TIER_COLD_MISS, TIER_PROMOTED, TIER_DEMOTED,
+    TIER_SKETCH_OVERFLOW,
 )
 
 

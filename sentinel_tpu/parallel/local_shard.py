@@ -93,6 +93,20 @@ def validate_mesh(spec: EngineSpec, mesh: Mesh) -> None:
         f"round max_resources up to a multiple of {n} (alt_rows follows it)")
 
 
+def shard_of_rows(n_rows: int, mesh: Optional[Mesh],
+                  rows: np.ndarray) -> np.ndarray:
+    """Owner shard per row id under the contiguous leading-axis split
+    (``validate_mesh`` guarantees even divisibility). Unmeshed engines
+    are a single shard. The tiering ticker uses this to spread
+    proactive demotions across shards so no device's hot set thins
+    faster than its peers'."""
+    rows = np.asarray(rows)
+    if mesh is None:
+        return np.zeros(rows.shape, np.int32)
+    per = n_rows // mesh.shape[MESH_AXIS]
+    return (rows // per).astype(np.int32)
+
+
 def state_shardings(spec: EngineSpec, mesh: Mesh,
                     state: SentinelState) -> SentinelState:
     """A ``SentinelState``-shaped pytree of :class:`NamedSharding` per the

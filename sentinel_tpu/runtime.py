@@ -589,6 +589,20 @@ class Sentinel:
         # bootstrap (or an operator) calls telemetry.start().
         from sentinel_tpu.obs.telemetry import HotTelemetry
         self.telemetry = HotTelemetry(self)
+        # Round 15 — tiered resource state (tiering/): the device table
+        # becomes the HOT tier; recycled rows' window counters, thread
+        # gauges and occupy bookings spill to a host cold tier and are
+        # restored bit-identically when the key is interned again.
+        # Constructed after the shutdown registry (it self-registers);
+        # the sketch ticker starts with the transport bootstrap or an
+        # operator tiering.start(). SENTINEL_TIERING_DISABLE reverts to
+        # the pre-round-15 lossy eviction.
+        from sentinel_tpu.tiering import TierManager
+        self.tiering = TierManager(self)
+        # per-rule-family pinned-name ledger (flow/degrade/param/auth):
+        # reloads release pins no other family still needs, so formerly
+        # ruled keys become demotable (see _update_rule_pins_locked)
+        self._rule_pins: dict = {}
         self.callbacks = StatisticCallbackRegistry()
         # circuit-breaker transition observers (EventObserverRegistry).
         # Event-driven: every decide/exit step that can move breaker state
@@ -879,6 +893,25 @@ class Sentinel:
                                                flow_dyn=fresh)
             self._pin_state_locked()
             self._rebuild_fastpath()
+            # release pins the new table no longer needs (mirrors the
+            # compile's pin sites: resource, relate-ref resource,
+            # chain-ref context, origin-specific limit_app)
+            res: set = set()
+            org: set = set()
+            ctxs: set = set()
+            for r in compiled.rules:
+                res.add(r.resource)
+                la = r.limit_app or "default"
+                if la not in ("default", "other"):
+                    org.add(la)
+                if r.strategy == flow_mod.STRATEGY_RELATE:
+                    res.add(r.ref_resource)
+                elif r.strategy == flow_mod.STRATEGY_CHAIN:
+                    ctxs.add(r.ref_resource)
+            self._update_rule_pins_locked("flow", res, org, ctxs)
+            # cold entries replay this settle at promote time with this
+            # exact now_idx (tiering/coldtier.settle_entry_np)
+            self.tiering.on_rules_reloaded_locked(now_idx)
 
     def set_token_service(self, svc) -> None:
         """Install the cluster token service used for cluster-mode flow rules
@@ -977,6 +1010,45 @@ class Sentinel:
                                             fn)
         return cached[2]
 
+    def _update_rule_pins_locked(self, family: str, res: set, org: set,
+                                 ctx: set) -> None:
+        """Refcounted rule-pin release (round 15): each rule family
+        registers the (resource, origin, context) names its CURRENT
+        compiled table pins; names the previous table pinned that no
+        family references anymore are unpinned, so formerly ruled keys
+        become evictable — and hence demotable to the cold tier.
+        Pre-round-15 compile-time pins leaked forever, which would have
+        made every rule-bound row a permanent hot-tier resident. Must
+        run AFTER the table swap: until then the old table still
+        addresses the old rows. Reserved rows (ENTRY node, origin "")
+        are pinned at construction outside this ledger and never appear
+        in rule sets."""
+        old = self._rule_pins.get(family, (set(), set(), set()))
+        new = (set(res), set(org), set(ctx))
+        self._rule_pins[family] = new
+        regs = (self.resources, self.origins, self.contexts)
+        for kind in range(3):
+            still: set = set()
+            for fam, sets in self._rule_pins.items():
+                if fam != family:
+                    still |= sets[kind]
+            for name in old[kind] - new[kind] - still:
+                regs[kind].unpin(name)
+        # pin-path interns bypass intern_resources: a newly ruled key
+        # that sits in the COLD tier just got a fresh (zeroed) row from
+        # the pin's alloc — classify it so the next eviction drain
+        # promotes its window/booking state before any rule evaluates
+        # against the zeroed row. tick=False: rule loads are control
+        # plane, not serving traffic — the hit-rate counters stay pure.
+        if self.tiering.enabled and res:
+            pairs = [(n, r) for n, r in
+                     ((n, self.resources.lookup(n)) for n in res)
+                     if r is not None]
+            if pairs:
+                self.tiering.note_interned(
+                    [p[0] for p in pairs], [p[1] for p in pairs],
+                    tick=False)
+
     def _pin_state_locked(self) -> None:
         """Re-place state leaves after host code rebuilt some of them
         (rule reloads swap in fresh unsharded arrays); no-op without a
@@ -1068,6 +1140,9 @@ class Sentinel:
                 breakers=deg_mod.init_breaker_state(cfg.max_degrade_rules))
             self._pin_state_locked()
             self._rebuild_fastpath()
+            self._update_rule_pins_locked(
+                "degrade", {r.resource for r in compiled.rules}, set(),
+                set())
 
     def load_param_flow_rules(self, rules: Sequence[pf_mod.ParamFlowRule]) -> None:
         self._user_param_rules = list(rules)
@@ -1108,6 +1183,12 @@ class Sentinel:
                 param_dyn=pf_mod.init_param_dyn(self.spec.param_keys))
             self._pin_state_locked()
             self._rebuild_fastpath()
+            # cluster-mode param rules don't compile into the device
+            # table but their rows must stay resident for delegation
+            self._update_rule_pins_locked(
+                "param", {r.resource for r in compiled.rules}
+                | {r.resource for r in all_rules if r.cluster_mode},
+                set(), set())
 
     def load_system_rules(self, rules: Sequence[sys_mod.SystemRule]) -> None:
         # buffered fast-path passes were admitted under the OLD tables —
@@ -1132,6 +1213,13 @@ class Sentinel:
             self._auth = compiled
             self._ruleset = self._build_ruleset()
             self._rebuild_fastpath()
+            org: set = set()
+            for r in compiled.rules:
+                org.update(o.strip() for o in r.limit_app.split(",")
+                           if o.strip())
+            self._update_rule_pins_locked(
+                "authority", {r.resource for r in compiled.rules}, org,
+                set())
 
     def update_window_geometry(self, sample_count: Optional[int] = None,
                                interval_ms: Optional[int] = None) -> None:
@@ -1318,6 +1406,9 @@ class Sentinel:
         # resolve rows ONCE; the same rows feed the verdict and the Entry so
         # an LRU eviction between lookups can't skew exit accounting
         row = self.resources.get_or_create(resource)
+        if self.tiering.enabled:
+            # classify + queue promotion if this key's state is cold
+            self.tiering.note_interned((resource,), (row,))
         if resource_type:   # ResourceTypeConstants classification for metrics
             self.resource_types[resource] = resource_type
         origin_id = self.origins.get_or_create(use_origin) if use_origin else 0
@@ -1562,9 +1653,13 @@ class Sentinel:
         return (pr, pk, gen, registry, pins)
 
     def _alt_row(self, row: int, kind: int, key_id: int) -> int:
-        """Hash + record the (main row → alt row) edge for eviction hygiene."""
+        """Hash + record the (main row → alt row) edge for eviction
+        hygiene. The slot's host identity ``(kind, key_id)`` travels
+        with the edge so the tiering demote can snapshot the slice under
+        a portable key and the promote can re-hash it onto the new row
+        (tiering/manager.py)."""
         r = _alt_hash(row, kind, key_id, self.spec.alt_rows)
-        self._alt_rows_by_row.setdefault(row, set()).add(r)
+        self._alt_rows_by_row.setdefault(row, {})[r] = (kind, key_id)
         return r
 
     def _alt_rows_for(self, row: int, origin: str, context_name: str):
@@ -1781,18 +1876,33 @@ class Sentinel:
         return pad_pow2(n)
 
     def intern_resources(self, resources: Sequence[str]) -> np.ndarray:
-        """Pre-stage a batch's resource rows: intern every name once and
-        return the int32 row array. Serving loops that dispatch the same
-        resource set step after step pass the returned array straight to
-        :meth:`entry_batch` / :meth:`entry_batch_nowait` as ``resources``,
-        moving the string-encode + intern cost out of the per-step path
-        (one FFI call here instead of one per step)."""
+        """Pre-stage a batch's resource rows: intern every DISTINCT name
+        once and return the int32 row array. Serving loops that dispatch
+        the same resource set step after step pass the returned array
+        straight to :meth:`entry_batch` / :meth:`entry_batch_nowait` as
+        ``resources``, moving the string-encode + intern cost out of the
+        per-step path (one FFI call here instead of one per step).
+
+        Duplicates resolve through a host map rather than repeated
+        registry allocations — a Zipf batch over a huge keyspace (round
+        15's 16M–64M-key workloads) interns its few hundred distinct
+        names once instead of pre-building a row per occurrence, so a
+        single skewed batch can no longer churn the LRU with cold keys."""
+        distinct = dict.fromkeys(resources)
+        names = list(distinct)
         batch_intern = getattr(self.resources, "get_or_create_batch", None)
         if batch_intern is not None:
-            return np.asarray(batch_intern(resources), np.int32)
-        return np.fromiter(
-            (self.resources.get_or_create(r) for r in resources),
-            np.int32, count=len(resources))
+            drows = np.asarray(batch_intern(names), np.int32)
+        else:
+            drows = np.fromiter(
+                (self.resources.get_or_create(r) for r in names),
+                np.int32, count=len(names))
+        self.tiering.note_interned(names, drows)
+        if len(names) == len(resources):
+            return drows
+        by_name = dict(zip(names, drows))
+        return np.fromiter((by_name[r] for r in resources), np.int32,
+                           count=len(resources))
 
     def entry_batch(self, resources: Sequence[str], *,
                     origins: Optional[Sequence[str]] = None,
@@ -1862,6 +1972,10 @@ class Sentinel:
                 rows = np.fromiter(
                     (self.resources.get_or_create(r) for r in resources),
                     np.int32, count=n)
+            # tiering: classify hot hit / cold miss and queue promotions
+            # for any re-interned cold keys (restored in this dispatch's
+            # eviction drain, before its decide)
+            self.tiering.note_interned(resources, rows)
         if resources is None and (self._host_gates
                                   or self._cluster_rules_by_row
                                   or self._cluster_param_rules_by_row):
@@ -2408,6 +2522,9 @@ class Sentinel:
                 batch = batch._replace(param_rules=None, param_keys=None)
             now, times = self._restamp_if_stale_locked(at_ms, now, times)
             self._drain_evictions_locked()
+            # hot-set sketch observe (tiering): dispatch-only scatter-max
+            # over this batch's rows; padding lanes are valid=False no-ops
+            self.tiering.observe_locked(batch.rows, batch.valid)
             self._seen_idx = max(self._seen_idx,
                                  self.spec.second.index_of(now))
             # static occupy variant: the occupy-aware pipeline runs only
@@ -2735,6 +2852,10 @@ class Sentinel:
                 bs = bs._replace(param_rules=None, param_keys=None)
                 bg = bg._replace(param_rules=None, param_keys=None)
             self._drain_evictions_locked()
+            # hot-set sketch observe (tiering): both split halves carry
+            # real traffic rows; padding lanes are valid=False no-ops
+            self.tiering.observe_locked(bs.rows, bs.valid)
+            self.tiering.observe_locked(bg.rows, bg.valid)
             self._seen_idx = max(self._seen_idx,
                                  self.spec.second.index_of(now))
             flags = {"skip_auth": self._skip_auth,
@@ -2927,6 +3048,8 @@ class Sentinel:
         with self._lock:
             now, times = self._restamp_if_stale_locked(at_ms, now, times)
             self._drain_evictions_locked()
+            # hot-set sketch observe (tiering): see decide_raw_nowait
+            self.tiering.observe_locked(batch.rows, batch.valid)
             self._seen_idx = max(self._seen_idx,
                                  self.spec.second.index_of(now))
             if any_prio:
@@ -3068,6 +3191,12 @@ class Sentinel:
         times = self._time_scalars(now)
         with self._lock:
             now, times = self._restamp_if_stale_locked(at_ms, now, times)
+            if self.tiering.enabled:
+                # tiering only: a key demoted between entry and exit must
+                # promote back before this decrement, or the exit would
+                # land on a recycled (or invalidated) row. Tiering-off
+                # keeps the historical no-drain exit path.
+                self._drain_evictions_locked()
             self._seen_idx = max(self._seen_idx,
                                  self.spec.second.index_of(now))
             unpin = None
@@ -3132,6 +3261,12 @@ class Sentinel:
                 # live occupy bookings are invalidated below
                 self.obs.counters.add(obs_keys.OCCUPY_EVICTED,
                                       len(evicted))
+            # tiering demote: snapshot the recycled rows' state into the
+            # cold tier BEFORE the invalidate destroys it (dispatch-only;
+            # stream order keeps the gather reading pre-invalidate
+            # values). Must run before the alt-edge pop below — the
+            # snapshot needs the slots' host identities.
+            self.tiering.pre_invalidate_locked(evicted, self.clock.now_ms())
             alt: List[int] = []
             for row in evicted:
                 alt.extend(self._alt_rows_by_row.pop(row, ()))
@@ -3141,6 +3276,13 @@ class Sentinel:
                               self.spec.alt_rows, np.int32)
             self._state = self._jit_invalidate(
                 self._state, jnp.asarray(rows_arr), jnp.asarray(alt_arr))
+        # tiering promote (the documented slow path): restore re-interned
+        # cold keys into their freshly allocated rows — after the
+        # invalidate, before the decide that triggered the intern, so
+        # that decide reads the row exactly as if it had never left.
+        # Unconditional: the promoted row may come from the free list
+        # with no eviction in this drain.
+        self.tiering.post_invalidate_locked(self.clock.now_ms())
 
     # ------------------------------------------------------------------
     # Introspection (command-surface backing)

@@ -369,6 +369,34 @@ def uncount_rows(spec: WindowSpec, state: WindowState, rows: jnp.ndarray,
     return state._replace(counters=counters)
 
 
+def extract_rows(spec: WindowSpec, state: WindowState,
+                 rows: jnp.ndarray) -> WindowState:
+    """Gather the full window slice of each row in ``rows`` → a
+    WindowState whose leading axis is ``len(rows)`` (tier demotion
+    snapshot). Stamps are ABSOLUTE window indices, so the slice is
+    self-contained: restored into any row at any later time it reads
+    exactly as it read here (stale buckets stay stale by the validity
+    arithmetic, not by position). Out-of-range rows (padding) gather
+    row 0's slice — callers mask them at restore via ``mode='drop'``."""
+    r = rows.clip(0, state.stamps.shape[0] - 1)
+    return WindowState(counters=state.counters[r], stamps=state.stamps[r],
+                       rt_sum=state.rt_sum[r], min_rt=state.min_rt[r])
+
+
+def restore_rows(spec: WindowSpec, state: WindowState, rows: jnp.ndarray,
+                 payload: WindowState) -> WindowState:
+    """Scatter a :func:`extract_rows` payload back into ``rows`` (tier
+    promotion). Overwrites the destination rows completely — the caller
+    just invalidated them (registry re-allocation), so the set is exact:
+    the row reads bit-identically to one that never left the device.
+    Padding: rows >= R drop."""
+    return WindowState(
+        counters=state.counters.at[rows].set(payload.counters, mode="drop"),
+        stamps=state.stamps.at[rows].set(payload.stamps, mode="drop"),
+        rt_sum=state.rt_sum.at[rows].set(payload.rt_sum, mode="drop"),
+        min_rt=state.min_rt.at[rows].set(payload.min_rt, mode="drop"))
+
+
 def invalidate_rows(spec: WindowSpec, state: WindowState, rows: jnp.ndarray) -> WindowState:
     """Forget all history of ``rows`` (registry eviction → row reuse).
 

@@ -1,0 +1,658 @@
+"""TierManager: the hot/cold state machine around the device row table.
+
+Lifecycle of a key under tiering (default ON; ``SENTINEL_TIERING_DISABLE``
+reverts to the pre-round-15 lossy eviction):
+
+* **resident (hot)** — a registry row; the dispatch paths are unchanged.
+* **demotion** — when the registry recycles a row (LRU overflow, or the
+  ticker's proactive ``evict_name``), the engine's eviction drain FIRST
+  dispatches a jitted gather of the row's complete state
+  (``engine.pipeline.extract_resource_rows`` — fresh output buffers,
+  dispatch-only under the engine lock) and queues it; the tiering
+  thread lands it into the :class:`~sentinel_tpu.tiering.coldtier.ColdTier`
+  off-lock. THEN the usual invalidate runs. ``tier.demoted`` ticks.
+* **cold** — host memory only; unbounded cardinality.
+* **promotion (the documented slow path)** — when a cold key is
+  interned again, the NEXT eviction drain (which runs under the engine
+  lock before every decide) scatters the cold payload back into the
+  freshly allocated row (``restore_resource_rows``), after replaying
+  any flow-rule reloads the key slept through
+  (:func:`~sentinel_tpu.tiering.coldtier.settle_entry_np`). The decide
+  that triggered the intern therefore sees the row EXACTLY as if it had
+  never left the device — verdict bit-parity is by construction
+  (window stamps and booking windows are absolute indices, so the
+  payload is time-portable), at the cost of one synchronous
+  host→device scatter on that batch (``tier.cold_miss`` +
+  ``tier.promoted`` tick; latency lands in
+  :attr:`TierManager.migration_hist`).
+
+Hot-set discovery: a conservative-update count-min sketch
+(:mod:`~sentinel_tpu.tiering.sketch`) over the batch's resource rows,
+updated under the engine lock inside the decide paths (dispatch-only).
+The ticker (modeled on the round-12 telemetry ticker: dispatch under
+the lock, land off-lock) decays the sketch, reads every row's estimate,
+and demotes the lowest-estimate unpinned rows whenever the resident
+count exceeds the ``SENTINEL_HOT_ROWS`` target — so LRU pressure from
+new keys lands on sketch-cold rows, never on the measured hot set.
+Proactive demotion requires the Python registry's ``evict_name``; on
+the native C++ table only LRU-overflow demotion runs (documented in
+OPERATIONS.md).
+
+Demotion attribution: the registry eviction queue carries row IDS (the
+name is already gone by then), so the manager keeps a shadow
+``row → name`` map maintained at every intern site
+(:meth:`TierManager.note_interned`). Rows reallocated by paths that
+bypass interning (rule-compile pins) resync from ``registry.name_of``
+at drain time and their previous owner's state is dropped
+unattributed — the pre-round-15 behavior, counted but not restored.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from sentinel_tpu.core.pending import start_host_copy
+from sentinel_tpu.core.registry import ENTRY_NODE_ROW
+from sentinel_tpu.obs import counters as obs_keys
+from sentinel_tpu.obs.hist import LogHistogram
+from sentinel_tpu.stats import events as ev
+from sentinel_tpu.tiering import sketch as sk
+from sentinel_tpu.tiering.coldtier import ColdEntry, ColdTier, settle_entry_np
+
+HOT_ROWS_ENV = "SENTINEL_HOT_ROWS"
+SKETCH_BITS_ENV = "SENTINEL_SKETCH_BITS"
+SKETCH_ROWS_ENV = "SENTINEL_SKETCH_ROWS"
+TIER_TICK_MS_ENV = "SENTINEL_TIER_TICK_MS"
+TIERING_DISABLE_ENV = "SENTINEL_TIERING_DISABLE"
+TIER_COLD_MAX_ENV = "SENTINEL_TIER_COLD_MAX"
+
+DEFAULT_TICK_MS = 200
+# un-landed demote payloads tolerated before the drain side force-lands
+# inline (the ticker normally lands them; this bounds device-buffer
+# retention when no ticker runs, e.g. short-lived test engines)
+PENDING_LAND_MAX = 64
+
+NEVER = -(2 ** 30)
+_I32MAX = np.iinfo(np.int32).max
+
+
+def _env_int(env: str, default: Optional[int], lo: int,
+             hi: int) -> Optional[int]:
+    raw = os.environ.get(env, "")
+    if not raw:
+        return default
+    try:
+        return max(lo, min(hi, int(raw)))
+    except ValueError:
+        return default
+
+
+def tier_hot_rows(default: Optional[int] = None) -> Optional[int]:
+    """Resident-row target for the ticker's proactive demotion; default
+    None = the full table (LRU-overflow demotion only)."""
+    return _env_int(HOT_ROWS_ENV, default, 64, 1 << 24)
+
+
+def tier_sketch_bits(default: int = sk.DEFAULT_BITS) -> int:
+    return _env_int(SKETCH_BITS_ENV, default, 4, 22)
+
+
+def tier_sketch_rows(default: int = sk.DEFAULT_ROWS) -> int:
+    return _env_int(SKETCH_ROWS_ENV, default, 1, 8)
+
+
+def tier_tick_ms(default: int = DEFAULT_TICK_MS) -> int:
+    return _env_int(TIER_TICK_MS_ENV, default, 10, 60000)
+
+
+def tier_cold_max(default: int = 0) -> int:
+    """Cold-tier entry bound; 0 = unbounded (the default)."""
+    return _env_int(TIER_COLD_MAX_ENV, default, 0, 1 << 31)
+
+
+def tiering_disabled() -> bool:
+    return os.environ.get(TIERING_DISABLE_ENV, "").lower() in (
+        "1", "true", "on", "yes")
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_extract(spec):
+    from sentinel_tpu.engine.pipeline import extract_resource_rows
+    return jax.jit(functools.partial(extract_resource_rows, spec))
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_restore(spec):
+    from sentinel_tpu.engine.pipeline import restore_resource_rows
+    return jax.jit(functools.partial(restore_resource_rows, spec))
+
+
+def _pad_pow2(n: int) -> int:
+    # pow2 padding keeps the extract/restore jit cache bounded per spec
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class TierManager:
+    """Per-:class:`~sentinel_tpu.runtime.Sentinel` tiering service
+    (``Sentinel.tiering``). Host structures live under a manager-local
+    lock; the ``*_locked`` hooks additionally run under the ENGINE lock
+    (they touch ``sentinel._state``). Lock order is always engine lock
+    → manager lock, never the reverse."""
+
+    def __init__(self, sentinel, *, enabled: Optional[bool] = None) -> None:
+        self._sentinel = sentinel
+        self._obs = sentinel.obs
+        if enabled is None:
+            enabled = not tiering_disabled()
+        self.enabled = bool(enabled)
+        self.hot_rows = tier_hot_rows()
+        self.cold = ColdTier(tier_cold_max() or None)
+        self.migration_hist = LogHistogram()
+        self._lock = threading.Lock()
+        # row → current owner name, maintained at every intern site
+        self._shadow: Dict[int, str] = {}
+        # row → FIRST victim name since the last eviction drain (later
+        # victims of the same row lived entirely between drains: no
+        # decide ever saw them, nothing on-device to save)
+        self._pending_demote: Dict[int, str] = {}
+        # name → row awaiting a cold→hot restore at the next drain
+        self._pending_promote: Dict[str, int] = {}
+        # names whose demote payload is dispatched but not yet landed
+        self._pending_land: Dict[str, dict] = {}
+        self._land_q: "collections.deque" = collections.deque()
+        self._est_q: "collections.deque" = collections.deque()
+        # flow-rule reload log: second-window now_idx per reload; a cold
+        # entry replays the tail it slept through at promote time
+        self._reload_idxs: List[int] = []
+        self._sketch = None
+        self._sketch_update = None
+        self._ticks = 0
+        self._last_est: Optional[np.ndarray] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        if self.enabled:
+            self._sketch = sk.init_sketch(tier_sketch_rows(),
+                                          tier_sketch_bits())
+            self._sketch_update = sk.jit_update()
+        # demote listeners (frontend/batcher.py prunes its name→row
+        # cache so a demoted key re-interns — and promotes — instead of
+        # dispatching against a recycled row)
+        self._demote_listeners: list = []
+        reg = getattr(sentinel, "register_shutdown", None)
+        if reg is not None:
+            reg(self)
+
+    # ---- intern-time hooks (outside the engine lock) ------------------
+
+    def note_interned(self, names, rows, tick: bool = True) -> None:
+        """Record name→row ownership for a just-interned batch and
+        classify each occurrence: resident name → ``tier.hot_hit``;
+        name the cold tier (or an in-flight demote) knows →
+        ``tier.cold_miss`` + queued promotion; first-sight name →
+        neither (a brand-new key is not a *miss* of anything — see the
+        hit-rate note in OPERATIONS.md). O(distinct names) python —
+        serving loops front this with the batcher's name→row cache, so
+        only cache misses pay it. ``tick=False`` (rule-load pin paths,
+        runtime._update_rule_pins_locked) keeps the shadow map and
+        promotion queue exact without counting control-plane interns
+        into the serving hit rate."""
+        if not self.enabled:
+            return
+        hot = cold = 0
+        with self._lock:
+            seen: Dict[str, list] = {}    # name → [count, classification]
+            for i, name in enumerate(names):
+                rec = seen.get(name)
+                if rec is not None:
+                    rec[0] += 1
+                    continue
+                row = int(rows[i])
+                prev = self._shadow.get(row)
+                if prev == name:
+                    seen[name] = [1, "hot"]
+                    continue
+                self._shadow[row] = name
+                if prev is not None:
+                    self._pending_demote.setdefault(row, prev)
+                if (name in self.cold or name in self._pending_land
+                        or any(v == name
+                               for v in self._pending_demote.values())):
+                    self._pending_promote[name] = row
+                    seen[name] = [1, "cold"]
+                else:
+                    seen[name] = [1, "new"]
+            for _name, (cnt, kind) in seen.items():
+                if kind == "hot":
+                    hot += cnt
+                elif kind == "cold":
+                    cold += cnt
+        if tick and self._obs.enabled:
+            if hot:
+                self._obs.counters.add(obs_keys.TIER_HOT_HIT, hot)
+            if cold:
+                self._obs.counters.add(obs_keys.TIER_COLD_MISS, cold)
+
+    def note_hot_hits(self, n: int) -> None:
+        """Frontend name→row cache hits: resident by construction (the
+        cache is pruned on demotion), counted here so the hit rate
+        covers the whole serving path."""
+        if self.enabled and n and self._obs.enabled:
+            self._obs.counters.add(obs_keys.TIER_HOT_HIT, n)
+
+    def add_demote_listener(self, fn) -> None:
+        """``fn(names: List[str])`` fires when keys leave the hot tier
+        (called from the eviction drain, still under the engine lock —
+        keep it O(names))."""
+        self._demote_listeners.append(fn)
+
+    # ---- engine-lock hooks -------------------------------------------
+
+    def observe_locked(self, rows_dev, valid_dev) -> None:
+        """Sketch update from a decide batch's device row array —
+        dispatch-only (conservative-update count-min; see sketch.py).
+        Overflow is detected host-side from the ticker's estimate
+        readback, so nothing here ever syncs."""
+        if self._sketch is None:
+            return
+        self._sketch, _overflow = self._sketch_update(
+            self._sketch, rows_dev, valid_dev)
+
+    def pre_invalidate_locked(self, evicted: List[int], now_ms: int) -> None:
+        """Demote snapshot: gather the evicted rows' state BEFORE the
+        invalidate destroys it. Dispatch + queue only; ``np.asarray``
+        happens on the tiering thread (or force-lands at promote).
+        Stream ordering guarantees the gather reads pre-invalidate
+        values even though the invalidate is dispatched right after."""
+        if not self.enabled:
+            return
+        sn = self._sentinel
+        victims: List[Tuple[str, int]] = []
+        with self._lock:
+            for row in evicted:
+                name = self._pending_demote.pop(row, None)
+                from_queue = name is not None
+                if name is None:
+                    name = self._shadow.get(row)
+                cur = sn.resources.name_of(row)
+                if cur is not None:
+                    self._shadow[row] = cur
+                else:
+                    self._shadow.pop(row, None)
+                if name is None or row == ENTRY_NODE_ROW:
+                    continue    # unattributable (pin-path reallocation)
+                if not from_queue and name == cur:
+                    continue    # stale duplicate queue entry; still owned
+                victims.append((name, row))
+        if not victims:
+            return
+        # alt slots: hashed (resource × origin/context) slices travel
+        # with their HOST identity so the promote can re-hash them
+        alt_ids: List[Tuple[int, int, int]] = []   # (victim_i, kind, key)
+        alt_slots: List[int] = []
+        for vi, (_name, row) in enumerate(victims):
+            slots = sn._alt_rows_by_row.get(row, {})
+            items = (slots.items() if isinstance(slots, dict)
+                     else ((s, None) for s in slots))
+            for slot, ident in items:
+                if ident is None:
+                    continue    # identity unknown: slice not portable
+                alt_ids.append((vi, ident[0], ident[1]))
+                alt_slots.append(slot)
+        k = len(victims)
+        kp = _pad_pow2(k)
+        ka = _pad_pow2(len(alt_slots)) if alt_slots else 1
+        rows_arr = np.full(kp, sn.spec.rows, np.int32)    # pad → dropped
+        rows_arr[:k] = [r for _n, r in victims]
+        alt_arr = np.full(ka, sn.spec.alt_rows, np.int32)
+        if alt_slots:
+            alt_arr[:len(alt_slots)] = alt_slots
+        payload = _jit_extract(sn.spec)(
+            sn._state, jnp.asarray(rows_arr), jnp.asarray(alt_arr))
+        start_host_copy(tuple(jax.tree_util.tree_leaves(payload)))
+        with self._lock:
+            rec = {"victims": victims, "alt_ids": alt_ids,
+                   "payload": payload, "now_ms": now_ms,
+                   "gen": len(self._reload_idxs), "landed": False}
+            for name, _row in victims:
+                self._pending_land[name] = rec
+            self._land_q.append(rec)
+            force = len(self._land_q) > PENDING_LAND_MAX
+        if self._obs.enabled:
+            self._obs.counters.add(obs_keys.TIER_DEMOTED, k)
+        if force:
+            self._land_all()
+        if self._demote_listeners:
+            names = [n for n, _r in victims]
+            for fn in self._demote_listeners:
+                try:
+                    fn(names)
+                except Exception:
+                    pass
+
+    def post_invalidate_locked(self, now_ms: int) -> None:
+        """Promote every pending cold key into its freshly allocated
+        (and just-invalidated) row — the synchronous half of the slow
+        path. Runs under the engine lock so the decide that interned
+        the key sees the restored row."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if not self._pending_promote:
+                return
+            todo = list(self._pending_promote.items())
+            self._pending_promote.clear()
+        sn = self._sentinel
+        t0 = time.monotonic_ns()
+        entries: List[Tuple[str, int, ColdEntry]] = []
+        for name, row in todo:
+            with self._lock:
+                if self._shadow.get(row) != name:
+                    # row recycled again before this drain; the entry
+                    # stays cold for the next intern of the name
+                    continue
+                pend = name in self._pending_land
+            if pend:
+                self._land_all()    # force-land the in-flight snapshot
+            entry = self.cold.pop(name)
+            if entry is None:
+                continue            # dropped (bounded cold tier)
+            # replay the flow reloads this key slept through, each with
+            # THAT reload's now_idx — bit-parity with the resident settle
+            with self._lock:
+                idxs = self._reload_idxs[entry.reload_gen:]
+            for idx in idxs:
+                settle_entry_np(sn.spec.second.buckets, entry, idx, ev.PASS)
+            entries.append((name, row, entry))
+        if not entries:
+            return
+        self._restore_locked(entries)
+        if self._obs.enabled:
+            self._obs.counters.add(obs_keys.TIER_PROMOTED, len(entries))
+        self.migration_hist.record(time.monotonic_ns() - t0)
+
+    def _restore_locked(self, entries) -> None:
+        """One jitted scatter for the whole promote batch."""
+        from sentinel_tpu.engine.pipeline import ResourceRowSlice
+        from sentinel_tpu.runtime import _alt_hash
+        from sentinel_tpu.stats.window import WindowState
+        sn = self._sentinel
+        spec = sn.spec
+        k = len(entries)
+        kp = _pad_pow2(k)
+        B = spec.second.buckets
+        e0 = entries[0][2]
+        ne = e0.sec_counters.shape[-1]
+        brt = e0.sec_rt_sum.shape[0]
+        mb, mbrt = e0.min_stamps.shape[0], e0.min_rt_sum.shape[0]
+        sec_c = np.zeros((kp, B, ne), np.int32)
+        sec_s = np.full((kp, B), NEVER, np.int32)
+        sec_rt = np.zeros((kp, brt), np.float32)
+        sec_mr = np.full((kp, brt), _I32MAX, np.int32)
+        min_c = np.zeros((kp, max(mb, 1), ne), np.int32)
+        min_s = np.full((kp, max(mb, 1)), NEVER, np.int32)
+        min_rt = np.zeros((kp, mbrt), np.float32)
+        min_mr = np.full((kp, mbrt), _I32MAX, np.int32)
+        thr = np.zeros(kp, np.int32)
+        occ_c = np.zeros((kp, B + 1), np.float32)
+        occ_w = np.full((kp, B + 1), NEVER, np.int32)
+        rows_arr = np.full(kp, spec.rows, np.int32)
+        alt_rows: List[int] = []
+        alt_payload: List[tuple] = []
+        for i, (_name, row, e) in enumerate(entries):
+            rows_arr[i] = row
+            sec_c[i], sec_s[i] = e.sec_counters, e.sec_stamps
+            sec_rt[i], sec_mr[i] = e.sec_rt_sum, e.sec_min_rt
+            if mb:
+                min_c[i], min_s[i] = e.min_counters, e.min_stamps
+                min_rt[i], min_mr[i] = e.min_rt_sum, e.min_min_rt
+            thr[i] = e.threads
+            occ_c[i], occ_w[i] = e.occ_cnt, e.occ_win
+            for (kind, key_id), alt in e.alts.items():
+                slot = _alt_hash(row, kind, key_id, spec.alt_rows)
+                slots = sn._alt_rows_by_row.setdefault(row, {})
+                if isinstance(slots, dict):
+                    slots[slot] = (kind, key_id)
+                else:
+                    slots.add(slot)
+                alt_rows.append(slot)
+                alt_payload.append(alt)
+        ka = _pad_pow2(len(alt_rows)) if alt_rows else 1
+        alt_arr = np.full(ka, spec.alt_rows, np.int32)
+        alt_c = np.zeros((ka, B, ne), np.int32)
+        alt_s = np.full((ka, B), NEVER, np.int32)
+        alt_rt = np.zeros((ka, brt), np.float32)
+        alt_mr = np.full((ka, brt), _I32MAX, np.int32)
+        alt_thr = np.zeros(ka, np.int32)
+        for j, alt in enumerate(alt_payload):
+            alt_arr[j] = alt_rows[j]
+            alt_c[j], alt_s[j], alt_rt[j], alt_mr[j], alt_thr[j] = alt
+        payload = ResourceRowSlice(
+            second=WindowState(jnp.asarray(sec_c), jnp.asarray(sec_s),
+                               jnp.asarray(sec_rt), jnp.asarray(sec_mr)),
+            minute=WindowState(jnp.asarray(min_c), jnp.asarray(min_s),
+                               jnp.asarray(min_rt), jnp.asarray(min_mr)),
+            threads=jnp.asarray(thr),
+            occ_cnt=jnp.asarray(occ_c), occ_win=jnp.asarray(occ_w),
+            alt_second=WindowState(jnp.asarray(alt_c), jnp.asarray(alt_s),
+                                   jnp.asarray(alt_rt), jnp.asarray(alt_mr)),
+            alt_threads=jnp.asarray(alt_thr))
+        sn._state = _jit_restore(spec)(
+            sn._state, jnp.asarray(rows_arr), payload, jnp.asarray(alt_arr))
+
+    def on_rules_reloaded_locked(self, now_idx: int) -> None:
+        """Flow-rule reload: resident rows just had their landed
+        bookings settled at ``now_idx``; log it so cold entries replay
+        the same settle at promote time."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._reload_idxs.append(int(now_idx))
+
+    # ---- landing (tiering thread / forced) ----------------------------
+
+    def _land_all(self) -> int:
+        with self._lock:
+            batch = list(self._land_q)
+            self._land_q.clear()
+        for rec in batch:
+            self._land_one(rec)
+        return len(batch)
+
+    def _land_one(self, rec) -> None:
+        if rec["landed"]:
+            return
+        rec["landed"] = True
+        p = rec["payload"]
+        sec = tuple(np.asarray(x) for x in p.second)
+        mnt = tuple(np.asarray(x) for x in p.minute)
+        threads = np.asarray(p.threads)
+        occ_c, occ_w = np.asarray(p.occ_cnt), np.asarray(p.occ_win)
+        alt_sec = tuple(np.asarray(x) for x in p.alt_second)
+        alt_thr = np.asarray(p.alt_threads)
+        for vi, (name, _row) in enumerate(rec["victims"]):
+            alts = {}
+            for j, (avi, kind, key_id) in enumerate(rec["alt_ids"]):
+                if avi == vi:
+                    alts[(kind, key_id)] = (
+                        alt_sec[0][j].copy(), alt_sec[1][j].copy(),
+                        alt_sec[2][j].copy(), alt_sec[3][j].copy(),
+                        int(alt_thr[j]))
+            entry = ColdEntry(
+                sec_counters=sec[0][vi].copy(), sec_stamps=sec[1][vi].copy(),
+                sec_rt_sum=sec[2][vi].copy(), sec_min_rt=sec[3][vi].copy(),
+                min_counters=mnt[0][vi].copy(), min_stamps=mnt[1][vi].copy(),
+                min_rt_sum=mnt[2][vi].copy(), min_min_rt=mnt[3][vi].copy(),
+                threads=int(threads[vi]),
+                occ_cnt=occ_c[vi].copy(), occ_win=occ_w[vi].copy(),
+                alts=alts, reload_gen=rec["gen"], demoted_ms=rec["now_ms"])
+            self.cold.put(name, entry)
+            with self._lock:
+                if self._pending_land.get(name) is rec:
+                    del self._pending_land[name]
+
+    # ---- ticker -------------------------------------------------------
+
+    def tick(self) -> bool:
+        """Dispatch one sketch decay + full-table estimate read under
+        the engine lock (no sync); queue the readback."""
+        if not self.enabled or self._closed or self._sketch is None:
+            return False
+        sn = self._sentinel
+        with sn._lock:
+            self._sketch, est = sk.jit_tick_read(sn.spec.rows)(self._sketch)
+        start_host_copy((est,))
+        with self._lock:
+            self._est_q.append(est)
+            self._ticks += 1
+        return True
+
+    def drain(self) -> int:
+        """Land queued demote payloads + sketch estimates OFF the
+        engine lock; handle sketch overflow; run proactive demotion
+        against the hot-rows target."""
+        n = self._land_all()
+        with self._lock:
+            ests = list(self._est_q)
+            self._est_q.clear()
+        if ests:
+            est = np.asarray(ests[-1])
+            self._last_est = est
+            if est.size and int(est.max()) >= sk.OVERFLOW_CAP // 2:
+                with self._sentinel._lock:
+                    self._sketch = sk._jit_halve(self._sketch)
+                if self._obs.enabled:
+                    self._obs.counters.add(obs_keys.TIER_SKETCH_OVERFLOW)
+            self._demote_cold_rows(est)
+        return n + len(ests)
+
+    def _demote_cold_rows(self, est: np.ndarray) -> None:
+        """Evict the lowest-estimate unpinned residents down to the
+        ``SENTINEL_HOT_ROWS`` target, round-robin across mesh shards
+        (parallel/local_shard.py row ownership) so no shard's hot set
+        thins faster than its peers'. Python registry only (the native
+        table has no targeted evict; LRU-overflow demotion still
+        applies there)."""
+        target = self.hot_rows
+        reg = self._sentinel.resources
+        evict = getattr(reg, "evict_name", None)
+        if target is None or evict is None:
+            return
+        items = reg.items()
+        over = len(items) - int(target)
+        if over <= 0:
+            return
+        from sentinel_tpu.parallel.local_shard import shard_of_rows
+        cand = [(int(est[row]), name, row) for name, row in items
+                if row != ENTRY_NODE_ROW and row < len(est)]
+        cand.sort()
+        shards = shard_of_rows(self._sentinel.spec.rows,
+                               self._sentinel.mesh,
+                               np.asarray([c[2] for c in cand], np.int32))
+        by_shard: Dict[int, collections.deque] = {}
+        for c, s in zip(cand, shards):
+            by_shard.setdefault(int(s), collections.deque()).append(c)
+        done = 0
+        while done < over and by_shard:
+            for s in list(by_shard):
+                q = by_shard[s]
+                while q:
+                    _e, name, row = q.popleft()
+                    if evict(name):
+                        # record intent NOW so a re-intern of this name
+                        # before the next engine drain classifies as a
+                        # cold miss and queues its promotion
+                        with self._lock:
+                            self._pending_demote.setdefault(row, name)
+                            self._shadow.pop(row, None)
+                        done += 1
+                        break
+                if not q:
+                    del by_shard[s]
+                if done >= over:
+                    break
+
+    def poll(self) -> int:
+        self.tick()
+        return self.drain()
+
+    def start(self, interval_sec: Optional[float] = None) -> None:
+        """Start the tiering daemon (no-op when disabled/running)."""
+        if not self.enabled or self._thread is not None or self._closed:
+            return
+        if interval_sec is None:
+            interval_sec = tier_tick_ms() / 1000.0
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(interval_sec):
+                try:
+                    self.poll()
+                except Exception:   # pragma: no cover — keep daemon alive
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="sentinel-tiering")
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Idempotent; registered with ``Sentinel.register_shutdown``."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._land_all()
+        except Exception:   # teardown must not depend on device health
+            pass
+
+    # ---- read surface -------------------------------------------------
+
+    def snapshot(self) -> Dict:
+        """The serving-bench artifact / transport-command body."""
+        c = self._obs.counters
+        with self._lock:
+            pend = len(self._land_q)
+        p50 = self.migration_hist.percentile(0.50)
+        p99 = self.migration_hist.percentile(0.99)
+        return {
+            "enabled": self.enabled,
+            "hot_rows_target": self.hot_rows,
+            "resident": len(self._sentinel.resources),
+            "cold": len(self.cold),
+            "cold_dropped": self.cold.dropped,
+            "pending_land": pend,
+            "ticks": self._ticks,
+            "hot_hit": c.get(obs_keys.TIER_HOT_HIT),
+            "cold_miss": c.get(obs_keys.TIER_COLD_MISS),
+            "promoted": c.get(obs_keys.TIER_PROMOTED),
+            "demoted": c.get(obs_keys.TIER_DEMOTED),
+            "sketch_overflow": c.get(obs_keys.TIER_SKETCH_OVERFLOW),
+            "migrate_p50_ms": None if p50 is None else p50 / 1e6,
+            "migrate_p99_ms": None if p99 is None else p99 / 1e6,
+        }
+
+    def hit_rate(self) -> Optional[float]:
+        """hot_hit / (hot_hit + cold_miss) — None before any classified
+        intern. First-sight registrations count as neither (a brand-new
+        key never had state to miss; ``tier.cold_miss`` measures
+        hot-tier sizing, not keyspace size — see OPERATIONS.md)."""
+        c = self._obs.counters
+        h = c.get(obs_keys.TIER_HOT_HIT)
+        m = c.get(obs_keys.TIER_COLD_MISS)
+        return h / (h + m) if (h + m) else None

@@ -137,6 +137,25 @@ KNOBS: Tuple[KnobSpec, ...] = (
     KnobSpec("SENTINEL_SORTFREE_CHUNK", "int", 256, 16, 4096, SCOPE_TRACE,
              (64, 256, 1024),
              "claim-cascade scan chunk (one [m, m] compare per step)"),
+    # tiering/manager.py tier_hot_rows() — device hot-tier row target;
+    # default None = the engine's max_resources (tiering keeps the whole
+    # table hot). Empty sweep grid: sizing is workload-skew-bound, not a
+    # latency/throughput trade the halving search can score.
+    KnobSpec("SENTINEL_HOT_ROWS", "int", None, 64, 1 << 24, SCOPE_RUNTIME,
+             (),
+             "device hot-tier size (rows the ticker keeps resident)"),
+    # tiering/manager.py tier_sketch_bits() — count-min width = 2^bits
+    KnobSpec("SENTINEL_SKETCH_BITS", "int", 12, 4, 22, SCOPE_RUNTIME,
+             (),
+             "count-min sketch width exponent (2^bits counters per row)"),
+    # tiering/manager.py tier_sketch_rows()
+    KnobSpec("SENTINEL_SKETCH_ROWS", "int", 4, 1, 8, SCOPE_RUNTIME,
+             (),
+             "count-min sketch depth (independent hash rows)"),
+    # tiering/manager.py tier_tick_ms() — promotion/demotion cadence
+    KnobSpec("SENTINEL_TIER_TICK_MS", "int", 200, 10, 60_000,
+             SCOPE_RUNTIME, (),
+             "tiering ticker period (sketch decay + demote scan)"),
 )
 
 KNOB_BY_ENV: Dict[str, KnobSpec] = {k.env: k for k in KNOBS}
@@ -165,6 +184,8 @@ OPERATIONAL_ENVS: Dict[str, Optional[type]] = {
     "SENTINEL_FLIGHT_BLOCK_BURST": int,
     "SENTINEL_TELEMETRY_K": int,
     "SENTINEL_TELEMETRY_DISABLE": None,
+    "SENTINEL_TIERING_DISABLE": None,
+    "SENTINEL_TIER_COLD_MAX": int,
     "SENTINEL_FIRST_LOAD_TIMEOUT_S": float,
     "SENTINEL_FIRST_LOAD_RETRIES": int,
     "SENTINEL_COMPILE_CACHE": None,

@@ -319,6 +319,9 @@ def test_registry_matches_runtime_clamps(monkeypatch):
         donation_enabled, host_staging_enabled, pipeline_depth,
         sortfree_enabled,
     )
+    from sentinel_tpu.tiering.manager import (
+        tier_hot_rows, tier_sketch_bits, tier_sketch_rows, tier_tick_ms,
+    )
     numeric = {
         "SENTINEL_PIPELINE_DEPTH": pipeline_depth,
         "SENTINEL_FRONTEND_BATCH": frontend_batch_max,
@@ -327,6 +330,10 @@ def test_registry_matches_runtime_clamps(monkeypatch):
         "SENTINEL_FRONTEND_IDLE_MS": frontend_idle_ms,
         "SENTINEL_SORTFREE_BITS": lambda: table_bits(4096),
         "SENTINEL_SORTFREE_CHUNK": chunk_size,
+        "SENTINEL_HOT_ROWS": tier_hot_rows,
+        "SENTINEL_SKETCH_BITS": tier_sketch_bits,
+        "SENTINEL_SKETCH_ROWS": tier_sketch_rows,
+        "SENTINEL_TIER_TICK_MS": tier_tick_ms,
     }
     for env, helper in numeric.items():
         spec = knobs_mod.KNOB_BY_ENV[env]
