@@ -1266,6 +1266,12 @@ class Sentinel:
             self._seen_idx = -(2 ** 62)
             self._fast.win_ms = max(1, new_second.win_ms)
             self._rebuild_fastpath()     # drops leases against old buckets
+            # tiering: cold entries + in-flight demote payloads carry
+            # OLD-geometry second windows and booking rings; land the
+            # in-flight ones, then cold-reset every cold entry to the
+            # new bucket count (the same reset resident rows just got)
+            # so a later promote can't scatter mismatched shapes
+            self.tiering.on_geometry_changed_locked()
 
     def set_global_switch(self, on: bool) -> None:
         """Reference setSwitch command — off = everything passes unchecked."""

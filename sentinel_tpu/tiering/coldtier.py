@@ -95,6 +95,35 @@ def settle_entry_np(buckets: int, entry: ColdEntry, now_idx: int,
     entry.occ_win = pend_win
 
 
+def reset_entry_geometry_np(entry: ColdEntry, buckets: int) -> None:
+    """In-place second-window cold-reset of one entry to a NEW bucket
+    count — the cold-tier mirror of ``runtime.update_window_geometry``,
+    which swaps fresh second windows, booking rings, and flow shaping
+    state into every RESIDENT row while the minute ring and thread
+    gauges carry over. A cold entry gets exactly the same treatment so
+    a later promote (a) scatters shapes that match the new spec and
+    (b) restores the row bit-identical to one that stayed resident
+    through the change. ``reload_gen`` rewinds to 0: the manager clears
+    its reload-replay log at a geometry change (pre-change reloads
+    settled into buckets that no longer exist, and the reset entry has
+    nothing left to settle)."""
+    B = int(buckets)
+    ne = entry.sec_counters.shape[-1]
+    brt = B if entry.sec_rt_sum.shape[0] else 0
+    entry.sec_counters = np.zeros((B, ne), np.int32)
+    entry.sec_stamps = np.full(B, NEVER, np.int32)
+    entry.sec_rt_sum = np.zeros(brt, np.float32)
+    entry.sec_min_rt = np.full(brt, _I32MAX, np.int32)
+    entry.occ_cnt = np.zeros(B + 1, np.float32)
+    entry.occ_win = np.full(B + 1, NEVER, np.int32)
+    entry.alts = {
+        ident: (np.zeros((B, ne), np.int32), np.full(B, NEVER, np.int32),
+                np.zeros(brt, np.float32), np.full(brt, _I32MAX, np.int32),
+                alt[4])
+        for ident, alt in entry.alts.items()}
+    entry.reload_gen = 0
+
+
 class ColdTier:
     """Locked name → :class:`ColdEntry` store with optional LRU bound."""
 
@@ -117,6 +146,14 @@ class ColdTier:
     def pop(self, name: str) -> Optional[ColdEntry]:
         with self._lock:
             return self._entries.pop(name, None)
+
+    def convert_geometry(self, buckets: int) -> None:
+        """Cold-reset every entry's second windows + booking ring to a
+        new bucket count (live geometry change); see
+        :func:`reset_entry_geometry_np`."""
+        with self._lock:
+            for entry in self._entries.values():
+                reset_entry_geometry_np(entry, buckets)
 
     def __contains__(self, name: str) -> bool:
         with self._lock:

@@ -263,8 +263,11 @@ class TierManager:
     def observe_locked(self, rows_dev, valid_dev) -> None:
         """Sketch update from a decide batch's device row array —
         dispatch-only (conservative-update count-min; see sketch.py).
-        Overflow is detected host-side from the ticker's estimate
-        readback, so nothing here ever syncs."""
+        The update op halves the table inside the jit when an estimate
+        crosses the overflow cap, so counters stay bounded even on an
+        engine that never starts the ticker; the flag is dropped here
+        (syncing it would stall the decide) and the overflow COUNTER is
+        ticked host-side from the ticker's estimate readback."""
         if self._sketch is None:
             return
         self._sketch, _overflow = self._sketch_update(
@@ -325,7 +328,8 @@ class TierManager:
         with self._lock:
             rec = {"victims": victims, "alt_ids": alt_ids,
                    "payload": payload, "now_ms": now_ms,
-                   "gen": len(self._reload_idxs), "landed": False}
+                   "gen": len(self._reload_idxs), "landed": False,
+                   "lock": threading.Lock()}
             for name, _row in victims:
                 self._pending_land[name] = rec
             self._land_q.append(rec)
@@ -363,12 +367,26 @@ class TierManager:
                     # row recycled again before this drain; the entry
                     # stays cold for the next intern of the name
                     continue
-                pend = name in self._pending_land
-            if pend:
-                self._land_all()    # force-land the in-flight snapshot
+                pend = self._pending_land.get(name)
+            if pend is not None:
+                # force-land THIS rec directly — a queue-level
+                # _land_all would no-op if the tiering thread already
+                # dequeued it but hasn't finished landing, and the
+                # promote below would then pop a missing entry and
+                # silently serve a zeroed row; _land_one's per-rec
+                # lock instead blocks until the in-flight land is done
+                self._land_one(pend)
             entry = self.cold.pop(name)
             if entry is None:
                 continue            # dropped (bounded cold tier)
+            if entry.sec_counters.shape[0] != sn.spec.second.buckets:
+                # extracted under a previous window geometry and missed
+                # by the geometry-change conversion (a straggler that
+                # landed after it): restoring would scatter mismatched
+                # shapes, and the cold-reset semantic says its second
+                # windows are void anyway — drop; the key re-enters
+                # fresh, exactly like a resident row post-change
+                continue
             # replay the flow reloads this key slept through, each with
             # THAT reload's now_idx — bit-parity with the resident settle
             with self._lock:
@@ -461,6 +479,33 @@ class TierManager:
         with self._lock:
             self._reload_idxs.append(int(now_idx))
 
+    def on_geometry_changed_locked(self) -> None:
+        """Live second-window geometry change
+        (``runtime.update_window_geometry``, engine lock held): every
+        cold entry and in-flight demote payload was extracted under the
+        OLD bucket count — promoting one later would scatter mismatched
+        shapes into the new state (numpy shape error / IndexError on
+        the serving path). Land every in-flight payload first (host
+        numpy, still old-geometry — the per-rec lock in ``_land_one``
+        covers recs the tiering thread holds mid-land), then cold-reset
+        each entry's second windows + booking ring to the new bucket
+        count, minute ring and thread gauge carrying over — exactly
+        what resident rows get, so demote→change→promote stays
+        bit-identical to staying resident. The reload-replay log
+        restarts: pre-change reloads settled into buckets that no
+        longer exist and every entry is reset-empty."""
+        if not self.enabled:
+            return
+        with self._lock:
+            recs = list({id(r): r for r in
+                         self._pending_land.values()}.values())
+        for rec in recs:
+            self._land_one(rec)
+        with self._lock:
+            self._land_q.clear()    # all landed (or marked) above
+            self._reload_idxs.clear()
+        self.cold.convert_geometry(self._sentinel.spec.second.buckets)
+
     # ---- landing (tiering thread / forced) ----------------------------
 
     def _land_all(self) -> int:
@@ -472,9 +517,18 @@ class TierManager:
         return len(batch)
 
     def _land_one(self, rec) -> None:
+        # per-rec lock: the engine side (post_invalidate_locked,
+        # on_geometry_changed_locked) may force-land a rec the tiering
+        # thread has already dequeued from _land_q — whoever arrives
+        # second blocks until the first fully lands (cold.put done),
+        # then no-ops, so a force-land always leaves the entry visible
+        # to the cold.pop that follows it
+        with rec["lock"]:
+            self._land_one_held(rec)
+
+    def _land_one_held(self, rec) -> None:
         if rec["landed"]:
             return
-        rec["landed"] = True
         p = rec["payload"]
         sec = tuple(np.asarray(x) for x in p.second)
         mnt = tuple(np.asarray(x) for x in p.minute)
@@ -502,6 +556,7 @@ class TierManager:
             with self._lock:
                 if self._pending_land.get(name) is rec:
                     del self._pending_land[name]
+        rec["landed"] = True
 
     # ---- ticker -------------------------------------------------------
 
@@ -530,6 +585,10 @@ class TierManager:
         if ests:
             est = np.asarray(ests[-1])
             self._last_est = est
+            # update_sketch already halved inline at the cap (decide
+            # paths never sync); an estimate still >= cap/2 means an
+            # overflow happened since the last tick — tick the counter
+            # and halve again to keep headroom
             if est.size and int(est.max()) >= sk.OVERFLOW_CAP // 2:
                 with self._sentinel._lock:
                     self._sketch = sk._jit_halve(self._sketch)
@@ -570,15 +629,30 @@ class TierManager:
                 q = by_shard[s]
                 while q:
                     _e, name, row = q.popleft()
+                    # record intent BEFORE evict_name frees the row: a
+                    # re-intern of this name in the window after the
+                    # registry pops the row but before intent lands
+                    # would otherwise classify hot against the stale
+                    # shadow entry, and the next drain would invalidate
+                    # the row without queuing its promotion — silently
+                    # zeroing a resident key
+                    with self._lock:
+                        if self._shadow.get(row) != name:
+                            continue    # re-owned since the estimate
+                        claimed = row not in self._pending_demote
+                        if claimed:
+                            self._pending_demote[row] = name
+                        del self._shadow[row]
                     if evict(name):
-                        # record intent NOW so a re-intern of this name
-                        # before the next engine drain classifies as a
-                        # cold miss and queues its promotion
-                        with self._lock:
-                            self._pending_demote.setdefault(row, name)
-                            self._shadow.pop(row, None)
                         done += 1
                         break
+                    # evict refused (pinned / raced away): roll back so
+                    # the name doesn't look cold while still resident
+                    with self._lock:
+                        if (claimed and
+                                self._pending_demote.get(row) == name):
+                            del self._pending_demote[row]
+                        self._shadow.setdefault(row, name)
                 if not q:
                     del by_shard[s]
                 if done >= over:

@@ -24,11 +24,17 @@ Hash family: multiply-shift over odd 32-bit constants
 (``h_s(x) = ((x * C_s) >> 15) & (W - 1)``) — int32 overflow wraps,
 which is exactly the mod-2^32 arithmetic the scheme wants.
 
-Decay: the ticker applies ``c -= c >> DECAY_SHIFT`` per tick so the
-sketch tracks the RECENT hot set, not all-time counts. Overflow: when
-any estimate crosses :data:`OVERFLOW_CAP` the whole table halves and
-``tier.sketch_overflow`` ticks (frequencies are relative, halving
-preserves ranking).
+Decay: the ticker applies ``c -= max(c >> DECAY_SHIFT, 1)`` (floored
+at zero) per tick so the sketch tracks the RECENT hot set, not
+all-time counts — the ``min 1`` term matters: a pure shift-decay can
+never move a counter below ``2**DECAY_SHIFT - 1``, leaving permanent
+floor estimates on cold rows. Overflow: :func:`update_sketch` halves
+the whole table INSIDE the jitted op whenever any estimate crosses
+:data:`OVERFLOW_CAP` (frequencies are relative, halving preserves
+ranking), so counters are bounded — and can never wrap int32 — even on
+an engine that never starts the ticker; the returned overflow flag and
+the ticker's estimate readback only drive the ``tier.sketch_overflow``
+accounting.
 """
 
 from __future__ import annotations
@@ -122,18 +128,27 @@ def update_sketch(counts: jnp.ndarray, items: jnp.ndarray,
     direction (a hot row's estimate can only lag, never spuriously
     spike another row hot). Invalid (padding) lanes write 0 — a no-op
     under max. Returns ``(counts', overflow)`` with ``overflow`` a bool
-    scalar: any estimate crossed :data:`OVERFLOW_CAP` (caller halves via
-    :func:`halve_sketch` and ticks ``tier.sketch_overflow``)."""
+    scalar: any estimate crossed :data:`OVERFLOW_CAP`. The halving
+    happens HERE, inside the jitted op, so the table is self-clamping
+    on engines with no running ticker (dispatch-only callers may drop
+    the flag; it only feeds ``tier.sketch_overflow`` accounting)."""
     idx = _bucket_idx(counts, items)                           # [SR, N]
     est = _estimates(counts, idx)                              # [N]
     target = jnp.where(valid, est + 1, 0)
     counts = SKETCH_IMPLS[impl](counts, idx, target)
-    return counts, jnp.any(target >= OVERFLOW_CAP)
+    overflow = jnp.any(target >= OVERFLOW_CAP)
+    counts = jnp.where(overflow, halve_sketch(counts), counts)
+    return counts, overflow
 
 
 def decay_sketch(counts: jnp.ndarray) -> jnp.ndarray:
-    """Per-tick exponential decay (recency weighting)."""
-    return counts - jax.lax.shift_right_logical(counts, DECAY_SHIFT)
+    """Per-tick exponential decay (recency weighting). Nonzero counters
+    lose at least 1 per tick — ``c >> DECAY_SHIFT`` alone is 0 for
+    ``c < 2**DECAY_SHIFT``, which would pin cold rows at a permanent
+    nonzero floor estimate forever."""
+    dec = jnp.maximum(jax.lax.shift_right_logical(counts, DECAY_SHIFT),
+                      jnp.minimum(counts, 1))
+    return counts - dec
 
 
 def halve_sketch(counts: jnp.ndarray) -> jnp.ndarray:

@@ -300,7 +300,7 @@ def test_tiering_disable_env(monkeypatch):
 # ---------------------------------------------------------------------------
 
 def _run_engine(capacity, steps, batch, keys, rules, reload_rules,
-                seed, origins=None):
+                seed, origins=None, geometry_step=None):
     """Seeded churn traffic against one engine; returns (verdict triples,
     tiering snapshot). Reload fires mid-run; ~25% of requests are
     prioritized so occupy bookings ride through demote/promote."""
@@ -315,6 +315,8 @@ def _run_engine(capacity, steps, batch, keys, rules, reload_rules,
         for step in range(steps):
             if step == steps // 2:
                 s.load_flow_rules(reload_rules)
+            if geometry_step is not None and step == geometry_step:
+                s.update_window_geometry(sample_count=4)
             names = list(rng.choice(keys, size=batch, replace=False))
             prio = list(rng.random(batch) < 0.25)
             kw = {}
@@ -384,3 +386,157 @@ def test_parity_alt_rows_carry_through_churn(monkeypatch):
     assert blocked > 0
     assert ssnap["demoted"] > 0 and ssnap["promoted"] > 0
     assert bsnap["demoted"] == 0
+
+
+# ---------------------------------------------------------------------------
+# review round: sketch self-clamp/decay floor, geometry change vs cold
+# tier, force-land race, proactive-demote TOCTOU rollback
+# ---------------------------------------------------------------------------
+
+def test_sketch_inline_halve_at_cap():
+    # the update op self-clamps at OVERFLOW_CAP inside the jit: no
+    # running ticker is needed to keep counters from wrapping int32
+    counts = jnp.full((2, 16), sk.OVERFLOW_CAP - 1, jnp.int32)
+    out, overflow = sk.update_sketch(counts, jnp.asarray([3], jnp.int32),
+                                     jnp.asarray([True]))
+    assert bool(overflow)
+    assert int(np.asarray(out).max()) <= sk.OVERFLOW_CAP // 2
+
+
+def test_sketch_decay_reaches_zero():
+    # counters below 2**DECAY_SHIFT must still decay away (a pure
+    # shift-decay leaves a permanent nonzero floor on cold rows)
+    counts = jnp.full((1, 4), 7, jnp.int32)
+    for _ in range(7):
+        counts = sk.decay_sketch(counts)
+    assert int(np.asarray(counts).max()) == 0
+    assert int(np.asarray(counts).min()) == 0
+
+
+def test_geometry_change_converts_cold_entries(monkeypatch):
+    """A live update_window_geometry must not strand old-geometry state
+    in the cold tier or the in-flight demote queue: entries land, get
+    cold-reset to the new bucket count (the same reset resident rows
+    receive), and promote cleanly afterwards."""
+    monkeypatch.setenv("SENTINEL_TPU_NATIVE", "0")
+    clk = ManualClock(start_ms=1_000_000)
+    s = Sentinel(load_config(max_resources=32, max_flow_rules=8,
+                             max_degrade_rules=8, max_authority_rules=8),
+                 clock=clk)
+    try:
+        t = s.tiering
+        s.entry_batch(["a", "b"], acquire=[1, 1])
+        # demote "a" (payload landed) and "b" (payload left in-flight:
+        # the tiering thread never runs in this test)
+        assert s.resources.evict_name("a")
+        s.entry_batch(["x"], acquire=[1])
+        t._land_all()
+        assert "a" in t.cold
+        assert s.resources.evict_name("b")
+        s.entry_batch(["x"], acquire=[1])       # dispatches b's snapshot
+        assert "b" in t._pending_land
+        s.update_window_geometry(sample_count=4)
+        B = s.spec.second.buckets
+        assert B == 4
+        for name in ("a", "b"):                 # both landed + converted
+            assert name in t.cold
+        e = t.cold._entries["a"]
+        assert e.sec_counters.shape[0] == B
+        assert e.occ_cnt.shape[0] == B + 1
+        assert not t._pending_land and not t._land_q
+        # promotion under the new geometry, same entry call, no crash
+        v = s.entry_batch(["a", "b"], acquire=[1, 1])
+        assert np.asarray(v.allow).all()
+        assert t.snapshot()["promoted"] == 2
+        assert "a" not in t.cold and "b" not in t.cold
+    finally:
+        s.close()
+
+
+def test_parity_through_geometry_change(monkeypatch):
+    """Verdict parity tiered vs all-resident THROUGH a live
+    update_window_geometry: both sides cold-reset second windows, and
+    the tiered side must convert its cold tier too (an old-geometry
+    entry promoted after the change used to crash the serving path)."""
+    monkeypatch.setenv("SENTINEL_TPU_NATIVE", "0")
+    ruled = [f"gk{i}" for i in range(8)]
+    keys = [f"gk{i}" for i in range(48)]
+    rules = [stpu.FlowRule(resource=r, count=3.0) for r in ruled]
+    reload_rules = ([stpu.FlowRule(resource=r, count=3.0)
+                     for r in ruled[:4]]
+                    + [stpu.FlowRule(resource=f"gk{i}", count=2.0)
+                       for i in range(8, 12)])
+    small, ssnap = _run_engine(24, 32, 12, keys, rules, reload_rules, 77,
+                               geometry_step=20)
+    big, bsnap = _run_engine(512, 32, 12, keys, rules, reload_rules, 77,
+                             geometry_step=20)
+    _assert_parity(small, big)
+    blocked = sum(int((~a).sum()) for a, _r, _w in small)
+    assert blocked > 0
+    assert ssnap["demoted"] > 0 and ssnap["promoted"] > 0
+    assert bsnap["demoted"] == 0
+
+
+def test_promote_force_lands_dequeued_record(monkeypatch):
+    """The promote path force-lands via the demote RECORD, not the land
+    queue: when the tiering thread has dequeued the record but not yet
+    landed it, the promotion must still restore the key's state (not
+    serve a zeroed row) and must not strand an orphaned cold entry."""
+    monkeypatch.setenv("SENTINEL_TPU_NATIVE", "0")
+    clk = ManualClock(start_ms=1_000_000)
+    s = Sentinel(load_config(max_resources=32, max_flow_rules=8,
+                             max_degrade_rules=8, max_authority_rules=8),
+                 clock=clk)
+    try:
+        t = s.tiering
+        s.entry_batch(["k"], acquire=[1])
+        assert s.resources.evict_name("k")
+        s.entry_batch(["x"], acquire=[1])       # dispatch k's snapshot
+        with t._lock:
+            rec = t._land_q.popleft()           # thread dequeues...
+        assert not rec["landed"]                # ...but has not landed
+        s.entry_batch(["k"], acquire=[1])       # re-intern → promote
+        assert t.snapshot()["promoted"] == 1
+        assert rec["landed"]                    # force-landed directly
+        t._land_all()
+        assert "k" not in t.cold                # no orphaned entry
+        # the restored row really carried its counters: demote again
+        # and inspect the fresh cold entry — both decides of "k" landed
+        # in the same second bucket, so a zeroed restore would show 1
+        assert s.resources.evict_name("k")
+        s.entry_batch(["x"], acquire=[1])
+        t._land_all()
+        e = t.cold._entries["k"]
+        assert int(e.sec_counters[:, ev.PASS].sum()) == 2
+    finally:
+        s.close()
+
+
+def test_proactive_demote_rolls_back_when_evict_refused(monkeypatch):
+    """_demote_cold_rows records demote intent BEFORE evict_name frees
+    the row (so a racing re-intern classifies cold, not hot against the
+    stale shadow) and rolls the intent back when the evict is refused —
+    a pinned key must not be left looking cold while still resident."""
+    monkeypatch.setenv("SENTINEL_TPU_NATIVE", "0")
+    clk = ManualClock(start_ms=1_000_000)
+    s = Sentinel(load_config(max_resources=16, max_flow_rules=8,
+                             max_degrade_rules=8, max_authority_rules=8),
+                 clock=clk)
+    try:
+        t = s.tiering
+        s.entry_batch(["a", "b"], acquire=[1, 1])
+        ra, rb = s.resources.lookup("a"), s.resources.lookup("b")
+        s.resources.pin("a")
+        t.hot_rows = 1
+        est = np.zeros(s.spec.rows, np.int32)
+        est[rb] = 5                     # "a" is coldest → tried first
+        t._demote_cold_rows(est)
+        with t._lock:
+            # pinned "a": refused → intent rolled back, still resident
+            assert t._shadow.get(ra) == "a"
+            assert ra not in t._pending_demote
+            # unpinned "b": demoted with intent recorded up front
+            assert t._pending_demote.get(rb) == "b"
+            assert rb not in t._shadow
+    finally:
+        s.close()
