@@ -173,6 +173,54 @@ def measure_serving(jax) -> dict:
                 tick_ms / out["sync_step_ms"], 4) if out["sync_step_ms"]
                 else None,
         }
+
+    # round 16 — single-dispatch ablation for the artifact trail: the
+    # SAME traffic through this engine (count-min observe fused into
+    # the decide program, SENTINEL_SINGLE_DISPATCH default-on) vs an
+    # engine built with the knob off (decide + a standalone observe
+    # dispatch per step). ``dispatches_per_batch`` is counted from
+    # ``pipeline.dispatches`` over the measured region; bit-parity and
+    # the steady ==1 invariant are gated by ci_gate gate (m).
+    from sentinel_tpu.obs import counters as obs_keys
+    c0 = sph.obs.counters.get(obs_keys.PIPE_DISPATCH)
+    fused_ms = min(run_sync() for _ in range(REPEATS))
+    n_disp = sph.obs.counters.get(obs_keys.PIPE_DISPATCH) - c0
+    out["dispatches_per_batch"] = round(n_disp / (STEPS * REPEATS), 4)
+    prev_sd = os.environ.get("SENTINEL_SINGLE_DISPATCH")
+    os.environ["SENTINEL_SINGLE_DISPATCH"] = "0"
+    try:
+        two = stpu.Sentinel(config=stpu.load_config(
+            max_resources=4096, max_flow_rules=256, max_degrade_rules=16,
+            max_authority_rules=16, minute_enabled=False))
+    finally:
+        if prev_sd is None:
+            os.environ.pop("SENTINEL_SINGLE_DISPATCH", None)
+        else:
+            os.environ["SENTINEL_SINGLE_DISPATCH"] = prev_sd
+    two.load_flow_rules([stpu.FlowRule(resource=f"s{i}", count=1e9)
+                         for i in range(256)])
+    rows_two = two.intern_resources(
+        [f"s{int(i)}" for i in rng.integers(0, 1024, B)])
+
+    def run_sync_two() -> float:
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            two.entry_batch_nowait(rows_two).result()
+        return (time.perf_counter() - t0) / STEPS * 1000
+
+    run_sync_two()                               # warm
+    d0 = two.obs.counters.get(obs_keys.PIPE_DISPATCH)
+    two_ms = min(run_sync_two() for _ in range(REPEATS))
+    d1 = two.obs.counters.get(obs_keys.PIPE_DISPATCH)
+    out["single_dispatch"] = {
+        "enabled": bool(sph._single_dispatch),
+        "fused_step_ms": round(fused_ms, 3),
+        "two_dispatch_step_ms": round(two_ms, 3),
+        "two_dispatch_per_batch": round(
+            (d1 - d0) / (STEPS * REPEATS), 4),
+        "step_ratio": (round(fused_ms / two_ms, 4) if two_ms else None),
+    }
+    two.close()
     sph.close()
     return out
 

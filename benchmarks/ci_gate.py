@@ -130,6 +130,19 @@ answer). The obs-overhead band (gate d, ≤ ``OBS_OVERHEAD_MAX``) now
 runs with tiering ON on both engines, so the sketch-update dispatch
 cost is already inside that band. ``CI_GATE_TIER=0`` skips. See the
 comment block above ``TIER_ENV_FLAG``.
+
+Gate (m) — the single-dispatch gate (r16): with both cadence carries
+armed, a steady fused serving batch must cost exactly ONE device
+dispatch (``pipeline.dispatches`` rises by one per batch; the sketch
+observe, the telemetry tick and the sketch decay all ride the jitted
+program's ``lax.cond`` epilogue) with each service ticking once per
+due cadence slot; verdicts AND the count-min table must be
+bit-identical between ``SENTINEL_SINGLE_DISPATCH=1`` and ``=0``
+through tiered churn with a mid-run rule reload; and the armed-vs-
+disarmed step-time ratio must stay ≤ ``OBS_OVERHEAD_MAX`` — the
+epilogue may not leak cost into batches where no tick is due.
+``CI_GATE_SINGLE_DISPATCH=0`` skips. See the comment block above
+``SINGLE_DISPATCH_ENV_FLAG``.
 """
 
 from __future__ import annotations
@@ -1536,6 +1549,230 @@ def measure_tiering() -> dict:
     return out
 
 
+# Gate (m) — the single-dispatch gate (r16). Three halves:
+#   mechanism: a ManualClock engine with BOTH cadence carries armed and
+#             steady fused (decide+exit) traffic — pipeline.dispatches
+#             must rise by exactly ONE per batch (the sketch observe,
+#             the telemetry tick and the sketch decay all ride the one
+#             jitted program, no standalone observe/tick dispatches),
+#             split_route.single_dispatch must attribute every batch,
+#             and each service's tick count must equal a host-side
+#             replay of its cadence (once per due slot, never per
+#             batch, no skipped slots).
+#   parity:   seeded churn traffic (tiered 24-row engine, mid-run rule
+#             reload, ~25% prioritized) with SENTINEL_SINGLE_DISPATCH=1
+#             vs =0 — verdict triples AND the final count-min table
+#             must be bit-identical, the probe must block somewhere
+#             (an all-PASS parity is vacuous), and the route counter
+#             must prove the two runs really took different routes.
+#   overhead: steady fused step time with the carries ARMED at 5 Hz vs
+#             disarmed, interleaved min-of-N, ratio ≤ OBS_OVERHEAD_MAX
+#             — the lax.cond epilogue may not leak cost into batches
+#             where no tick is due.
+# CI_GATE_SINGLE_DISPATCH=0 skips the whole gate.
+SINGLE_DISPATCH_ENV_FLAG = "CI_GATE_SINGLE_DISPATCH"
+
+
+def measure_single_dispatch() -> dict:
+    import time as _time
+
+    import numpy as np
+
+    sys.path.insert(0, str(HERE.parent))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import sentinel_tpu as stpu
+    from sentinel_tpu.core.clock import ManualClock
+    from sentinel_tpu.obs import counters as obs_keys
+
+    T0 = 1_785_000_000_000
+    out: dict = {}
+
+    def build(clock=None, **env):
+        prev = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            return stpu.Sentinel(stpu.load_config(
+                max_resources=64, max_flow_rules=16, max_degrade_rules=16,
+                max_authority_rules=16, minute_enabled=True,
+                host_fast_path=False), clock=clock)
+        finally:
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def fused_cols(s, rows):
+        n = rows.shape[0]
+        pad_a = s.spec.alt_rows
+        return (rows, np.zeros(n, np.int32), np.full(n, pad_a, np.int32),
+                np.zeros(n, np.int32), np.full(n, pad_a, np.int32),
+                np.ones(n, np.int32), np.ones(n, np.bool_),
+                np.zeros(n, np.bool_))
+
+    # ---- mechanism half: armed carries, dispatches/batch == 1 --------
+    clk = ManualClock(start_ms=T0)
+    sph = build(clk, SENTINEL_SINGLE_DISPATCH="1")
+    try:
+        rows_all = sph.intern_resources(["sd-a", "sd-b", "sd-c"])
+        t_arm = int(clk.now_ms())
+        sph.telemetry.arm_carry(400)
+        sph.tiering.arm_carry(150)
+        base = sph.obs.counters.get(obs_keys.PIPE_DISPATCH)
+        route0 = sph.obs.counters.get(obs_keys.ROUTE_SINGLE_DISPATCH)
+        tel0 = sph.telemetry.snapshot()["ticks"]
+        tier0 = sph.tiering.snapshot()["ticks"]
+        rng = np.random.default_rng(1603)
+        times, prev_rows = [], None
+        for _ in range(30):
+            rows = np.asarray(rng.choice(rows_all, size=4), np.int32)
+            times.append(int(clk.now_ms()))
+            sph.decide_and_exit_raw_nowait(
+                *fused_cols(sph, rows),
+                exit_rows=prev_rows if prev_rows is not None else rows,
+                exit_valid=(np.ones(4, np.bool_)
+                            if prev_rows is not None
+                            else np.zeros(4, np.bool_))).result()
+            prev_rows = rows
+            sph.telemetry.drain()       # the CadenceScheduler's job
+            sph.tiering.drain()
+            clk.advance_ms(50)
+
+        def claims(interval):
+            last, n = t_arm, 0
+            for t in times:
+                if t - last >= interval:
+                    last, n = t, n + 1
+            return n
+
+        disp = sph.obs.counters.get(obs_keys.PIPE_DISPATCH) - base
+        out["mech_batches"] = len(times)
+        out["dispatches_per_batch"] = disp / len(times)
+        out["route_single_dispatch"] = (
+            sph.obs.counters.get(obs_keys.ROUTE_SINGLE_DISPATCH) - route0)
+        out["tel_ticks"] = sph.telemetry.snapshot()["ticks"] - tel0
+        out["tel_ticks_expected"] = claims(400)
+        out["tier_ticks"] = sph.tiering.snapshot()["ticks"] - tier0
+        out["tier_ticks_expected"] = claims(150)
+        out["tel_drops"] = sph.telemetry.snapshot()["drops"]
+    finally:
+        sph.close()
+
+    # ---- parity half: fused observe+epilogue vs legacy, bitwise ------
+    RULED = [f"sd{i}" for i in range(8)]
+    SKEYS = [f"sd{i}" for i in range(48)]
+
+    def churn(sd_env: str):
+        # staging off: the ring's in-place slot reuse is a pre-existing
+        # process-history-sensitive race under tiering churn (ROADMAP
+        # known issues) — this is a bit-parity probe, keep it out
+        overrides = {"SENTINEL_TPU_NATIVE": "0",
+                     "SENTINEL_HOST_STAGING": "0",
+                     "SENTINEL_SINGLE_DISPATCH": sd_env}
+        prev = {k: os.environ.get(k) for k in overrides}
+        os.environ.update(overrides)
+        try:
+            cclk = ManualClock(start_ms=T0)
+            s = stpu.Sentinel(stpu.load_config(
+                max_resources=24, max_flow_rules=16, max_degrade_rules=16,
+                max_authority_rules=16, host_fast_path=False), clock=cclk)
+        finally:
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        try:
+            s.load_flow_rules([stpu.FlowRule(resource=r, count=3.0)
+                               for r in RULED])
+            rng = np.random.default_rng(1604)
+            verdicts = []
+            for step in range(32):
+                if step == 16:  # mid-run reload: pins move, state carries
+                    s.load_flow_rules(
+                        [stpu.FlowRule(resource=r, count=3.0)
+                         for r in RULED[:4]]
+                        + [stpu.FlowRule(resource=f"sd{i}", count=2.0)
+                           for i in range(8, 12)])
+                names = list(rng.choice(SKEYS, size=12, replace=False))
+                prio = list(rng.random(12) < 0.25)
+                v = s.entry_batch(names, acquire=[1] * 12,
+                                  prioritized=prio)
+                verdicts.append((np.asarray(v.allow).copy(),
+                                 np.asarray(v.reason).copy(),
+                                 np.asarray(v.wait_ms).copy()))
+                cclk.advance_ms(25)
+            sketch = np.asarray(s.tiering._sketch).copy()
+            route = s.obs.counters.get(obs_keys.ROUTE_SINGLE_DISPATCH)
+            return verdicts, sketch, route
+        finally:
+            s.close()
+
+    on_v, on_sk, on_route = churn("1")
+    off_v, off_sk, off_route = churn("0")
+    out["parity"] = all(
+        np.array_equal(a[0], b[0]) and np.array_equal(a[1], b[1])
+        and np.array_equal(a[2], b[2])
+        for a, b in zip(on_v, off_v))
+    out["sketch_parity"] = bool(np.array_equal(on_sk, off_sk))
+    out["parity_blocked"] = int(sum(
+        int((~a).sum()) for a, _r, _w in on_v))
+    out["parity_route_on"] = on_route
+    out["parity_route_off"] = off_route
+
+    # ---- overhead half: armed epilogue vs disarmed, no-tick-due ------
+    # Both engines run on ManualClocks that NEVER advance inside a
+    # timed region, so no timed batch has a tick due — the gated
+    # property is precisely that the lax.cond epilogue costs nothing
+    # on those batches. Between regions the armed clock jumps past the
+    # cadence and one UNTIMED dispatch fires the real epilogue program,
+    # so the armed engine keeps the production steady state (carry
+    # bookkeeping warm, epilogue executable resident) rather than an
+    # idealized never-armed one.
+    B, STEPS, REPEATS = 4096, 6, 8
+    pair = []
+    for key, armed in (("on", True), ("off", False)):
+        sclk = ManualClock(start_ms=T0)
+        s = build(sclk, SENTINEL_SINGLE_DISPATCH="1")
+        s.load_flow_rules([stpu.FlowRule(resource="sd-api", count=1e9)])
+        rows_all = s.intern_resources([f"sd-r{i}" for i in range(8)])
+        rng = np.random.default_rng(1605)
+        cols = fused_cols(
+            s, np.asarray(rng.choice(rows_all, size=B), np.int32))
+        kw = dict(exit_rows=cols[0], exit_valid=np.zeros(B, np.bool_))
+        if armed:
+            s.telemetry.arm_carry(200)
+            s.tiering.arm_carry(200)
+        for _ in range(2):                  # warm the plain fused program
+            s.decide_and_exit_raw_nowait(*cols, **kw).result()
+        if armed:                           # warm the epilogue variant too
+            sclk.advance_ms(250)
+            s.decide_and_exit_raw_nowait(*cols, **kw).result()
+            s.telemetry.drain()
+            s.tiering.drain()
+        pair.append((key, s, sclk, cols, kw))
+    best: dict = {}
+    for rep in range(REPEATS):
+        for key, s, sclk, cols, kw in (pair if rep % 2 == 0
+                                       else pair[::-1]):
+            t0 = _time.perf_counter()
+            for _ in range(STEPS):
+                s.decide_and_exit_raw_nowait(*cols, **kw).result()
+            dt = (_time.perf_counter() - t0) / STEPS
+            best[key] = min(best.get(key, dt), dt)
+            sclk.advance_ms(250)            # untimed: epilogue fires here
+            s.decide_and_exit_raw_nowait(*cols, **kw).result()
+            s.telemetry.drain()
+            s.tiering.drain()
+    for _key, s, _clk, _cols, _kw in pair:
+        s.close()
+    out["sd_epilogue_on_s_per_step"] = best["on"]
+    out["sd_epilogue_off_s_per_step"] = best["off"]
+    out["sd_overhead_ratio"] = best["on"] / best["off"]
+    return out
+
+
 def main() -> int:
     best = max(measure_once() for _ in range(3))
     cal = calibrate()
@@ -1557,6 +1794,9 @@ def main() -> int:
                  else None)
     tiering = (measure_tiering()
                if os.environ.get(TIER_ENV_FLAG, "1") != "0" else None)
+    single = (measure_single_dispatch()
+              if os.environ.get(SINGLE_DISPATCH_ENV_FLAG, "1") != "0"
+              else None)
     ratios = {k.replace("_s_per_step", "_ratio"): v / cal
               for k, v in prep.items()}
     if "--update" in sys.argv:
@@ -1606,6 +1846,13 @@ def main() -> int:
                               else v)
                           for k, v in tiering.items()}
                          if tiering is not None else None),
+             # informational: gate (m) is parity + mechanism (binary)
+             # plus the fixed OBS_OVERHEAD_MAX band, not re-baselined
+             # per machine
+             "single_dispatch": ({k: (round(v, 6) if isinstance(v, float)
+                                      else v)
+                                  for k, v in single.items()}
+                                 if single is not None else None),
              "calibration_s": cal}, indent=1))
         print(f"baseline updated: floor={best / 2:.0f} (measured {best:.0f}) "
               f"on {fingerprint()}; host-prep ratios "
@@ -1644,6 +1891,10 @@ def main() -> int:
         "tiering": ({k: (round(v, 4) if isinstance(v, float) else v)
                      for k, v in tiering.items()}
                     if tiering is not None else "skipped"),
+        "single_dispatch": ({k: (round(v, 6) if isinstance(v, float)
+                                 else v)
+                             for k, v in single.items()}
+                            if single is not None else "skipped"),
     }
     print(json.dumps(out))
     rc = 0
@@ -1870,6 +2121,68 @@ def main() -> int:
                   "the migration-latency histogram recorded nothing — "
                   "the cold-miss slow path lost its instrumentation",
                   file=sys.stderr)
+            rc = 1
+    if single is not None:
+        if single["dispatches_per_batch"] != 1.0:
+            print(f"SINGLE-DISPATCH REGRESSION: steady-state fused "
+                  f"serving cost {single['dispatches_per_batch']} device "
+                  f"dispatches per batch with both tickers armed "
+                  f"(batches={single['mech_batches']}) — the sketch "
+                  f"observe or the tick epilogue fell back to a "
+                  f"standalone program", file=sys.stderr)
+            rc = 1
+        if single["route_single_dispatch"] < single["mech_batches"]:
+            print(f"SINGLE-DISPATCH MECHANISM REGRESSION: only "
+                  f"{single['route_single_dispatch']} of "
+                  f"{single['mech_batches']} fused batches earned "
+                  f"split_route.single_dispatch — the scrape can no "
+                  f"longer tell the fused route from the legacy "
+                  f"composition", file=sys.stderr)
+            rc = 1
+        if (single["tel_ticks"] != single["tel_ticks_expected"]
+                or single["tier_ticks"] != single["tier_ticks_expected"]
+                or single["tel_ticks_expected"] == 0
+                or single["tier_ticks_expected"] == 0
+                or single["tel_drops"] != 0):
+            print(f"SINGLE-DISPATCH CADENCE REGRESSION: carried ticks "
+                  f"drifted from the host cadence replay — telemetry "
+                  f"{single['tel_ticks']}/{single['tel_ticks_expected']} "
+                  f"(drops {single['tel_drops']}), tiering "
+                  f"{single['tier_ticks']}/{single['tier_ticks_expected']}"
+                  f" — the epilogue is firing per batch, skipping due "
+                  f"slots, or the probe degenerated", file=sys.stderr)
+            rc = 1
+        if not single["parity"] or not single["sketch_parity"]:
+            print(f"SINGLE-DISPATCH PARITY REGRESSION: verdict parity="
+                  f"{single['parity']}, sketch parity="
+                  f"{single['sketch_parity']} between "
+                  f"SENTINEL_SINGLE_DISPATCH=1 and =0 — the fused "
+                  f"observe or the lax.cond epilogue changed an answer; "
+                  f"SENTINEL_SINGLE_DISPATCH=0 is the operator escape "
+                  f"hatch while this is debugged", file=sys.stderr)
+            rc = 1
+        if single["parity_blocked"] == 0:
+            print("SINGLE-DISPATCH PARITY REGRESSION: the parity probe "
+                  "never produced a BLOCK verdict — an all-PASS parity "
+                  "proves nothing; the probe's rule pressure degenerated",
+                  file=sys.stderr)
+            rc = 1
+        if (single["parity_route_on"] == 0
+                or single["parity_route_off"] != 0):
+            print(f"SINGLE-DISPATCH MECHANISM REGRESSION: route "
+                  f"attribution (split_route.single_dispatch on="
+                  f"{single['parity_route_on']}, off="
+                  f"{single['parity_route_off']}) says the two parity "
+                  f"runs did not actually take different routes",
+                  file=sys.stderr)
+            rc = 1
+        if single["sd_overhead_ratio"] > OBS_OVERHEAD_MAX:
+            print(f"SINGLE-DISPATCH OVERHEAD REGRESSION: armed-epilogue "
+                  f"step time ratio "
+                  f"{round(single['sd_overhead_ratio'], 4)} > "
+                  f"{OBS_OVERHEAD_MAX} vs carries disarmed (5 Hz probe "
+                  f"cadence) — the lax.cond epilogue is leaking cost "
+                  f"into batches where no tick is due", file=sys.stderr)
             rc = 1
     if trace["pinned_records"] == 0 or "deadline_miss" not in trace["kinds"]:
         print(f"TRACE-CAPTURE REGRESSION: {trace['induced_misses']} induced "

@@ -252,6 +252,28 @@ def main() -> None:
     bench("FULL decide_entries",
           jax.jit(full_step, donate_argnums=(0,)), (state, None))
 
+    # round 16 — the single-dispatch serving program: the count-min
+    # observe scatter fused behind decide_entries in the SAME program
+    # (runtime._build_sd_steps). The delta vs FULL decide_entries is the
+    # marginal cost of the fused observe; the saved standalone dispatch
+    # is the chained_tiny_add floor above.
+    from sentinel_tpu.tiering import sketch as sk_mod
+
+    def fused_sd_step(carry):
+        st, counts, _ = carry
+        st2, verd = decide_entries(
+            spec, ruleset, st, batch, times_arr, sys_scalars,
+            enable_occupy=False, record_alt=False)
+        counts2, _est = sk_mod.update_sketch(counts, batch.rows,
+                                             batch.valid)
+        return st2, counts2, verd
+
+    # fresh state: the FULL bench above donated (consumed) its carry
+    sd_state = init_state(spec, NRULES, max(len(deg_rules), 1))
+    bench("FULL decide+sketch_observe (fused sd)",
+          jax.jit(fused_sd_step, donate_argnums=(0,)),
+          (sd_state, sk_mod.init_sketch(), None))
+
     comp = (results.get("flow_check", 0)
             + results.get("degrade_entry_check", 0)
             + results.get("authority+system", 0)

@@ -59,6 +59,7 @@ KNOB_ENVS = (
     "SENTINEL_HOT_ROWS", "SENTINEL_SKETCH_BITS", "SENTINEL_SKETCH_ROWS",
     "SENTINEL_TIER_TICK_MS", "SENTINEL_TIERING_DISABLE",
     "SENTINEL_TIER_COLD_MAX",
+    "SENTINEL_SINGLE_DISPATCH",
     "SERVING_DURATION_MS", "SERVING_RATE", "SERVING_SEED",
 )
 
@@ -140,19 +141,16 @@ def run_workload(name: str, *, seed: int = DEFAULT_SEED,
     _warm(sph, batch_max, reqs[0].resource if reqs else "warm/0")
     sph.obs.counters.clear()
     sph.obs.hist_request.clear()
-    # round 12 — the hot-resource telemetry ticker rides the replay at
-    # its production 1 Hz cadence (obs/telemetry.py); health + hot view
-    # land in the artifact below, the on/off overhead ratio is gated by
-    # ci_gate gate (k)
+    # round 16 — ONE CadenceScheduler replaces the two ticker threads
+    # (rounds 12 + 15): it arms the telemetry (1 Hz) and tiering
+    # (SENTINEL_TIER_TICK_MS) epilogue carries so fused serving traffic
+    # runs the ticks inside its own dispatch, and only self-dispatches
+    # standalone ticks over idle gaps. Health + hot view land in the
+    # artifact below; the overhead ratios are gated by ci_gate gates
+    # (k) and (m).
     telem = getattr(sph, "telemetry", None)
-    if telem is not None and telem.enabled:
-        telem.start(interval_sec=1.0)
-    # round 15 — the tiering ticker rides the replay at its configured
-    # cadence (SENTINEL_TIER_TICK_MS) so large-universe workloads
-    # exercise real demotion/promotion; snapshot lands in the artifact
-    tiering = getattr(sph, "tiering", None)
-    if tiering is not None and tiering.enabled:
-        tiering.start()
+    from sentinel_tpu.serving import CadenceScheduler
+    CadenceScheduler(sph, telemetry_interval_sec=1.0).start()
 
     lat = LogHistogram()
     stats = {"shed": 0, "allowed": 0, "blocked": 0, "deadline_miss": 0}
@@ -222,6 +220,20 @@ def run_workload(name: str, *, seed: int = DEFAULT_SEED,
         "settled_obs": sph.obs.hist_request.count,
         "pipe_stall": c.get(obs_keys.PIPE_STALL),
         "pipe_depth_sum": c.get(obs_keys.PIPE_DEPTH),
+        # round 16 — device dispatches per flushed batch (ticker
+        # self-dispatches included, so steady ≈1 only when the sketch
+        # observe rides the decide program; the exact ==1 invariant on
+        # the fused path is gated by ci_gate gate (m))
+        "dispatches": c.get(obs_keys.PIPE_DISPATCH),
+        "route_single_dispatch": c.get(obs_keys.ROUTE_SINGLE_DISPATCH),
+        "dispatches_per_batch": (
+            round(c.get(obs_keys.PIPE_DISPATCH)
+                  / (c.get(obs_keys.FE_FLUSH_FULL)
+                     + c.get(obs_keys.FE_FLUSH_DEADLINE)
+                     + c.get(obs_keys.FE_FLUSH_IDLE)), 4)
+            if (c.get(obs_keys.FE_FLUSH_FULL)
+                + c.get(obs_keys.FE_FLUSH_DEADLINE)
+                + c.get(obs_keys.FE_FLUSH_IDLE)) else None),
         "decisions_per_s": (sph.obs.hist_request.count
                             / (duration_ms / 1e3) if duration_ms else 0.0),
     }

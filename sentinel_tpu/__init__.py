@@ -72,7 +72,9 @@ from sentinel_tpu.rules.system import SystemRule
 from sentinel_tpu.runtime import (
     ENTRY_TYPE_IN, ENTRY_TYPE_OUT, Entry, Sentinel, pipeline_depth,
 )
-from sentinel_tpu.serving import DispatchPipeline, PipelinedVerdicts
+from sentinel_tpu.serving import (
+    CadenceScheduler, DispatchPipeline, PipelinedVerdicts,
+)
 from sentinel_tpu.frontend import (
     AdaptiveBatcher, FrontendClosed, IngestOverload, RequestVerdict,
 )
@@ -97,7 +99,8 @@ __all__ = [
     "ContextScope", "enter_context", "exit_context",
     "snapshot_context", "restore_context",
     "SentinelConfig", "load_config",
-    "DispatchPipeline", "PipelinedVerdicts", "pipeline_depth",
+    "CadenceScheduler", "DispatchPipeline", "PipelinedVerdicts",
+    "pipeline_depth",
     "AdaptiveBatcher", "RequestVerdict", "IngestOverload",
     "FrontendClosed",
 ]

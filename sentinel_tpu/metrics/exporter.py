@@ -29,7 +29,7 @@ Self-telemetry families (from ``Sentinel.obs`` — obs/; absent while
     sentinel_block_reason_total{reason=...} denials by verdict code name
     sentinel_occupy_bookings_total{event=...} granted/carried/settled/evicted
     sentinel_pipeline_total{event=...}     depth/stall/leaked_handles/
-                                           meshed_dispatch
+                                           meshed_dispatch/dispatches
     sentinel_frontend_total{event=...}     enqueue/queue_depth/shed
     sentinel_frontend_flush_total{reason=...} full/deadline/idle batch cuts
     sentinel_span_ring_wraps_total         spans/links lost to ring wrap
@@ -210,7 +210,9 @@ class SentinelCollector:
                                  (ck.ROUTE_SPLIT, "split_fired"),
                                  (ck.ROUTE_FUSED, "fused_exit"),
                                  (ck.ROUTE_MESHED, "meshed"),
-                                 (ck.ROUTE_SORTFREE, "sortfree")):
+                                 (ck.ROUTE_SORTFREE, "sortfree"),
+                                 (ck.ROUTE_SINGLE_DISPATCH,
+                                  "single_dispatch")):
                 route.add_metric([fam_key], counts.get(key, 0))
             sf_ovf.add_metric([], counts.get(ck.SORTFREE_OVERFLOW, 0))
             hits.add_metric([], counts.get(ck.CACHE_HIT, 0))
@@ -227,7 +229,8 @@ class SentinelCollector:
             for key, ev in ((ck.PIPE_DEPTH, "depth"),
                             (ck.PIPE_STALL, "stall"),
                             (ck.PIPE_LEAKED, "leaked_handles"),
-                            (ck.PIPE_MESHED, "meshed_dispatch")):
+                            (ck.PIPE_MESHED, "meshed_dispatch"),
+                            (ck.PIPE_DISPATCH, "dispatches")):
                 pipeline.add_metric([ev], counts.get(key, 0))
             for key, ev in ((ck.FE_ENQUEUE, "enqueue"),
                             (ck.FE_QUEUE_DEPTH, "queue_depth"),

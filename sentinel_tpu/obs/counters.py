@@ -12,8 +12,11 @@ dispatch stays within the 2% observability budget (benchmarks/ci_gate.py
   per-event split (``_decide_split_nowait``), ``meshed`` when the
   dispatch ran on a row-sharded engine (alongside its route counter:
   meshed_total/route_total attributes how much traffic the mesh path
-  carries), and ``sortfree`` when the dispatch's flow programs grouped
-  segments sort-free (alongside its route counter, same pattern).
+  carries), ``sortfree`` when the dispatch's flow programs grouped
+  segments sort-free (alongside its route counter, same pattern), and
+  ``single_dispatch`` when a whole-batch decide/fused program carried
+  the tiering sketch observe inside itself (round 16 — the batch cost
+  ONE device dispatch instead of decide + observe).
 * ``sortfree.bucket_overflow`` — claim-cascade overflow total: elements
   whose step fell back to the sorted branch (ops/sortfree.py); sustained
   growth means the bucket table is undersized for the key distribution.
@@ -28,8 +31,11 @@ dispatch stays within the 2% observability budget (benchmarks/ci_gate.py
   by enqueue count for the achieved average depth), ``stall`` (submits
   that had to settle the oldest in-flight batch first),
   ``leaked_handles`` (PendingVerdicts settled by the GC finalizer
-  because ``.result()`` was never called), and ``meshed_dispatch``
-  (submits whose backing Sentinel is row-sharded over a mesh).
+  because ``.result()`` was never called), ``meshed_dispatch``
+  (submits whose backing Sentinel is row-sharded over a mesh), and
+  ``dispatches`` (device dispatches issued by the serving hot path and
+  its tickers — dispatches/batch is the round-16 single-dispatch
+  headline, gated at 1.0 by benchmarks/ci_gate.py gate (m)).
 * ``frontend.*`` — the ingest tier (sentinel_tpu/frontend/):
   ``enqueue`` (requests accepted), ``queue_depth`` (sum of pending
   queue length sampled at each enqueue — divide by enqueues for the
@@ -169,6 +175,23 @@ TIER_PROMOTED = "tier.promoted"
 TIER_DEMOTED = "tier.demoted"
 TIER_SKETCH_OVERFLOW = "tier.sketch_overflow"
 
+# PR 16 — single-dispatch serving tick: ``pipeline.dispatches`` counts
+# DEVICE DISPATCHES issued by the serving hot path and its tickers
+# (decide = 1, split = 2, fused decide+exit = 1, exit = 1, a standalone
+# sketch observe = 1, a self-dispatched telemetry or tiering tick = 1;
+# cold-path programs — invalidation drains, promotions/restores, rule
+# reloads — are deliberately NOT counted: the key exists so
+# dispatches-per-batch is measurable from obs plumbing alone, and the
+# cold path is not per-batch). ``split_route.single_dispatch`` ticks
+# once per whole-batch dispatch that carried the tiering sketch update
+# inside the decide/fused program itself (the round-16 fused observe —
+# alongside its route counter, like ROUTE_MESHED/ROUTE_SORTFREE); the
+# per-sub-batch split pipeline fuses the sketch too but keeps its two
+# dispatches, so it never ticks this key. Gate (m) in
+# benchmarks/ci_gate.py holds steady-state dispatches/batch == 1.
+PIPE_DISPATCH = "pipeline.dispatches"
+ROUTE_SINGLE_DISPATCH = "split_route.single_dispatch"
+
 #: Fixed aggregation catalog (order is the wire format of the multihost
 #: counter vector — append only, never reorder).
 CATALOG = (
@@ -196,6 +219,7 @@ CATALOG = (
     TELEMETRY_TICK, TELEMETRY_DROP, EXPORTER_LABEL_OVERFLOW,
     TIER_HOT_HIT, TIER_COLD_MISS, TIER_PROMOTED, TIER_DEMOTED,
     TIER_SKETCH_OVERFLOW,
+    PIPE_DISPATCH, ROUTE_SINGLE_DISPATCH,
 )
 
 

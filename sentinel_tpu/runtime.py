@@ -121,6 +121,23 @@ def sortfree_enabled() -> bool:
     return _env_on("SENTINEL_SORTFREE")
 
 
+def single_dispatch_enabled() -> bool:
+    """Single-dispatch serving tick (round 16): fold the tiering
+    sketch's conservative-update scatter into the jitted decide programs
+    (the sketch table becomes another donated operand) and, on the fused
+    decide+exit path, a ``lax.cond``-gated epilogue that runs the
+    telemetry tick + sketch decay when the host says one is due — so a
+    steady-state serving batch costs exactly ONE device dispatch.
+    Bit-exact with the two-dispatch composition by construction (the
+    fused programs trace the same ``sketch.update_sketch`` /
+    ``sketch.tick_read`` / ``telemetry_tick`` math in the same order).
+    ``SENTINEL_SINGLE_DISPATCH=0`` is the operator escape hatch — it
+    restores the pre-round-16 dispatch sequence AND its program cache
+    keys byte-for-byte (see docs/OPERATIONS.md "Single-dispatch
+    serving")."""
+    return _env_on("SENTINEL_SINGLE_DISPATCH")
+
+
 def pipeline_depth(default: int = 2) -> int:
     """The ``SENTINEL_PIPELINE_DEPTH`` knob, clamped to [1, 64]."""
     raw = os.environ.get(PIPELINE_DEPTH_ENV, "")
@@ -206,6 +223,183 @@ def _jitted_steps(spec: EngineSpec, custom_slots: tuple = (), shardings=None,
     if custom_slots or shardings is not None:
         return _build_steps(spec, custom_slots, shardings, donate)
     return _jitted_steps_cached(spec, donate)
+
+#: Static flag names shared by every decide-shaped program (must match
+#: the ``decide_entries`` keyword surface — _build_steps uses the same
+#: tuple inline).
+_STEP_STATICS = ("scalar_flow", "fast_flow", "skip_auth", "skip_sys",
+                 "scalar_has_rl", "skip_threads", "sortfree")
+
+#: Epilogue due-flag bits (host-computed, packed into the int32[4]
+#: ``epi`` operand as [flags, now_idx_s, sec_idx_m, append]).
+_EPI_TELEMETRY = 1       # run the telemetry tick branch
+_EPI_TIER = 2            # run the sketch decay + estimate branch
+
+
+def _build_sd_steps(spec: EngineSpec, custom_slots: tuple, shardings=None,
+                    donate: bool = True, mesh=None, tel_k: int = 1,
+                    tel_rows_per_shard: int = 0):
+    """Round-16 sketch-fused serving programs (``SENTINEL_SINGLE_DISPATCH``).
+
+    Three families, mirroring :func:`_build_steps`'s variant layout
+    (index ``(2 if no_alt else 0) + (1 if use_occ else 0)``):
+
+    * ``decide`` — ``decide_entries`` + :func:`sketch.update_sketch`
+      over the batch's rows, one program: ``(rules, state, sketch,
+      batch, times, sys_scalars) → (state, verdicts, sketch)``.
+    * ``fused`` — same fusion over ``decide_and_record_exits``.
+    * ``fused_epi`` — the fused program plus a ``lax.cond``-gated
+      epilogue: bit ``_EPI_TELEMETRY`` of ``epi[0]`` runs
+      :func:`~sentinel_tpu.obs.telemetry.telemetry_tick` over the
+      post-decide window state + timeline ring, bit ``_EPI_TIER`` runs
+      :func:`sketch.tick_read` (decay then full-table estimate).
+      Signature ``(rules, state, sketch, ring, epi, batch, xbatch,
+      times, sys_scalars) → (state, verdicts, sketch, ring, tel_outs,
+      est)``; the skipped branches return zero-shaped outputs and the
+      operands unchanged.
+
+    Bit-parity with the legacy two-dispatch composition is by
+    construction: the sketch update reads only ``batch.rows``/``valid``
+    (never the decide outputs), the decide never reads the sketch, and
+    the epilogue branches trace the exact helpers the standalone ticks
+    jit — same math, same order (observe, then decay+estimate over the
+    updated table), different program boundaries.
+
+    Sketch/ring/epilogue outputs are replicated on meshed engines
+    (``NamedSharding(mesh, P())`` — the tables are a few KB; only the
+    row-sharded state carries a layout)."""
+    from sentinel_tpu.obs.telemetry import TelemetryRing, telemetry_tick
+    from sentinel_tpu.tiering import sketch as sk_mod
+
+    if shardings is None or mesh is None:
+        kw3: dict = {}
+        kw6: dict = {}
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec
+        st_out, vd_out = shardings
+        rep = NamedSharding(mesh, PartitionSpec())
+        ring_rep = TelemetryRing(seconds=rep, lanes=rep, rt=rep,
+                                 cursor=rep)
+        kw3 = {"out_shardings": (st_out, vd_out, rep)}
+        kw6 = {"out_shardings": (st_out, vd_out, rep, ring_rep, rep, rep)}
+    kw_d12 = {"donate_argnums": (1, 2)} if donate else {}
+    kw_d123 = {"donate_argnums": (1, 2, 3)} if donate else {}
+    n_ev = ev.NUM_EVENTS
+
+    def dec_sd(occ, alt):
+        base = functools.partial(decide_entries, spec, enable_occupy=occ,
+                                 custom_slots=custom_slots, record_alt=alt)
+
+        def step(rules, state, sketch, batch, times, sys_scalars,
+                 scalar_flow=False, fast_flow=False, skip_auth=False,
+                 skip_sys=False, scalar_has_rl=True, skip_threads=False,
+                 sortfree=False):
+            state, verdicts = base(
+                rules, state, batch, times, sys_scalars,
+                scalar_flow=scalar_flow, fast_flow=fast_flow,
+                skip_auth=skip_auth, skip_sys=skip_sys,
+                scalar_has_rl=scalar_has_rl, skip_threads=skip_threads,
+                sortfree=sortfree)
+            # the overflow flag is dropped exactly like observe_locked's
+            # (self-clamping halve happens inside update_sketch; the
+            # COUNTER is ticked from the ticker's estimate readback)
+            sketch, _overflow = sk_mod.update_sketch(
+                sketch, batch.rows, batch.valid)
+            return state, verdicts, sketch
+
+        return jax.jit(step, static_argnames=_STEP_STATICS,
+                       **kw3, **kw_d12)
+
+    def fused_sd(occ, alt, epilogue):
+        base = functools.partial(decide_and_record_exits, spec,
+                                 enable_occupy=occ,
+                                 custom_slots=custom_slots, record_alt=alt)
+
+        def step(rules, state, sketch, batch, xbatch, times, sys_scalars,
+                 scalar_flow=False, fast_flow=False, skip_auth=False,
+                 skip_sys=False, scalar_has_rl=True, skip_threads=False,
+                 sortfree=False):
+            state, verdicts = base(
+                rules, state, batch, xbatch, times, sys_scalars,
+                scalar_flow=scalar_flow, fast_flow=fast_flow,
+                skip_auth=skip_auth, skip_sys=skip_sys,
+                scalar_has_rl=scalar_has_rl, skip_threads=skip_threads,
+                sortfree=sortfree)
+            sketch, _overflow = sk_mod.update_sketch(
+                sketch, batch.rows, batch.valid)
+            return state, verdicts, sketch
+
+        if not epilogue:
+            return jax.jit(step, static_argnames=_STEP_STATICS,
+                           **kw3, **kw_d12)
+
+        def step_epi(rules, state, sketch, ring, epi, batch, xbatch,
+                     times, sys_scalars, scalar_flow=False,
+                     fast_flow=False, skip_auth=False, skip_sys=False,
+                     scalar_has_rl=True, skip_threads=False,
+                     sortfree=False):
+            state, verdicts, sketch = step(
+                rules, state, sketch, batch, xbatch, times, sys_scalars,
+                scalar_flow=scalar_flow, fast_flow=fast_flow,
+                skip_auth=skip_auth, skip_sys=skip_sys,
+                scalar_has_rl=scalar_has_rl, skip_threads=skip_threads,
+                sortfree=sortfree)
+
+            def tel_run(op):
+                second, minute, rg = op
+                return telemetry_tick(
+                    spec.second, spec.minute, tel_k, mesh,
+                    tel_rows_per_shard, second, minute, rg,
+                    epi[1], epi[2], epi[3])
+
+            def tel_skip(op):
+                _second, _minute, rg = op
+                zk = jnp.zeros((tel_k,), jnp.int32)
+                zl = jnp.zeros((tel_k, n_ev), jnp.int32)
+                return (zk, zk, zl, zl, jnp.zeros((tel_k,), jnp.float32),
+                        jnp.zeros((n_ev,), jnp.int32),
+                        jnp.zeros((), jnp.float32)), rg
+
+            tel_outs, ring2 = jax.lax.cond(
+                (epi[0] & _EPI_TELEMETRY) > 0, tel_run, tel_skip,
+                (state.second, state.minute, ring))
+
+            def tier_run(sc):
+                return sk_mod.tick_read(sc, spec.rows)
+
+            def tier_skip(sc):
+                return sc, jnp.zeros((spec.rows,), jnp.int32)
+
+            sketch, est = jax.lax.cond(
+                (epi[0] & _EPI_TIER) > 0, tier_run, tier_skip, sketch)
+            return state, verdicts, sketch, ring2, tel_outs, est
+
+        return jax.jit(step_epi, static_argnames=_STEP_STATICS,
+                       **kw6, **kw_d123)
+
+    return {
+        "decide": (dec_sd(False, True), dec_sd(True, True),
+                   dec_sd(False, False), dec_sd(True, False)),
+        "fused": (fused_sd(False, True, False), fused_sd(True, True, False),
+                  fused_sd(False, False, False),
+                  fused_sd(True, False, False)),
+        "fused_epi": (fused_sd(False, True, True),
+                      fused_sd(True, True, True),
+                      fused_sd(False, False, True),
+                      fused_sd(True, False, True)),
+    }
+
+
+@functools.lru_cache(maxsize=None)
+def _sd_steps_cached(spec: EngineSpec, donate: bool, tel_k: int,
+                     tel_rows_per_shard: int):
+    """Sketch-fused programs shared across Sentinel instances with the same
+    geometry + telemetry layout — same caching policy as
+    :func:`_jitted_steps_cached` (variants with custom DeviceSlots or mesh
+    shardings stay per-instance so their compilations are collectable)."""
+    return _build_sd_steps(spec, (), donate=donate, tel_k=tel_k,
+                           tel_rows_per_shard=tel_rows_per_shard)
+
 
 # jitted once at import; shapes are padded to powers of two so the trace
 # cache stays small (calling jax.jit(...) per drain would re-trace every time)
@@ -645,6 +839,14 @@ class Sentinel:
          self._jit_fused_steps) = \
             _jitted_steps(self.spec, shardings=self._mesh_shardings,
                           donate=self._donate)
+        # round 16 — single-dispatch serving tick: sketch-fused decide
+        # programs built lazily (_sd_steps_locked; reset wherever the
+        # legacy 9-tuple above is reassigned). The knob off leaves every
+        # legacy path — and its program cache keys — byte-identical to
+        # pre-r16.
+        self._single_dispatch = bool(self._tuned.get(
+            "SENTINEL_SINGLE_DISPATCH", single_dispatch_enabled()))
+        self._sd_steps = None
         # (variant, geometry, statics) combos whose program fetch was
         # already guarded — see _warm_first_fetch_locked
         self._fetched_programs: set = set()
@@ -1068,6 +1270,27 @@ class Sentinel:
          self._jit_fused_steps) = \
             _jitted_steps(self.spec, self._device_slots,
                           self._mesh_shardings, donate=self._donate)
+        self._sd_steps = None       # sketch-fused variants track the 9-tuple
+
+    def _sd_steps_locked(self):
+        """Round-16 sketch-fused serving programs, built lazily (engine
+        lock held — the builder reads live geometry / shardings /
+        telemetry layout; plain-geometry engines share the process-wide
+        :func:`_sd_steps_cached` compilations). Never consulted with
+        ``SENTINEL_SINGLE_DISPATCH`` off."""
+        if self._sd_steps is None:
+            if self._device_slots or self._mesh_shardings is not None \
+                    or self.mesh is not None:
+                self._sd_steps = _build_sd_steps(
+                    self.spec, self._device_slots, self._mesh_shardings,
+                    donate=self._donate, mesh=self.mesh,
+                    tel_k=self.telemetry.k,
+                    tel_rows_per_shard=self.telemetry._rows_per_shard)
+            else:
+                self._sd_steps = _sd_steps_cached(
+                    self.spec, self._donate, self.telemetry.k,
+                    self.telemetry._rows_per_shard)
+        return self._sd_steps
 
     def _slot_code(self, kind: str, index: int) -> int:
         """Reason code for a custom slot denial (disjoint sub-spaces: the
@@ -1262,6 +1485,7 @@ class Sentinel:
              self._jit_fused_steps) = \
                 _jitted_steps(self.spec, self._device_slots,
                               self._mesh_shardings, donate=self._donate)
+            self._sd_steps = None   # sketch-fused variants track the 9-tuple
             self._occupy_live_until_ms = -1
             self._seen_idx = -(2 ** 62)
             self._fast.win_ms = max(1, new_second.win_ms)
@@ -2528,9 +2752,17 @@ class Sentinel:
                 batch = batch._replace(param_rules=None, param_keys=None)
             now, times = self._restamp_if_stale_locked(at_ms, now, times)
             self._drain_evictions_locked()
-            # hot-set sketch observe (tiering): dispatch-only scatter-max
-            # over this batch's rows; padding lanes are valid=False no-ops
-            self.tiering.observe_locked(batch.rows, batch.valid)
+            # hot-set sketch observe (tiering): single-dispatch engines
+            # fuse the scatter-max INTO the decide program below (round
+            # 16 — the sketch rides as a donated operand); the legacy
+            # standalone dispatch stays as the disabled/fallback path.
+            # Padding lanes are valid=False no-ops either way.
+            sd_sketch = (self.tiering.sketch_for_fuse_locked()
+                         if self._single_dispatch else None)
+            observed = False
+            if sd_sketch is None:
+                observed = self.tiering.observe_locked(batch.rows,
+                                                       batch.valid)
             self._seen_idx = max(self._seen_idx,
                                  self.spec.second.index_of(now))
             # static occupy variant: the occupy-aware pipeline runs only
@@ -2573,12 +2805,25 @@ class Sentinel:
                 # whole-batch demotion to the sorted path
                 flags["fast_flow"] = True
                 flags["scalar_has_rl"] = self._scalar_has_rl
-            self._warm_first_fetch_locked(decide, batch, times, sys_scalars,
-                                          flags, trace_id=tr)
-            with obs.annotate("sentinel_tpu.decide"):
-                state, verdicts = decide(
-                    self._ruleset, self._state, batch, times, sys_scalars,
-                    **flags)
+            if sd_sketch is not None:
+                dec_sd = self._sd_steps_locked()["decide"][
+                    (2 if no_alt_rows else 0) + (1 if use_occ else 0)]
+                self._warm_sd_first_fetch_locked(
+                    dec_sd, batch, sd_sketch, times, sys_scalars, flags,
+                    trace_id=tr)
+                with obs.annotate("sentinel_tpu.decide"):
+                    state, verdicts, new_sketch = dec_sd(
+                        self._ruleset, self._state, sd_sketch, batch,
+                        times, sys_scalars, **flags)
+                self.tiering.set_sketch_locked(new_sketch)
+            else:
+                self._warm_first_fetch_locked(decide, batch, times,
+                                              sys_scalars, flags,
+                                              trace_id=tr)
+                with obs.annotate("sentinel_tpu.decide"):
+                    state, verdicts = decide(
+                        self._ruleset, self._state, batch, times,
+                        sys_scalars, **flags)
             self._state = state
             # breaker observers: ride the existing readback (seq taken
             # under the dispatch lock so diffs land in dispatch order)
@@ -2605,6 +2850,10 @@ class Sentinel:
                 obs.counters.add(obs_keys.ROUTE_SORTFREE)
             if self.mesh is not None:
                 obs.counters.add(obs_keys.ROUTE_MESHED)
+            obs.counters.add(obs_keys.PIPE_DISPATCH,
+                             2 if observed else 1)
+            if sd_sketch is not None:
+                obs.counters.add(obs_keys.ROUTE_SINGLE_DISPATCH)
             t_disp = obs.spans.now_ns()
             if tr:
                 obs.spans.record(tr, "decide.dispatch", t_d0, t_disp, n=n,
@@ -2679,6 +2928,36 @@ class Sentinel:
         self._warm_first_fetch_key_locked(
             program_key("decide", id(dec), (b,), flags), _attempt,
             f"decide step (B={b})", trace_id, b)
+
+    def _warm_sd_first_fetch_locked(self, dec_sd, batch, sketch, times,
+                                    sys_scalars, flags,
+                                    trace_id: int = 0) -> None:
+        """:meth:`_warm_first_fetch_locked` for the sketch-fused decide
+        step (round 16). Distinct cache kind (``decide_sd``): the fused
+        program has an extra donated sketch operand and a third output,
+        so it is a different executable from the plain decide step. The
+        throwaway execution feeds ``jnp.zeros_like(sketch)`` — the real
+        table is live engine state and the step donates its sketch
+        argument."""
+        from sentinel_tpu.core.compile_cache import program_key
+        b = int(batch.rows.shape[0])
+
+        def _attempt():
+            throwaway = init_state(self.spec, self.cfg.max_flow_rules,
+                                   self.cfg.max_degrade_rules)
+            warm = self._place_batch(
+                batch._replace(valid=np.zeros(b, np.bool_)))
+            warm_sketch = jnp.zeros_like(sketch)
+            if self.mesh is not None:
+                throwaway = jax.tree.map(jax.device_put, throwaway,
+                                         self._mesh_shardings[0])
+            return jax.block_until_ready(
+                dec_sd(self._ruleset, throwaway, warm_sketch, warm, times,
+                       sys_scalars, **flags))
+
+        self._warm_first_fetch_key_locked(
+            program_key("decide_sd", id(dec_sd), (b,), flags), _attempt,
+            f"sketch-fused decide step (B={b})", trace_id, b)
 
     def _warm_first_fetch_key_locked(self, key, attempt, what: str,
                                      trace_id: int, n: int) -> None:
@@ -2859,9 +3138,17 @@ class Sentinel:
                 bg = bg._replace(param_rules=None, param_keys=None)
             self._drain_evictions_locked()
             # hot-set sketch observe (tiering): both split halves carry
-            # real traffic rows; padding lanes are valid=False no-ops
-            self.tiering.observe_locked(bs.rows, bs.valid)
-            self.tiering.observe_locked(bg.rows, bg.valid)
+            # real traffic rows; padding lanes are valid=False no-ops.
+            # Single-dispatch mode (round 16) folds the observe into each
+            # sub-step instead — the sketch threads through both halves.
+            sd_sketch = (self.tiering.sketch_for_fuse_locked()
+                         if self._single_dispatch else None)
+            observed = 0
+            if sd_sketch is None:
+                observed += int(self.tiering.observe_locked(bs.rows,
+                                                            bs.valid))
+                observed += int(self.tiering.observe_locked(bg.rows,
+                                                            bg.valid))
             self._seen_idx = max(self._seen_idx,
                                  self.spec.second.index_of(now))
             flags = {"skip_auth": self._skip_auth,
@@ -2892,15 +3179,40 @@ class Sentinel:
                 dec_s = self._jit_decide_noalt
                 dec_g = (self._jit_decide_noalt if no_alt_g
                          else self._jit_decide)
-            self._warm_first_fetch_locked(dec_s, bs, times, sys_scalars,
-                                          fl_s, trace_id=tr)
-            self._warm_first_fetch_locked(dec_g, bg, times, sys_scalars,
-                                          fl_g, trace_id=tr)
-            with obs.annotate("sentinel_tpu.decide_split"):
-                state, v1 = dec_s(self._ruleset, self._state, bs, times,
-                                  sys_scalars, **fl_s)
-                state, v2 = dec_g(self._ruleset, state, bg, times,
-                                  sys_scalars, **fl_g)
+            if sd_sketch is not None:
+                # sketch-fused sub-steps: the scalar half is always the
+                # noalt variant (origin-free by construction), the
+                # general half keys off its own no_alt_g
+                sd_steps = self._sd_steps_locked()["decide"]
+                dec_s_sd = sd_steps[2 + (1 if use_occ else 0)]
+                dec_g_sd = sd_steps[(2 if no_alt_g else 0)
+                                    + (1 if use_occ else 0)]
+                self._warm_sd_first_fetch_locked(
+                    dec_s_sd, bs, sd_sketch, times, sys_scalars, fl_s,
+                    trace_id=tr)
+                self._warm_sd_first_fetch_locked(
+                    dec_g_sd, bg, sd_sketch, times, sys_scalars, fl_g,
+                    trace_id=tr)
+                with obs.annotate("sentinel_tpu.decide_split"):
+                    state, v1, sd_sk1 = dec_s_sd(
+                        self._ruleset, self._state, sd_sketch, bs, times,
+                        sys_scalars, **fl_s)
+                    state, v2, sd_sk2 = dec_g_sd(
+                        self._ruleset, state, sd_sk1, bg, times,
+                        sys_scalars, **fl_g)
+                self.tiering.set_sketch_locked(sd_sk2)
+            else:
+                self._warm_first_fetch_locked(dec_s, bs, times,
+                                              sys_scalars, fl_s,
+                                              trace_id=tr)
+                self._warm_first_fetch_locked(dec_g, bg, times,
+                                              sys_scalars, fl_g,
+                                              trace_id=tr)
+                with obs.annotate("sentinel_tpu.decide_split"):
+                    state, v1 = dec_s(self._ruleset, self._state, bs,
+                                      times, sys_scalars, **fl_s)
+                    state, v2 = dec_g(self._ruleset, state, bg, times,
+                                      sys_scalars, **fl_g)
             self._state = state
             brk = None
             if self._breaker_observers:
@@ -2916,6 +3228,10 @@ class Sentinel:
         if obs_on:
             if "sortfree" in flags:
                 obs.counters.add(obs_keys.ROUTE_SORTFREE)
+            # two sub-dispatches plus any legacy standalone observes;
+            # split never earns split_route.single_dispatch (it is a
+            # two-program route by definition)
+            obs.counters.add(obs_keys.PIPE_DISPATCH, 2 + observed)
             t_disp = obs.spans.now_ns()
             if tr:
                 obs.spans.record(tr, "split.dispatch", t_d0, t_disp, n=n,
@@ -3054,8 +3370,16 @@ class Sentinel:
         with self._lock:
             now, times = self._restamp_if_stale_locked(at_ms, now, times)
             self._drain_evictions_locked()
-            # hot-set sketch observe (tiering): see decide_raw_nowait
-            self.tiering.observe_locked(batch.rows, batch.valid)
+            # hot-set sketch observe (tiering): see decide_raw_nowait.
+            # Single-dispatch mode (round 16) folds the observe — and any
+            # due telemetry/tiering tick epilogue — into the one fused
+            # serving program dispatched below.
+            sd_sketch = (self.tiering.sketch_for_fuse_locked()
+                         if self._single_dispatch else None)
+            observed = False
+            if sd_sketch is None:
+                observed = self.tiering.observe_locked(batch.rows,
+                                                       batch.valid)
             self._seen_idx = max(self._seen_idx,
                                  self.spec.second.index_of(now))
             if any_prio:
@@ -3065,8 +3389,7 @@ class Sentinel:
             use_occ = any_prio or now < self._occupy_live_until_ms
             # variant order mirrors the decide set: (occ,alt) =
             # (F,T),(T,T),(F,F),(T,F)
-            fused = self._jit_fused_steps[(2 if no_alt else 0)
-                                          + (1 if use_occ else 0)]
+            vidx = (2 if no_alt else 0) + (1 if use_occ else 0)
             flags = {"skip_auth": self._skip_auth,
                      "skip_sys": self._skip_sys,
                      "skip_threads": self._skip_threads}
@@ -3078,13 +3401,66 @@ class Sentinel:
             elif acq_uniform and key_fits:
                 flags["fast_flow"] = True
                 flags["scalar_has_rl"] = self._scalar_has_rl
-            self._warm_fused_first_fetch_locked(fused, batch, xbatch, times,
-                                                sys_scalars, flags,
-                                                trace_id=tr)
-            with obs.annotate("sentinel_tpu.fused"):
-                state, verdicts = fused(
-                    self._ruleset, self._state, batch, xbatch, times,
-                    sys_scalars, **flags)
+            tel_prep = None
+            tier_due = False
+            tel_outs = est = None
+            if sd_sketch is not None:
+                # consult both carry cadences under the SAME lock hold
+                # that dispatches — a claim is only made when the
+                # epilogue program below will actually run it
+                tel_prep = self.telemetry.carry_due_locked(now)
+                tier_due = self.tiering.carry_due_locked(now)
+                sd = self._sd_steps_locked()
+                if tel_prep is not None or tier_due:
+                    fused_sd = sd["fused_epi"][vidx]
+                    ring = self.telemetry.ring_for_fuse_locked()
+                    eflags = ((_EPI_TELEMETRY if tel_prep is not None
+                               else 0) | (_EPI_TIER if tier_due else 0))
+                    if tel_prep is not None:
+                        _, _, append, idx_s, sec_idx_m = tel_prep
+                    else:
+                        append = idx_s = sec_idx_m = 0
+                    epi = jnp.asarray(np.array(
+                        [eflags, idx_s, sec_idx_m, append], np.int32))
+                    self._warm_fused_sd_first_fetch_locked(
+                        fused_sd, batch, xbatch, sd_sketch, times,
+                        sys_scalars, flags, epilogue=True, trace_id=tr)
+                    with obs.annotate("sentinel_tpu.fused"):
+                        (state, verdicts, new_sketch, new_ring, tel_outs,
+                         est) = fused_sd(
+                            self._ruleset, self._state, sd_sketch, ring,
+                            epi, batch, xbatch, times, sys_scalars,
+                            **flags)
+                    self.tiering.set_sketch_locked(new_sketch)
+                    if tel_prep is not None:
+                        self.telemetry.queue_carry(tel_prep, tel_outs,
+                                                   new_ring)
+                    else:
+                        self.telemetry.set_ring_locked(new_ring)
+                        tel_outs = None
+                    if tier_due:
+                        self.tiering.queue_estimates(est)
+                    else:
+                        est = None
+                else:
+                    fused_sd = sd["fused"][vidx]
+                    self._warm_fused_sd_first_fetch_locked(
+                        fused_sd, batch, xbatch, sd_sketch, times,
+                        sys_scalars, flags, epilogue=False, trace_id=tr)
+                    with obs.annotate("sentinel_tpu.fused"):
+                        state, verdicts, new_sketch = fused_sd(
+                            self._ruleset, self._state, sd_sketch, batch,
+                            xbatch, times, sys_scalars, **flags)
+                    self.tiering.set_sketch_locked(new_sketch)
+            else:
+                fused = self._jit_fused_steps[vidx]
+                self._warm_fused_first_fetch_locked(fused, batch, xbatch,
+                                                    times, sys_scalars,
+                                                    flags, trace_id=tr)
+                with obs.annotate("sentinel_tpu.fused"):
+                    state, verdicts = fused(
+                        self._ruleset, self._state, batch, xbatch, times,
+                        sys_scalars, **flags)
             self._state = state
             brk = None
             if self._breaker_observers:
@@ -3092,6 +3468,8 @@ class Sentinel:
                 brk = (self._breaker_seq, self._deg.rules,
                        self._breaker_snapshot_locked())
         start_host_copy((verdicts.allow, verdicts.reason, verdicts.wait_ms)
+                        + (tuple(tel_outs) if tel_outs is not None else ())
+                        + ((est,) if est is not None else ())
                         + ((brk[2],) if brk else ()))
         t_disp = 0
         if obs_on:
@@ -3103,6 +3481,10 @@ class Sentinel:
             else:
                 route = obs_keys.ROUTE_GENERAL
             obs.counters.add(obs_keys.ROUTE_FUSED)
+            obs.counters.add(obs_keys.PIPE_DISPATCH,
+                             2 if observed else 1)
+            if sd_sketch is not None:
+                obs.counters.add(obs_keys.ROUTE_SINGLE_DISPATCH)
             if "sortfree" in flags:
                 obs.counters.add(obs_keys.ROUTE_SORTFREE)
             if self.mesh is not None:
@@ -3169,6 +3551,50 @@ class Sentinel:
             program_key("fused", id(fused), (b_e, b_x), flags), _attempt,
             f"fused decide+exit step (B={b_e}/{b_x})", trace_id, b_e)
 
+    def _warm_fused_sd_first_fetch_locked(self, fused_sd, batch, xbatch,
+                                          sketch, times, sys_scalars,
+                                          flags, *, epilogue: bool,
+                                          trace_id: int = 0) -> None:
+        """First-fetch guard for the sketch-fused decide+exit programs
+        (round 16). Two cache kinds — ``fused_sd`` and ``fused_sd_epi``
+        — since the epilogue variant is a different executable (extra
+        ring/epi operands, six outputs). All donated operands are fed
+        throwaways: fresh state, a zero sketch, and (epilogue) a fresh
+        ring; the zero ``epi`` flags make both cond branches take their
+        skip side, so the warm run is a no-op on service state."""
+        from sentinel_tpu.core.compile_cache import program_key
+        b_e = int(batch.rows.shape[0])
+        b_x = int(xbatch.rows.shape[0])
+
+        def _attempt():
+            throwaway = init_state(self.spec, self.cfg.max_flow_rules,
+                                   self.cfg.max_degrade_rules)
+            warm_e = self._place_batch(
+                batch._replace(valid=np.zeros(b_e, np.bool_)))
+            warm_x = self._place_batch(
+                xbatch._replace(valid=np.zeros(b_x, np.bool_)))
+            warm_sketch = jnp.zeros_like(sketch)
+            if self.mesh is not None:
+                throwaway = jax.tree.map(jax.device_put, throwaway,
+                                         self._mesh_shardings[0])
+            if epilogue:
+                from sentinel_tpu.obs.telemetry import init_ring
+                warm_ring = init_ring(self.telemetry.ring_slots)
+                warm_epi = jnp.zeros((4,), jnp.int32)
+                return jax.block_until_ready(
+                    fused_sd(self._ruleset, throwaway, warm_sketch,
+                             warm_ring, warm_epi, warm_e, warm_x, times,
+                             sys_scalars, **flags))
+            return jax.block_until_ready(
+                fused_sd(self._ruleset, throwaway, warm_sketch, warm_e,
+                         warm_x, times, sys_scalars, **flags))
+
+        kind = "fused_sd_epi" if epilogue else "fused_sd"
+        self._warm_first_fetch_key_locked(
+            program_key(kind, id(fused_sd), (b_e, b_x), flags), _attempt,
+            f"sketch-fused decide+exit step (B={b_e}/{b_x})", trace_id,
+            b_e)
+
     def exit_batch(self, *, rows, origin_rows, chain_rows, acquire, rt_ms,
                    error, is_in, param_rules=None, param_keys=None,
                    param_gen: int = -1, count_thread=None,
@@ -3234,6 +3660,8 @@ class Sentinel:
         # pin discipline: resolve→pin, decide, exit-decrement→unpin)
         if unpin is not None:
             unpin[0].unpin_rows(unpin[1])
+        if obs.enabled:
+            obs.counters.add(obs_keys.PIPE_DISPATCH)
         if tr:
             obs.spans.record(tr, "exit.dispatch", t0, obs.spans.now_ns(),
                              n=n)

@@ -225,3 +225,95 @@ class DispatchPipeline:
     def __exit__(self, *exc) -> bool:
         self.flush()
         return False
+
+
+class CadenceScheduler:
+    """One thread for both tick cadences (round 16 single-dispatch).
+
+    Replaces the two per-service ticker daemons (``telemetry.start`` +
+    ``tiering.start``). Arming the services' carry cadences lets steady
+    serving traffic run the telemetry tick and the sketch decay/estimate
+    INSIDE the fused serving dispatch (the runtime's ``lax.cond``
+    epilogue) — so under load the ticks cost zero extra dispatches. The
+    scheduler thread then only (a) drains both services' queued
+    readbacks off the engine lock and (b) self-dispatches a standalone
+    ``tick()`` for a service whose armed cadence has gone stale
+    (:data:`IDLE_FACTOR` × its interval with no batch carrying the
+    epilogue — the zero-traffic fallback), so an idle engine still
+    refreshes its hot set and decays its sketch.
+
+    ``poll()`` is the thread body and is callable directly in tests;
+    start/stop are idempotent and ``stop`` is registered with
+    ``Sentinel.register_shutdown``.
+    """
+
+    #: a carry slot is considered missed — and the scheduler
+    #: self-dispatches — after this many armed intervals without a tick
+    IDLE_FACTOR = 1.5
+
+    def __init__(self, sentinel: Sentinel,
+                 telemetry_interval_sec: float = 1.0,
+                 tiering_interval_sec: Optional[float] = None):
+        from sentinel_tpu.tiering.manager import tier_tick_ms
+        self._s = sentinel
+        if tiering_interval_sec is None:
+            tiering_interval_sec = tier_tick_ms() / 1000.0
+        self._tel_ms = max(1, int(telemetry_interval_sec * 1000))
+        self._tier_ms = max(1, int(tiering_interval_sec * 1000))
+        # drain at twice the fastest cadence so carried readbacks land
+        # with at most half an interval of extra latency
+        self._poll_s = max(0.02, min(self._tel_ms, self._tier_ms) / 2000.0)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        reg = getattr(sentinel, "register_shutdown", None)
+        if reg is not None:
+            reg(self)
+
+    def poll(self) -> int:
+        """One scheduler pass: self-dispatch any stale service's tick,
+        then drain both; → entries drained."""
+        sn = self._s
+        n = 0
+        tel = sn.telemetry
+        tier = sn.tiering
+        if tel.enabled:
+            now = sn.clock.now_ms()
+            if now - tel.last_tick_ms() >= self._tel_ms * self.IDLE_FACTOR:
+                tel.tick()
+            n += tel.drain()
+        if tier.enabled:
+            now = sn.clock.now_ms()
+            if (now - tier.last_tick_ms()
+                    >= self._tier_ms * self.IDLE_FACTOR):
+                tier.tick()
+            n += tier.drain()
+        return n
+
+    def start(self) -> None:
+        """Arm both carry cadences and start the daemon (idempotent)."""
+        if self._thread is not None:
+            return
+        self._s.telemetry.arm_carry(self._tel_ms)
+        self._s.tiering.arm_carry(self._tier_ms)
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self._poll_s):
+                try:
+                    self.poll()
+                except Exception:  # pragma: no cover — keep daemon alive
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="sentinel-cadence")
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Disarm the carries and join the daemon (idempotent; the
+        services' own registered stops handle their final drains)."""
+        self._s.telemetry.disarm_carry()
+        self._s.tiering.disarm_carry()
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None

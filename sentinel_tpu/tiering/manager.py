@@ -180,6 +180,11 @@ class TierManager:
         self._sketch_update = None
         self._ticks = 0
         self._last_est: Optional[np.ndarray] = None
+        # round 16 — epilogue carry cadence: when armed (CadenceScheduler,
+        # serving.py), serving traffic runs the decay+estimate inside the
+        # fused dispatch and the ticker only self-dispatches on idle gaps
+        self._carry_ms: Optional[int] = None
+        self._last_tick_ms = int(sentinel.clock.now_ms())
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._closed = False
@@ -260,18 +265,80 @@ class TierManager:
 
     # ---- engine-lock hooks -------------------------------------------
 
-    def observe_locked(self, rows_dev, valid_dev) -> None:
+    def observe_locked(self, rows_dev, valid_dev) -> bool:
         """Sketch update from a decide batch's device row array —
         dispatch-only (conservative-update count-min; see sketch.py).
         The update op halves the table inside the jit when an estimate
         crosses the overflow cap, so counters stay bounded even on an
         engine that never starts the ticker; the flag is dropped here
         (syncing it would stall the decide) and the overflow COUNTER is
-        ticked host-side from the ticker's estimate readback."""
+        ticked host-side from the ticker's estimate readback.
+
+        Round 16: this standalone dispatch is the DISABLED/FALLBACK path
+        — with ``SENTINEL_SINGLE_DISPATCH`` on, the runtime fuses the
+        identical :func:`sketch.update_sketch` into the decide program
+        (see :meth:`sketch_for_fuse_locked`) and never calls this.
+        Returns whether a dispatch was actually issued (the runtime's
+        ``pipeline.dispatches`` accounting)."""
         if self._sketch is None:
-            return
+            return False
         self._sketch, _overflow = self._sketch_update(
             self._sketch, rows_dev, valid_dev)
+        return True
+
+    # ---- round 16: single-dispatch fusion surface ---------------------
+
+    def sketch_for_fuse_locked(self):
+        """Engine lock held: the sketch table to thread through a
+        sketch-fused decide dispatch, or None when tiering (or its
+        sketch) is off — None tells the runtime to fall back to the
+        legacy program + :meth:`observe_locked` composition."""
+        if not self.enabled or self._closed:
+            return None
+        return self._sketch
+
+    def set_sketch_locked(self, sketch) -> None:
+        """Engine lock held: store the donated-output sketch returned by
+        a sketch-fused dispatch."""
+        self._sketch = sketch
+
+    def arm_carry(self, interval_ms: int) -> None:
+        """Let serving traffic carry the decay+estimate tick inside the
+        fused dispatch at this cadence (CadenceScheduler, serving.py)."""
+        with self._lock:
+            self._carry_ms = max(1, int(interval_ms))
+            self._last_tick_ms = int(self._sentinel.clock.now_ms())
+
+    def disarm_carry(self) -> None:
+        with self._lock:
+            self._carry_ms = None
+
+    def last_tick_ms(self) -> int:
+        with self._lock:
+            return self._last_tick_ms
+
+    def carry_due_locked(self, now_ms: int) -> bool:
+        """Engine lock held: claim one carried tick if the cadence is
+        armed and due. The claim updates ``_last_tick_ms`` immediately —
+        the caller dispatches the epilogue in the same lock hold, so a
+        concurrent self-dispatch fallback won't double-tick."""
+        if (not self.enabled or self._closed or self._sketch is None):
+            return False
+        with self._lock:
+            if (self._carry_ms is None
+                    or now_ms - self._last_tick_ms < self._carry_ms):
+                return False
+            self._last_tick_ms = int(now_ms)
+            return True
+
+    def queue_estimates(self, est) -> None:
+        """Queue an epilogue-carried estimate readback (engine lock
+        held; the host copy was started by the runtime). Counted as a
+        tick — :meth:`drain` lands it exactly like a self-dispatched
+        one."""
+        with self._lock:
+            self._est_q.append(est)
+            self._ticks += 1
 
     def pre_invalidate_locked(self, evicted: List[int], now_ms: int) -> None:
         """Demote snapshot: gather the evicted rows' state BEFORE the
@@ -569,9 +636,12 @@ class TierManager:
         with sn._lock:
             self._sketch, est = sk.jit_tick_read(sn.spec.rows)(self._sketch)
         start_host_copy((est,))
+        if self._obs.enabled:
+            self._obs.counters.add(obs_keys.PIPE_DISPATCH)
         with self._lock:
             self._est_q.append(est)
             self._ticks += 1
+            self._last_tick_ms = int(sn.clock.now_ms())
         return True
 
     def drain(self) -> int:

@@ -168,13 +168,23 @@ def jit_update(impl: str = DEFAULT_IMPL):
     return jax.jit(functools.partial(update_sketch, impl=impl))
 
 
+def tick_read(counts: jnp.ndarray, n_rows: int
+              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One ticker read as pure math: decay, then estimate every row.
+
+    Shared verbatim by the self-dispatched ticker (:func:`jit_tick_read`)
+    and the round-16 single-dispatch epilogue (the ``lax.cond`` branch
+    runtime._build_sd_steps traces into the fused serving program) — one
+    definition is what makes the carried estimates bit-identical to the
+    standalone tick's."""
+    counts = decay_sketch(counts)
+    return counts, estimate_all(counts, n_rows)
+
+
 @functools.lru_cache(maxsize=None)
 def jit_tick_read(n_rows: int):
     """Fused ticker read: decay then estimate every row (fresh buffers)."""
-    def _read(counts):
-        counts = decay_sketch(counts)
-        return counts, estimate_all(counts, n_rows)
-    return jax.jit(_read)
+    return jax.jit(functools.partial(tick_read, n_rows=n_rows))
 
 
 _jit_halve = jax.jit(halve_sketch)

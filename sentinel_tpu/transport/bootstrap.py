@@ -29,12 +29,19 @@ class TransportRuntime:
     cluster_state: ClusterModeState
     port: int
     metric_timer: Optional[object] = None
+    cadence: Optional[object] = None    # serving.CadenceScheduler (r16)
 
     def stop(self) -> None:
         if self.heartbeat is not None:
             self.heartbeat.stop()
         if self.metric_timer is not None:
             self.metric_timer.stop()
+        if self.cadence is not None:
+            # join the cadence daemon here, not just at Sentinel.close():
+            # embedders that stop the transport without closing the
+            # engine must not leave a device-dispatching thread running
+            # into interpreter teardown
+            self.cadence.stop()
         self.http.stop()
 
 
@@ -60,6 +67,7 @@ def start_transport(sentinel, *, host: str = "0.0.0.0", port: int = 8719,
     center = CommandCenter()
     extra: dict = {}
     metric_timer = None
+    cadence = None
     if metric_searcher is None and metric_log:
         from sentinel_tpu.metrics.searcher import MetricSearcher
         from sentinel_tpu.metrics.timer import MetricTimerListener
@@ -80,14 +88,20 @@ def start_transport(sentinel, *, host: str = "0.0.0.0", port: int = 8719,
             obs.flight.configure(sentinel.cfg.metric_dir(),
                                  sentinel.cfg.app_name)
         # hot-resource telemetry (obs/telemetry.py): top-K second lines
-        # ride the same rotation as <app>-metric; the telemetry ticker is
-        # its own thread (device tick + async readback must overlap the
-        # dispatch pipeline, not serialize behind metric_timer.tick())
+        # ride the same rotation as <app>-metric. Since round 16 the
+        # telemetry + tiering cadences share ONE CadenceScheduler thread
+        # (serving.py): it arms both services' epilogue carries so
+        # steady serving traffic runs the ticks inside the fused
+        # dispatch, and only self-dispatches on idle gaps. Its drains
+        # still overlap the dispatch pipeline rather than serializing
+        # behind metric_timer.tick(). Stops via register_shutdown.
         telemetry = getattr(sentinel, "telemetry", None)
         if telemetry is not None and telemetry.enabled:
             telemetry.configure(sentinel.cfg.metric_dir(),
                                 sentinel.cfg.app_name)
-            telemetry.start()
+            from sentinel_tpu.serving import CadenceScheduler
+            cadence = CadenceScheduler(sentinel)
+            cadence.start()
     cstate = register_default_handlers(
         center, sentinel, metric_searcher=metric_searcher,
         extra_info=extra, writable_registry=writable_registry,
@@ -118,4 +132,4 @@ def start_transport(sentinel, *, host: str = "0.0.0.0", port: int = 8719,
         hb.start()
     return TransportRuntime(center=center, http=http, heartbeat=hb,
                             cluster_state=cstate, port=bound,
-                            metric_timer=metric_timer)
+                            metric_timer=metric_timer, cadence=cadence)

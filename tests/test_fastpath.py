@@ -29,7 +29,8 @@ def make(clk, **over):
 
 def _count_decides(sph):
     """Wrap the jitted decide steps (all four static variants: occupy ×
-    alt-free) to count device dispatches."""
+    alt-free, plus the round-16 sketch-fused set) to count device
+    dispatches."""
     counter = {"n": 0}
 
     def wrap(fn):
@@ -41,6 +42,15 @@ def _count_decides(sph):
     for attr in ("_jit_decide", "_jit_decide_prio",
                  "_jit_decide_noalt", "_jit_decide_prio_noalt"):
         setattr(sph, attr, wrap(getattr(sph, attr)))
+
+    orig_sd = sph._sd_steps_locked
+
+    def sd_wrapped():
+        steps = orig_sd()
+        return dict(steps,
+                    decide=tuple(wrap(f) for f in steps["decide"]))
+
+    sph._sd_steps_locked = sd_wrapped
     return counter
 
 

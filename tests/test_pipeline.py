@@ -539,6 +539,18 @@ def test_runtime_selects_alt_free_variant(clk):
         return inner
     sph._jit_decide_noalt = w(orig_noalt, "noalt")
     sph._jit_decide = w(orig_full, "full")
+    # with SENTINEL_SINGLE_DISPATCH on (the default) the dispatch goes
+    # through the sketch-fused tuple instead — same variant layout:
+    # indices 0/1 carry alt recording, 2/3 are the *_noalt pair
+    orig_sd = sph._sd_steps_locked
+
+    def sd_wrapped():
+        steps = orig_sd()
+        d = steps["decide"]
+        return dict(steps, decide=(w(d[0], "full"), w(d[1], "full"),
+                                   w(d[2], "noalt"), w(d[3], "noalt")))
+
+    sph._sd_steps_locked = sd_wrapped
     with sph.entry("plain"):
         pass
     assert hits == {"noalt": 1, "full": 0}

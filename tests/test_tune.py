@@ -317,7 +317,7 @@ def test_registry_matches_runtime_clamps(monkeypatch):
     from sentinel_tpu.ops.sortfree import chunk_size, table_bits
     from sentinel_tpu.runtime import (
         donation_enabled, host_staging_enabled, pipeline_depth,
-        sortfree_enabled,
+        single_dispatch_enabled, sortfree_enabled,
     )
     from sentinel_tpu.tiering.manager import (
         tier_hot_rows, tier_sketch_bits, tier_sketch_rows, tier_tick_ms,
@@ -351,6 +351,7 @@ def test_registry_matches_runtime_clamps(monkeypatch):
         "SENTINEL_DONATE": donation_enabled,
         "SENTINEL_HOST_STAGING": host_staging_enabled,
         "SENTINEL_SORTFREE": sortfree_enabled,
+        "SENTINEL_SINGLE_DISPATCH": single_dispatch_enabled,
     }
     for env, helper in booleans.items():
         spec = knobs_mod.KNOB_BY_ENV[env]
