@@ -60,6 +60,10 @@ KNOB_ENVS = (
     "SENTINEL_TIER_TICK_MS", "SENTINEL_TIERING_DISABLE",
     "SENTINEL_TIER_COLD_MAX",
     "SENTINEL_SINGLE_DISPATCH",
+    "SENTINEL_CONTROL_DISABLE", "SENTINEL_CONTROL_INTERVAL_MS",
+    "SENTINEL_CONTROL_P99_HI_MS", "SENTINEL_CONTROL_P99_LO_MS",
+    "SENTINEL_CONTROL_MIN_ADMIT", "SENTINEL_CONTROL_COOLDOWN_MS",
+    "SENTINEL_CONTROL_DEGRADE_RT_MS",
     "SERVING_DURATION_MS", "SERVING_RATE", "SERVING_SEED",
 )
 
@@ -76,6 +80,13 @@ def _rules_for(stpu, name: str):
                               count=1e9) for i in range(16)]
     if name == "flash_crowd":
         generous = [stpu.FlowRule(resource=f"flash/{i}", count=1e9)
+                    for i in range(16)]
+        generous.append(stpu.FlowRule(resource="flash/hot", count=300.0))
+    elif name == "overload_episode":
+        # the composite carries three prefixes; the flash hot key keeps
+        # its tight rule so the spike exercises BLOCK, not just queueing
+        generous = [stpu.FlowRule(resource=f"{p}/{i}", count=1e9)
+                    for p in ("steady", "flash", "slow")
                     for i in range(16)]
         generous.append(stpu.FlowRule(resource="flash/hot", count=300.0))
     elif name == "priority_mix":
@@ -117,13 +128,20 @@ def run_workload(name: str, *, seed: int = DEFAULT_SEED,
                  budget_ms: int = 3, idle_ms: float = 1.0,
                  depth: int = 2, queue_max: Optional[int] = None,
                  wl_kwargs: Optional[dict] = None,
-                 trace_dir: Optional[str] = None) -> Dict:
+                 trace_dir: Optional[str] = None,
+                 control: bool = False,
+                 control_kwargs: Optional[dict] = None) -> Dict:
     """Replay one zoo workload open-loop through a fresh Sentinel +
     AdaptiveBatcher; returns the per-workload metrics dict.
 
     ``trace_dir`` attaches the SLO flight recorder's rolling
     ``<workload>-trace`` log there (obs/flight.py) — what ci_gate's
-    trace-capture probe reads back with ``load_pinned``."""
+    trace-capture probe reads back with ``load_pinned``.
+
+    ``control=True`` attaches a round-17 overload ControlLoop
+    (``control_kwargs`` → its constructor: interval_ms, config, seed);
+    it rides the CadenceScheduler daemon and its snapshot lands under
+    the ``control`` key of the result."""
     import sentinel_tpu as stpu
     from sentinel_tpu.frontend import AdaptiveBatcher, IngestOverload
     from sentinel_tpu.frontend.workloads import make as make_workload
@@ -150,18 +168,36 @@ def run_workload(name: str, *, seed: int = DEFAULT_SEED,
     # (k) and (m).
     telem = getattr(sph, "telemetry", None)
     from sentinel_tpu.serving import CadenceScheduler
+    ctl = None
+    if control:
+        from sentinel_tpu.control import ControlLoop
+        ctl = ControlLoop(sph, **(control_kwargs or {}))
     CadenceScheduler(sph, telemetry_interval_sec=1.0).start()
 
     lat = LogHistogram()
     stats = {"shed": 0, "allowed": 0, "blocked": 0, "deadline_miss": 0}
     worst = {"ns": -1, "trace": 0}      # worst-latency request + trace id
+    # per-prefix (tenant) breakdown: the controller gate scores the
+    # steady tenant's latency separately from the abusive streams
+    by_prefix: Dict[str, Dict] = {}
     deadline_ns = deadline_ms * 1e6
+
+    def _prefix_slot(resource: str) -> Dict:
+        p = resource.split("/", 1)[0]
+        slot = by_prefix.get(p)
+        if slot is None:
+            slot = by_prefix[p] = {"offered": 0, "shed": 0,
+                                   "completed": 0, "deadline_miss": 0,
+                                   "hist": LogHistogram()}
+        return slot
 
     async def replay() -> None:
         batcher = AdaptiveBatcher(
             sph, batch_max=batch_max, deadline_ms=deadline_ms,
             budget_ms=budget_ms, idle_ms=idle_ms, depth=depth,
             queue_max=queue_max)
+        if ctl is not None:
+            ctl.bind_batcher(batcher)
         loop = asyncio.get_running_loop()
         t_start = loop.time()
 
@@ -169,6 +205,8 @@ def run_workload(name: str, *, seed: int = DEFAULT_SEED,
             delay = t_start + r.t_ms / 1000.0 - loop.time()
             if delay > 0:
                 await asyncio.sleep(delay)
+            slot = _prefix_slot(r.resource)
+            slot["offered"] += 1
             t0 = time.perf_counter_ns()
             try:
                 v = await batcher.submit(r.resource, count=r.count,
@@ -176,11 +214,15 @@ def run_workload(name: str, *, seed: int = DEFAULT_SEED,
                                          origin=r.origin)
             except IngestOverload:
                 stats["shed"] += 1
+                slot["shed"] += 1
                 return
             dt = time.perf_counter_ns() - t0
             lat.record(dt)
+            slot["completed"] += 1
+            slot["hist"].record(dt)
             if dt > deadline_ns:
                 stats["deadline_miss"] += 1
+                slot["deadline_miss"] += 1
             if dt > worst["ns"]:
                 worst["ns"], worst["trace"] = dt, v.trace_id
             stats["allowed" if v.allow else "blocked"] += 1
@@ -236,7 +278,18 @@ def run_workload(name: str, *, seed: int = DEFAULT_SEED,
                 + c.get(obs_keys.FE_FLUSH_IDLE)) else None),
         "decisions_per_s": (sph.obs.hist_request.count
                             / (duration_ms / 1e3) if duration_ms else 0.0),
+        "by_prefix": {
+            p: {"offered": s["offered"], "shed": s["shed"],
+                "completed": s["completed"],
+                "deadline_miss": s["deadline_miss"],
+                "p50_ms": s["hist"].percentile_ms(0.50),
+                "p95_ms": s["hist"].percentile_ms(0.95),
+                "p99_ms": s["hist"].percentile_ms(0.99)}
+            for p, s in sorted(by_prefix.items())},
     }
+    if ctl is not None:
+        out["control"] = ctl.snapshot(limit=64)
+        out["control_dropped"] = c.get(obs_keys.CONTROL_DROPPED)
     if telem is not None and telem.enabled:
         telem.poll()                     # land anything still in flight
         tsnap = telem.snapshot()
@@ -277,6 +330,11 @@ ZOO: Dict[str, dict] = {
     # deliberately small queue bound: the backpressure probe must SHED
     "slow_consumer": {"queue_max": 512,
                       "wl_kwargs": {"burst_mult": 16.0}},
+    # round 17 — the controller episode: steady tenant + flash crowd +
+    # slow-consumer bursts with the ControlLoop attached (its actions
+    # and the per-tenant breakdown land in the artifact)
+    "overload_episode": {"control": True, "queue_max": 1024,
+                         "control_kwargs": {"interval_ms": 100}},
 }
 
 

@@ -1,0 +1,21 @@
+"""Self-driving overload protection (round 17).
+
+A closed loop from device telemetry to the frontend: the policy core
+(:mod:`~sentinel_tpu.control.policy`) turns the per-second telemetry
+timeline, the rolling request-latency histogram, and the ingest queue
+depth into typed actions under AIMD with hysteresis and per-action
+cooldowns; the actuators (:mod:`~sentinel_tpu.control.actuators`) apply
+them through existing runtime-scope seams only (frontend admission
+fraction, online batcher retune, forced breaker transitions); and
+:class:`~sentinel_tpu.control.loop.ControlLoop` runs the cycle on the
+round-16 :class:`~sentinel_tpu.serving.CadenceScheduler` daemon,
+pinning every action + its triggering evidence into the flight
+recorder. See docs/OPERATIONS.md "Self-driving overload protection".
+"""
+
+from sentinel_tpu.control.policy import (           # noqa: F401
+    Degrade, HistDeltaP99, Observation, OverloadPolicy, PolicyConfig,
+    RetuneBatcher, ShedRate, WindowedFilter, action_kind)
+from sentinel_tpu.control.actuators import Actuators  # noqa: F401
+from sentinel_tpu.control.loop import (               # noqa: F401
+    CONTROL_DISABLE_ENV, ControlLoop, control_disabled)

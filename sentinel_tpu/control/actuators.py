@@ -1,0 +1,73 @@
+"""Action application through existing runtime-scope seams ONLY.
+
+No new engine surface: :class:`ShedRate` rides the round-17
+``IngestQueue.set_admission`` gate (requests drop BEFORE batches form,
+deterministically — the drop pattern is a pure function of the seed and
+arrival index, so replays shed identically);
+:class:`RetuneBatcher` rides ``AdaptiveBatcher.retune`` (host-side
+policy state, no retrace); :class:`Degrade` rides
+``Sentinel.force_breaker`` (the device kernels evolve the forced slot
+normally afterwards). Every apply returns a human-readable note — the
+evidence string the loop pins into the flight recorder alongside the
+triggering observation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from sentinel_tpu.control import policy as pol
+from sentinel_tpu.rules import degrade as deg_mod
+
+_BREAKER_STATE = {
+    pol.DEG_OPEN: deg_mod.STATE_OPEN,
+    pol.DEG_HALF_OPEN: deg_mod.STATE_HALF_OPEN,
+    pol.DEG_CLOSE: deg_mod.STATE_CLOSED,
+}
+
+
+class Actuators:
+    """Bound to one Sentinel (+ optionally its frontend batcher).
+
+    ``seed`` feeds the deterministic admission hash; captured once so
+    every :class:`ShedRate` of a run draws from the same stream."""
+
+    def __init__(self, sentinel, batcher=None, *, seed: int = 0):
+        self._s = sentinel
+        self._b = batcher
+        self.seed = int(seed)
+
+    @property
+    def batcher(self):
+        return self._b
+
+    def bind_batcher(self, batcher) -> None:
+        """Late-bind the frontend (it is often constructed after the
+        engine); shed/retune actions are no-ops until bound."""
+        self._b = batcher
+
+    def apply(self, action) -> Optional[str]:
+        """Apply one typed action; → evidence note, or None when the
+        action had no seam to land on (no batcher bound / unknown
+        resource) — the loop counts but does not pin those."""
+        if isinstance(action, pol.ShedRate):
+            b = self._b
+            if b is None:
+                return None
+            b.queue.set_admission(action.frac, seed=self.seed)
+            return f"admit_frac={action.frac:.3f} seed={self.seed}"
+        if isinstance(action, pol.RetuneBatcher):
+            b = self._b
+            if b is None:
+                return None
+            b.retune(budget_ms=action.budget_ms,
+                     batch_cap=action.batch_cap)
+            return (f"budget_ms={b.budget_ms} "
+                    f"batch_cap={b.queue.batch_max}")
+        if isinstance(action, pol.Degrade):
+            ok = self._s.force_breaker(
+                action.resource, _BREAKER_STATE[action.transition])
+            if not ok:
+                return None
+            return f"{action.resource}->{action.transition}"
+        raise TypeError(f"unknown control action {action!r}")

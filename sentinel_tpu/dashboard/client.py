@@ -128,6 +128,18 @@ class SentinelApiClient:
             params["tick"] = "1"
         return json.loads(self._get(ip, port, "topk", params) or "{}")
 
+    def fetch_control(self, ip: str, port: int,
+                      actions: int = 32, tick: bool = False
+                      ) -> Dict[str, Any]:
+        """Overload-controller snapshot (``control`` command —
+        control/loop.py): admission fraction, estimator extrema, degrade
+        trackers, the last observation, and the applied-action tail.
+        ``tick=True`` runs one observe/decide/apply cycle inline first."""
+        params = {"actions": str(actions)}
+        if tick:
+            params["tick"] = "1"
+        return json.loads(self._get(ip, port, "control", params) or "{}")
+
     def fetch_trace(self, ip: str, port: int,
                     trace_id: str = "") -> Dict[str, Any]:
         """Request-scoped trace export (``trace`` command): with an id, a

@@ -513,6 +513,17 @@ class _Handler(BaseHTTPRequestHandler):
             except AgentUnreachable as exc:
                 self._json(_fail(str(exc)))
             return
+        if method == "GET" and path == "/obs/control.json":
+            # overload-controller state + applied-action audit tail
+            # (control/loop.py via the agent's ``control`` command)
+            try:
+                self._json(_ok(d.client.fetch_control(
+                    q.get("ip", ""), int(q.get("port", "0") or 0),
+                    actions=int(q.get("actions", "32") or 32),
+                    tick=q.get("tick", "") in ("1", "true"))))
+            except AgentUnreachable as exc:
+                self._json(_fail(str(exc)))
+            return
         if method == "GET" and path == "/obs/traces.json":
             # request-scoped tracing: ?id= proxies one causal chain as a
             # Chrome-trace-event document; without id, the flight

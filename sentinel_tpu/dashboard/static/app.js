@@ -84,7 +84,7 @@ const VIEW_TITLES = {
   metrics: "Realtime Metrics", resources: "Resource View",
   machines: "Machine List", cluster: "Cluster Management",
   tree: "Node Tree", telemetry: "Runtime Telemetry",
-  hotkeys: "Hot Resources",
+  hotkeys: "Hot Resources", control: "Overload Control",
   flow: "Flow Rules", degrade: "Degrade Rules", paramFlow: "Param Flow Rules",
   system: "System Rules", authority: "Authority Rules",
   gatewayFlow: "Gateway Flow Rules", gatewayApi: "API Definitions",
@@ -130,6 +130,7 @@ function renderSidebar() {
   const menu = [["metrics", "Realtime Metrics"], ["resources", "Resource View"],
                 ["tree", "Node Tree"], ["telemetry", "Telemetry"],
                 ["hotkeys", "Hot Resources"],
+                ["control", "Overload Control"],
                 ["machines", "Machine List"], ["cluster", "Cluster"]];
   navEl.appendChild(h("h4", {}, "Monitor"));
   for (const [v, label] of menu) {
@@ -159,6 +160,7 @@ function render() {
   if (S.view === "tree") return viewTree(c);
   if (S.view === "telemetry") return viewTelemetry(c);
   if (S.view === "hotkeys") return viewHotKeys(c);
+  if (S.view === "control") return viewControl(c);
   return viewRules(c, S.view);
 }
 
@@ -605,6 +607,98 @@ async function viewHotKeys(c) {
               h("td", { class: "num" }, Number(e.rt_sum).toFixed(1)),
             ])))])
         : h("span", { class: "dim" }, "no timeline seconds yet"),
+    ]));
+  }
+  await refresh();
+  setRefresh(refresh, 5000);
+}
+
+// ------------------------------------------------------------------ control
+// Overload-controller state + audit trail (agent `control` command →
+// /obs/control.json): admission fraction, estimator extrema, degrade
+// trackers, and the applied-action tail with evidence (control/loop.py).
+async function viewControl(c) {
+  await loadMachines();
+  const sel = machineSelector(() => refresh());
+  const body = h("div", {});
+  c.appendChild(h("div", { class: "card" }, [
+    h("h3", {}, [h("span", {}, `Overload Control — ${S.app}`),
+                 h("span", { class: "toolbar" }, [
+                   h("span", { class: "sub" }, "machine"), sel])]),
+    body,
+  ]));
+  async function refresh() {
+    if (!S.machineSel) {
+      body.innerHTML = "";
+      body.appendChild(h("span", { class: "dim" }, "no healthy machine"));
+      return;
+    }
+    const [ip, port] = S.machineSel.split(":");
+    const j = await api(`/obs/control.json?ip=${ip}&port=${port}&actions=32`);
+    body.innerHTML = "";
+    if (!j || !j.success) {
+      body.appendChild(h("span", { class: "bad" },
+        j ? j.msg + " (no controller attached on this agent?)" : "error"));
+      return;
+    }
+    const d = j.data || {};
+    if (!d.enabled) {
+      body.appendChild(h("span", { class: "dim" },
+        "controller disabled on this agent (SENTINEL_CONTROL_DISABLE)"));
+      return;
+    }
+    const p = d.policy || {};
+    const ob = d.last_obs;
+    body.appendChild(h("span", { class: "sub" },
+      `interval ${d.interval_ms}ms · ticks ${d.ticks} · ` +
+      `actions ${d.total_actions} · admit ` +
+      `${(100 * (p.admit_frac == null ? 1 : p.admit_frac)).toFixed(1)}%` +
+      (p.degraded_batcher ? " · batcher retuned" : "")));
+    if (ob) {
+      body.appendChild(h("div", { class: "card" }, [
+        h("h3", {}, [h("span", {}, "Last observation"),
+          h("span", { class: "sub" },
+            "interval p99 from the rolling request histogram; " +
+            "rate/RT extrema are windowed estimates")]),
+        h("table", {}, [h("thead", {}, h("tr", {},
+            ["p99 (ms)", "rt avg (ms)", "pass/s", "block/s", "queue",
+             "max rate", "min rt (ms)"].map(t => h("th", {}, t)))),
+          h("tbody", {}, [h("tr", {}, [
+            h("td", { class: "num" }, String(ob.p99_ms)),
+            h("td", { class: "num" }, String(ob.rt_avg_ms)),
+            h("td", { class: "num" }, String(ob.pass_per_s)),
+            h("td", { class: "num" }, String(ob.block_per_s)),
+            h("td", { class: "num" },
+              `${ob.queue_depth}/${ob.queue_max || "∞"}`),
+            h("td", { class: "num" },
+              p.max_rate == null ? "–" : String(p.max_rate)),
+            h("td", { class: "num" },
+              p.min_rt_ms == null ? "–" : String(p.min_rt_ms)),
+          ])])]),
+      ]));
+    }
+    const acts = d.actions || [];
+    body.appendChild(h("div", { class: "card" }, [
+      h("h3", {}, [h("span", {}, "Applied actions (newest last)"),
+        h("span", { class: "sub" },
+          "each one is also pinned in the flight recorder " +
+          "(trigger kind controller_action)")]),
+      acts.length
+        ? h("table", {}, [h("thead", {}, h("tr", {},
+            ["time", "action", "detail", "p99 (ms)", "queue"]
+              .map(t => h("th", {}, t)))),
+            h("tbody", {}, acts.map(a => h("tr", {}, [
+              h("td", {},
+                new Date(a.ts_ms).toTimeString().slice(0, 8)),
+              h("td", {}, a.kind),
+              h("td", {}, a.note),
+              h("td", { class: "num" },
+                String((a.evidence || {}).p99_ms)),
+              h("td", { class: "num" },
+                String((a.evidence || {}).queue_depth)),
+            ])))])
+        : h("span", { class: "dim" },
+            "no interventions yet — the loop is holding"),
     ]));
   }
   await refresh();

@@ -79,6 +79,7 @@ import asyncio
 import collections
 import os
 import threading
+import zlib
 from typing import Dict, List, NamedTuple, Optional
 
 import numpy as np
@@ -204,6 +205,35 @@ class IngestQueue:
                           else max(1, int(queue_max)))
         self._q: "collections.deque[_Pending]" = collections.deque()
         self._min_deadline: Optional[int] = None
+        # controller-settable admission gate (round 17): fraction of
+        # arriving requests admitted BEFORE they join a batch. 1.0 = the
+        # gate is wide open and admitted() takes the zero-state early
+        # return, so an idle controller leaves the request stream (and
+        # every downstream verdict) bit-identical to pre-r17.
+        self.admit_frac = 1.0
+        self.admit_seed = 0
+        self._admit_idx = 0
+
+    def set_admission(self, frac: float, seed: int = 0) -> None:
+        """Controller actuation: admit only ``frac`` of arriving
+        requests. Deterministic — the drop pattern is a pure function of
+        ``(seed, arrival index, resource)``, so a replay of the same
+        request stream with the same seed sheds the same requests (the
+        property the gate's replayability check rides on)."""
+        self.admit_frac = min(1.0, max(0.0, float(frac)))
+        self.admit_seed = int(seed) & 0xFFFFFFFF
+        self._admit_idx = 0
+
+    def admitted(self, resource: str) -> bool:
+        """One admission draw (consumes one arrival index when the gate
+        is engaged; free when wide open)."""
+        if self.admit_frac >= 1.0:
+            return True
+        idx = self._admit_idx
+        self._admit_idx = idx + 1
+        mix = (self.admit_seed * 0x9E3779B1 + idx) & 0xFFFFFFFF
+        h = zlib.crc32(resource.encode("utf-8", "replace"), mix)
+        return (h & 0xFFFFFF) / float(1 << 24) < self.admit_frac
 
     def __len__(self) -> int:
         return len(self._q)
@@ -341,6 +371,17 @@ class AdaptiveBatcher:
         # chain retroactively); without it the stride sampler decides
         tr = obs.request_trace() if obs_on else 0
         t0 = obs.spans.now_ns() if obs_on else 0
+        if not self.queue.admitted(resource):
+            # controller shed: dropped BEFORE the batch forms, so the
+            # device never sees the request (the whole point — overload
+            # relief must not cost a dispatch). The triggering action
+            # already pinned a flight chain; per-request drops only count.
+            if obs_on:
+                obs.counters.add(obs_keys.CONTROL_DROPPED)
+                obs.counters.add(obs_keys.FE_SHED)
+            raise IngestOverload(
+                f"admission controller shedding "
+                f"(frac={self.queue.admit_frac:.3f}); request shed")
         if self.queue.would_shed(self._inflight):
             if obs_on:
                 obs.counters.add(obs_keys.FE_SHED)
@@ -379,6 +420,26 @@ class AdaptiveBatcher:
     def pending(self) -> int:
         """Requests accepted but not yet fanned out (queued + in flight)."""
         return len(self.queue) + self._inflight
+
+    def retune(self, budget_ms: Optional[int] = None,
+               batch_cap: Optional[int] = None) -> None:
+        """Controller actuation: hot-swap the flush-deadline reserve and
+        the batch cap ONLINE. Pure host-side policy state — no retrace,
+        no new engine geometry (padded dispatch widths are chosen per
+        flush, exactly as before). Callable from any thread; the ingest
+        loop picks the new values up on its next wake. A ``batch_cap``
+        above the construction-time ``batch_max`` is clamped: the
+        controller may only trade throughput for latency, never exceed
+        the operator's provisioned batch width."""
+        if budget_ms is not None:
+            self.budget_ms = max(0, int(budget_ms))
+            self.queue.budget_ms = self.budget_ms
+        if batch_cap is not None:
+            cap = min(self.batch_max, max(1, int(batch_cap)))
+            self.queue.batch_max = cap
+        loop, wake = self._loop, self._wake
+        if loop is not None and wake is not None and not loop.is_closed():
+            loop.call_soon_threadsafe(wake.set)
 
     # ------------------------------------------------------------------
     # ingest loop

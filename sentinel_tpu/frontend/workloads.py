@@ -25,6 +25,10 @@ Shapes (the traffic a flow-control deployment exists for):
 * ``slow_consumer`` — square-wave bursts well above the sustainable
   rate with idle gaps: drives the queue to its backpressure bound so
   shed behavior is observable.
+* ``overload_episode`` — the round-17 composite: steady tenant +
+  flash-crowd spike + slow-consumer bursts overlapping in one
+  timeline (independent per-component rngs merged by arrival time);
+  the overload-controller gate's episode.
 
 All are registered in :data:`WORKLOADS`; ``make(name, ...)`` is the
 lookup used by the bench and tests.
@@ -229,6 +233,37 @@ def slow_consumer(seed: int, duration_ms: float = 1000.0,
     return [Request(t, r, 1, False, "") for t, r in zip(ts, names)]
 
 
+def overload_episode(seed: int, duration_ms: float = 1000.0,
+                     rate_rps: float = 2000.0, n_resources: int = 16,
+                     steady_frac: float = 0.5, spike_mult: float = 8.0,
+                     spike_start: float = 0.3, spike_end: float = 0.6,
+                     hot_frac: float = 0.8, burst_mult: float = 16.0,
+                     burst_period_ms: float = 200.0,
+                     burst_duty: float = 0.25,
+                     burst_frac: float = 0.25) -> List[Request]:
+    """The round-17 controller-gate composite: a steady tenant that
+    must keep its SLO, PLUS a flash-crowd spike on one hot resource,
+    PLUS slow-consumer square-wave bursts — all three overlapping in
+    one timeline. Component streams draw from independent seeded rngs
+    (``seed``, ``seed+1``, ``seed+2``) and merge sorted by arrival
+    time, so each component is individually deterministic and the
+    composite replays exactly. The steady slice keeps the ``steady/``
+    prefix — the gate scores ITS latency under the other two's abuse."""
+    parts: List[Request] = []
+    parts.extend(steady(seed, duration_ms,
+                        rate_rps * steady_frac, n_resources))
+    parts.extend(flash_crowd(
+        seed + 1, duration_ms,
+        rate_rps * max(0.0, 1.0 - steady_frac - burst_frac),
+        n_resources, spike_mult, spike_start, spike_end, hot_frac))
+    parts.extend(slow_consumer(
+        seed + 2, duration_ms, rate_rps * burst_frac,
+        max(1, n_resources // 4), burst_mult, burst_period_ms,
+        burst_duty))
+    parts.sort(key=lambda r: (r.t_ms, r.resource))
+    return parts
+
+
 #: name → generator; every generator is ``f(seed, duration_ms,
 #: rate_rps, **shape_params) -> List[Request]`` and fully deterministic
 #: for a given argument tuple.
@@ -239,6 +274,7 @@ WORKLOADS: Dict[str, Callable[..., List[Request]]] = {
     "zipf_hot": zipf_hot,
     "priority_mix": priority_mix,
     "slow_consumer": slow_consumer,
+    "overload_episode": overload_episode,
 }
 
 

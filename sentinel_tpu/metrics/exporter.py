@@ -190,6 +190,13 @@ class SentinelCollector:
             "classifications, promoted / demoted row migrations, "
             "sketch_overflow halvings (tiering/manager.py)",
             labels=["event"])
+        control = CounterMetricFamily(
+            f"{ns}_control_total",
+            "Overload-controller activity: tick (control cycles), "
+            "shed_rate / retune_batcher / degrade (actions applied), "
+            "admission_dropped (requests shed at the admission gate) "
+            "(control/loop.py)",
+            labels=["action"])
         if not describe_only and obs is not None and obs.enabled:
             from sentinel_tpu.obs import counters as ck
             counts = obs.counters.snapshot()
@@ -263,6 +270,12 @@ class SentinelCollector:
                             (ck.TIER_DEMOTED, "demoted"),
                             (ck.TIER_SKETCH_OVERFLOW, "sketch_overflow")):
                 tier.add_metric([ev], counts.get(key, 0))
+            for key, ev in ((ck.CONTROL_TICK, "tick"),
+                            (ck.CONTROL_SHED_ACTION, "shed_rate"),
+                            (ck.CONTROL_RETUNE_ACTION, "retune_batcher"),
+                            (ck.CONTROL_DEGRADE_ACTION, "degrade"),
+                            (ck.CONTROL_DROPPED, "admission_dropped")):
+                control.add_metric([ev], counts.get(key, 0))
             # bounded by construction: at most telemetry.k ≤ MAX_K labels
             telemetry = getattr(self.sentinel, "telemetry", None)
             if telemetry is not None and telemetry.enabled:
@@ -271,7 +284,7 @@ class SentinelCollector:
         yield from (p99, quant, req_quant, route, hits, misses, retries,
                     blocks, occupy, pipeline, frontend, fe_flush, wraps,
                     flight_pinned, flight_trig, sf_ovf, tune,
-                    res_qps, telem, label_ovf, tier)
+                    res_qps, telem, label_ovf, tier, control)
 
     def collect(self):
         ns = self.namespace

@@ -192,6 +192,24 @@ TIER_SKETCH_OVERFLOW = "tier.sketch_overflow"
 PIPE_DISPATCH = "pipeline.dispatches"
 ROUTE_SINGLE_DISPATCH = "split_route.single_dispatch"
 
+# PR 17 — closed-loop overload controller (sentinel_tpu/control/):
+# ``tick`` counts policy evaluations (one per ControlLoop cadence slot
+# with a fresh observation); the three ``action.*`` keys count APPLIED
+# interventions by type (shed-fraction change, batcher retune, forced
+# degrade transition — every one is also pinned in the flight recorder
+# with its triggering evidence, trigger kind ``controller_action``);
+# ``admission_dropped`` counts requests the frontend refused under a
+# controller-set admission fraction < 1 (deterministic seeded-hash
+# shed, BEFORE batches form — distinct from ``frontend.shed``, the
+# queue-overflow backpressure). Exported as
+# ``sentinel_control_total{action=...}``; see docs/OPERATIONS.md
+# "Self-driving overload protection (round 17)".
+CONTROL_TICK = "control.tick"
+CONTROL_SHED_ACTION = "control.action.shed_rate"
+CONTROL_RETUNE_ACTION = "control.action.retune_batcher"
+CONTROL_DEGRADE_ACTION = "control.action.degrade"
+CONTROL_DROPPED = "control.admission_dropped"
+
 #: Fixed aggregation catalog (order is the wire format of the multihost
 #: counter vector — append only, never reorder).
 CATALOG = (
@@ -220,6 +238,8 @@ CATALOG = (
     TIER_HOT_HIT, TIER_COLD_MISS, TIER_PROMOTED, TIER_DEMOTED,
     TIER_SKETCH_OVERFLOW,
     PIPE_DISPATCH, ROUTE_SINGLE_DISPATCH,
+    CONTROL_TICK, CONTROL_SHED_ACTION, CONTROL_RETUNE_ACTION,
+    CONTROL_DEGRADE_ACTION, CONTROL_DROPPED,
 )
 
 

@@ -60,7 +60,8 @@ FLIGHT_P99_ENV = "SENTINEL_FLIGHT_P99_MS"
 FLIGHT_BURST_ENV = "SENTINEL_FLIGHT_BLOCK_BURST"
 
 #: trigger kind → MetricNode.classification code in the <app>-trace log
-TRIGGER_CODES = {"deadline_miss": 1, "shed": 2, "p99": 3, "block_burst": 4}
+TRIGGER_CODES = {"deadline_miss": 1, "shed": 2, "p99": 3, "block_burst": 4,
+                 "controller_action": 5}
 
 RECENT_CAP = 64          # in-memory pinned-record tail (command surface)
 PENDING_CAP = 256        # un-flushed disk buffer bound (oldest dropped)
@@ -139,18 +140,21 @@ class FlightRecorder:
     # ---- trigger surface (hot-adjacent; every call is guarded) -------
 
     def trigger(self, kind: str, root: int = 0, note: str = "",
-                worst_ms: float = 0.0) -> bool:
+                worst_ms: float = 0.0, force: bool = False) -> bool:
         """Fire one SLO trigger; → True when a chain was actually pinned
-        (False: inactive, rate-limited, or nothing recorded to pin)."""
+        (False: inactive, rate-limited, or nothing recorded to pin).
+        ``force`` skips the per-kind rate limiter: controller actions are
+        already cooldown-limited upstream and every one must leave a pin."""
         if not self.active or self._closed:
             return False
         spans = self._obs.spans
         now_ns = spans.now_ns()
         gap_ns = int(self.window_ms * 1e6)
         with self._lock:
-            last = self._last_pin_ns.get(kind)
-            if last is not None and now_ns - last < gap_ns:
-                return False
+            if not force:
+                last = self._last_pin_ns.get(kind)
+                if last is not None and now_ns - last < gap_ns:
+                    return False
             self._last_pin_ns[kind] = now_ns
         roots = [int(root)] if root else self._window_roots(now_ns)
         if not roots:

@@ -439,12 +439,17 @@ class HotTelemetry:
             if name is None:        # stale row (evicted since the tick)
                 continue
             lanes = roll_lanes[i]
+            succ_s = int(sec_lanes[i][ev.SUCCESS])
             hot.append({
                 "resource": name, "row": row, "load": load,
                 "qps": round(load / interval_s, 3),
                 "pass": int(lanes[ev.PASS]), "block": int(lanes[ev.BLOCK]),
                 "success": int(lanes[ev.SUCCESS]),
                 "exception": int(lanes[ev.EXCEPTION]),
+                # device-measured mean RT over the landed second — the
+                # overload controller's per-resource degrade signal
+                "rt_ms": round(float(sec_rt[i]) / succ_s, 3) if succ_s
+                         else 0.0,
             })
         timeline_entry = None
         nodes = []

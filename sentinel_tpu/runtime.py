@@ -585,38 +585,50 @@ class PendingVerdicts(PendingResult):
 
 class _StagingRing:
     """Preallocated host staging for the always-present entry-batch columns
-    of one padded size: ``_build_entry_batch`` fills the next slot in place
+    of one padded size: ``_build_entry_batch`` fills a free slot in place
     (``pad_into``) instead of allocating ~9 fresh numpy arrays per step —
     the ``entry.prep`` cost a serving loop re-pays every dispatch.
 
     A slot must not be rewritten while a dispatch built from it could
-    still read it. jax's jit call copies host operands to the device
-    synchronously, but the split path builds TWO batches (possibly the
-    same padded size) before dispatching either and a depth-k pipeline
-    keeps k submits in flight, so the ring holds ``2k + 2`` slots (min 4).
-    ``next()`` is lock-guarded; concurrent large-batch dispatchers beyond
-    the ring depth should disable staging (``SENTINEL_HOST_STAGING=0`` —
-    see docs/OPERATIONS.md "Pipelined dispatch")."""
+    still read it. The round-7 ring assumed a jit call copies host
+    operands synchronously; on this backend that does not always hold
+    under tiering churn (ROADMAP known-issue 5), so slot reuse is now
+    tied to dispatch SETTLEMENT: ``acquire()`` hands out a slot from the
+    free list, and the dispatch path releases it from its deferred-read
+    closure only after the verdict readback has materialized — by which
+    point the device has consumed the staged operands. Under churn
+    (pipeline deeper than the free list, or a slot held across a stall)
+    ``acquire()`` grows the pool with a fresh slot instead of ever
+    rewriting an in-flight one; ``grown`` counts those allocations. A
+    slot leaked on an exception path simply shrinks the pool — the next
+    acquire re-grows it — so correctness never depends on release."""
 
-    __slots__ = ("slots", "_i", "_lock")
+    __slots__ = ("b", "_free", "_lock", "grown")
 
     _INT_COLS = ("rows", "origin_ids", "origin_rows", "context_ids",
                  "chain_rows", "acquire")
     _BOOL_COLS = ("is_in", "prioritized", "valid")
 
     def __init__(self, b: int, depth: int):
-        self._i = 0
+        self.b = b
+        self.grown = 0
         self._lock = threading.Lock()
-        self.slots = [
-            {**{c: np.empty(b, np.int32) for c in self._INT_COLS},
-             **{c: np.empty(b, np.bool_) for c in self._BOOL_COLS}}
-            for _ in range(depth)]
+        self._free = [self._new_slot() for _ in range(depth)]
 
-    def next(self) -> dict:
+    def _new_slot(self) -> dict:
+        return {**{c: np.empty(self.b, np.int32) for c in self._INT_COLS},
+                **{c: np.empty(self.b, np.bool_) for c in self._BOOL_COLS}}
+
+    def acquire(self) -> dict:
         with self._lock:
-            s = self.slots[self._i]
-            self._i = (self._i + 1) % len(self.slots)
-            return s
+            if self._free:
+                return self._free.pop()
+            self.grown += 1
+        return self._new_slot()
+
+    def release(self, slot: dict) -> None:
+        with self._lock:
+            self._free.append(slot)
 
 
 class Sentinel:
@@ -2734,10 +2746,11 @@ class Sentinel:
                     count_thread=count_thread, record_block=record_block,
                     now=now, trace_id=tr)
 
+        staged: list = []
         batch = self._build_entry_batch(
             rows, origin_ids, origin_rows, context_ids, chain_rows,
             acquire, is_in, prioritized, vfull, param_rules, param_keys,
-            cluster_fallback, count_thread, record_block)
+            cluster_fallback, count_thread, record_block, staged=staged)
         # no_alt_rows (computed above) is about ROWS only: batches with no
         # real origin/chain rows take the *_noalt step variants (the
         # alt-table scatters compile away; origin ids without rows are
@@ -2864,6 +2877,12 @@ class Sentinel:
             out = Verdicts(allow=np.asarray(verdicts.allow)[:n],
                            reason=np.asarray(verdicts.reason)[:n],
                            wait_ms=np.asarray(verdicts.wait_ms)[:n])
+            # verdict materialization proves the device consumed the
+            # staged host operands: only now may the slots be reused (a
+            # read that raised instead just leaks its slots — safe)
+            while staged:
+                ring, slot = staged.pop()
+                ring.release(slot)
             if obs_on:
                 t_end = obs.spans.now_ns()
                 obs.hist_dispatch.record(t_end - t_disp)
@@ -2999,14 +3018,20 @@ class Sentinel:
     def _build_entry_batch(self, rows, origin_ids, origin_rows, context_ids,
                            chain_rows, acquire, is_in, prioritized, vfull,
                            param_rules, param_keys, cluster_fallback,
-                           count_thread, record_block) -> EntryBatch:
+                           count_thread, record_block,
+                           staged=None) -> EntryBatch:
         """Pad raw numpy event arrays into a device EntryBatch (shared by
         the whole-batch, split, and fused dispatch paths).
 
         Serving-sized batches fill a preallocated staging slot
         (``_StagingRing``) in place of ~9 fresh allocations per step;
         the rare optional columns (param pairs, cluster bits, thread
-        counting, block recording) stay freshly allocated.
+        counting, block recording) stay freshly allocated. ``staged``
+        (a list) is the slot-ownership out-param: a staging slot used
+        here is appended as ``(ring, slot)`` and the CALLER must release
+        it after its dispatch settles (the deferred-read closures do).
+        Callers that pass no list get fresh allocations — a slot nobody
+        will release must never be acquired.
 
         Meshed serving additionally places every column on its batch-axis
         :class:`NamedSharding` (parallel/local_shard.place_batch) so the
@@ -3020,12 +3045,13 @@ class Sentinel:
         pad_r = self.spec.rows
         pad_a = self.spec.alt_rows
         if (self._staging_on and b >= self._STAGING_MIN_B
-                and not self._place_batches):
+                and staged is not None and not self._place_batches):
             ring = self._staging.get(b)
             if ring is None:
                 ring = self._staging.setdefault(
                     b, _StagingRing(b, self._staging_depth))
-            s = ring.next()
+            s = ring.acquire()
+            staged.append((ring, s))
             rows_c = _pad_into(s["rows"], rows, pad_r)
             origin_ids_c = _pad_into(s["origin_ids"], origin_ids, 0)
             origin_rows_c = _pad_into(s["origin_rows"], origin_rows, pad_a)
@@ -3111,13 +3137,15 @@ class Sentinel:
 
         zeros_s = np.zeros(idx_s.shape[0], np.bool_)
         zeros_g = np.zeros(idx_g.shape[0], np.bool_)
+        staged: list = []
         bs = self._build_entry_batch(
             take(rows, idx_s), take(origin_ids, idx_s),
             take(origin_rows, idx_s), take(context_ids, idx_s),
             take(chain_rows, idx_s), take(acquire, idx_s),
             take(is_in, idx_s), zeros_s, vfull[idx_s],
             take(param_rules, idx_s), take(param_keys, idx_s),
-            None, take(count_thread, idx_s), take(record_block, idx_s))
+            None, take(count_thread, idx_s), take(record_block, idx_s),
+            staged=staged)
         orow_g = take(origin_rows, idx_g)
         crow_g = take(chain_rows, idx_g)
         prio_g = (take(prioritized, idx_g) if any_prio else zeros_g)
@@ -3127,7 +3155,7 @@ class Sentinel:
             take(is_in, idx_g), prio_g, vfull[idx_g],
             take(param_rules, idx_g), take(param_keys, idx_g),
             take(cluster_fallback, idx_g), take(count_thread, idx_g),
-            take(record_block, idx_g))
+            take(record_block, idx_g), staged=staged)
         no_alt_g = self._batch_has_no_alt(orow_g, crow_g)
         times = self._time_scalars(now)
         load1, cpu = self._cpu.sample()
@@ -3248,6 +3276,10 @@ class Sentinel:
             allow[idx_g] = np.asarray(v2.allow)[:n_g]
             reason[idx_g] = np.asarray(v2.reason)[:n_g]
             wait[idx_g] = np.asarray(v2.wait_ms)[:n_g]
+            # both halves materialized → staged slots consumed; reuse ok
+            while staged:
+                ring, slot = staged.pop()
+                ring.release(slot)
             if obs_on:
                 t_end = obs.spans.now_ns()
                 obs.hist_dispatch.record(t_end - t_disp)
@@ -3334,10 +3366,11 @@ class Sentinel:
                       exit_chain_rows if exit_chain_rows is not None
                       else empty))
 
+        staged: list = []
         batch = self._build_entry_batch(
             rows, origin_ids, origin_rows, context_ids, chain_rows,
             acquire, is_in, prioritized, vfull, None, None, None, None,
-            None)
+            None, staged=staged)
         b_x = self._pad(n_x)
         xbatch = ExitBatch(
             rows=_pad_to(exit_rows, b_x, self.spec.rows, np.int32),
@@ -3500,6 +3533,10 @@ class Sentinel:
             out = Verdicts(allow=np.asarray(verdicts.allow)[:n],
                            reason=np.asarray(verdicts.reason)[:n],
                            wait_ms=np.asarray(verdicts.wait_ms)[:n])
+            # settlement proves the staged operands were consumed
+            while staged:
+                ring, slot = staged.pop()
+                ring.release(slot)
             if obs_on:
                 t_end = obs.spans.now_ns()
                 obs.hist_dispatch.record(t_end - t_disp)
@@ -3991,3 +4028,45 @@ class Sentinel:
             rules = list(self._deg.rules)
         return [(r.resource, states[j]) for j, r in enumerate(rules)
                 if j < len(states)]
+
+    def force_breaker(self, resource: str, state: int) -> bool:
+        """Force every degrade-rule slot on ``resource`` into ``state``
+        (``STATE_CLOSED``/``STATE_OPEN``/``STATE_HALF_OPEN``) — the
+        overload controller's Degrade actuator (round 17). The device
+        kernels then evolve the slot normally: a forced-OPEN slot
+        half-opens after the rule's own ``time_window`` (its
+        ``next_retry_ms`` is stamped exactly as a device trip would),
+        a forced-CLOSED/HALF_OPEN slot starts a fresh stat window.
+        Observers see the arc through the shared transition diff. → True
+        when the resource has at least one loaded degrade rule."""
+        state = int(state)
+        if state not in (deg_mod.STATE_CLOSED, deg_mod.STATE_OPEN,
+                         deg_mod.STATE_HALF_OPEN):
+            raise ValueError(f"invalid breaker state {state}")
+        # buffered fast-path passes were admitted under the old breaker
+        # state — land them first (same discipline as a rules reload)
+        self._flush_fast()
+        never = -(2 ** 30)
+        with self._lock:
+            slots = [j for j, r in enumerate(self._deg.rules)
+                     if r.resource == resource]
+            if not slots:
+                return False
+            idx = jnp.asarray(slots, jnp.int32)
+            st = self._state.breakers
+            if state == deg_mod.STATE_OPEN:
+                now_rel = self._rel_ms(self.clock.now_ms())
+                retry = st.next_retry_ms.at[idx].set(
+                    (self._deg.table.retry_timeout_ms[idx]
+                     + now_rel).astype(jnp.int32))
+            else:
+                retry = st.next_retry_ms.at[idx].set(never)
+            self._state = self._state._replace(breakers=st._replace(
+                state=st.state.at[idx].set(state),
+                next_retry_ms=retry,
+                win_stamp=st.win_stamp.at[idx].set(never),
+                bad=st.bad.at[idx].set(0),
+                total=st.total.at[idx].set(0)))
+            self._pin_state_locked()
+        self.check_breaker_transitions()
+        return True

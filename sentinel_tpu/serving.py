@@ -287,6 +287,15 @@ class CadenceScheduler:
                     >= self._tier_ms * self.IDLE_FACTOR):
                 tier.tick()
             n += tier.drain()
+        # round 17: the overload controller rides the same daemon. Its
+        # tick is never device-carried (pure host observe+decide), so
+        # the cadence check is exact, not the stale-carry fallback.
+        ctl = getattr(sn, "control", None)
+        if ctl is not None and ctl.enabled:
+            now = sn.clock.now_ms()
+            if now - ctl.last_tick_ms() >= ctl.interval_ms:
+                ctl.tick()
+            n += ctl.drain()
         return n
 
     def start(self) -> None:

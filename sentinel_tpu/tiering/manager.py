@@ -219,6 +219,17 @@ class TierManager:
         hot = cold = 0
         with self._lock:
             seen: Dict[str, list] = {}    # name → [count, classification]
+            # Two passes so classification cannot depend on intra-batch
+            # ORDER: when name A's fresh row displaced name B and B is
+            # ALSO in this batch at a new row (a rule reload re-interning
+            # a full pinned set does exactly this), B's cold-miss test
+            # must see the demote intent A's displacement records — in
+            # one pass that held only if A happened to come first, and
+            # the pin path feeds this from a Python set, so B's window
+            # state was dropped or kept by hash order (the real cause of
+            # the seed-1602 tiered-vs-resident divergence once blamed on
+            # the staging ring).
+            fresh: List[Tuple[str, int]] = []
             for i, name in enumerate(names):
                 rec = seen.get(name)
                 if rec is not None:
@@ -232,13 +243,14 @@ class TierManager:
                 self._shadow[row] = name
                 if prev is not None:
                     self._pending_demote.setdefault(row, prev)
+                seen[name] = [1, "new"]
+                fresh.append((name, row))
+            for name, row in fresh:
                 if (name in self.cold or name in self._pending_land
                         or any(v == name
                                for v in self._pending_demote.values())):
                     self._pending_promote[name] = row
-                    seen[name] = [1, "cold"]
-                else:
-                    seen[name] = [1, "new"]
+                    seen[name][1] = "cold"
             for _name, (cnt, kind) in seen.items():
                 if kind == "hot":
                     hot += cnt

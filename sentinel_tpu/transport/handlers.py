@@ -298,6 +298,25 @@ def register_default_handlers(
         return CommandResponse.of_success(json.dumps(
             telemetry.snapshot(timeline_limit=timeline_limit)))
 
+    def cmd_control(req: CommandRequest) -> CommandResponse:
+        """Overload-controller snapshot (control/loop.py): policy state
+        (admission fraction, estimator extrema, degrade trackers), the
+        last observation, and the applied-action tail with per-action
+        evidence. Params: ``actions`` (max actions, default 32),
+        ``tick`` (``1`` → run one observe/decide/apply cycle inline
+        first — the pull-only path without a scheduler)."""
+        control = getattr(s, "control", None)
+        if control is None:
+            return CommandResponse.of_failure("controller unavailable", 404)
+        try:
+            limit = int(req.param("actions", "32") or 32)
+        except ValueError:
+            return CommandResponse.of_failure("invalid limit", 400)
+        if req.param("tick", "") in ("1", "true"):
+            control.poll()
+        return CommandResponse.of_success(json.dumps(
+            control.snapshot(limit=limit)))
+
     def cmd_trace(req: CommandRequest) -> CommandResponse:
         """Request-scoped trace export (docs/OBSERVABILITY.md "Request
         tracing"). Params: ``id`` (a trace id → that chain's causal
@@ -438,6 +457,7 @@ def register_default_handlers(
         ("systemStatus", "system adaptive status", cmd_system_status),
         ("obs", "runtime self-telemetry snapshot", cmd_obs),
         ("topk", "hot-resource top-K snapshot", cmd_topk),
+        ("control", "overload controller snapshot", cmd_control),
         ("trace", "causal trace chain as chrome-trace JSON", cmd_trace),
         ("getClusterMode", "get cluster mode", cmd_get_cluster_mode),
         ("setClusterMode", "set cluster mode", cmd_set_cluster_mode),

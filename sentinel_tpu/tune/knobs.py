@@ -165,6 +165,27 @@ KNOBS: Tuple[KnobSpec, ...] = (
     KnobSpec("SENTINEL_TIER_TICK_MS", "int", 200, 10, 60_000,
              SCOPE_RUNTIME, (),
              "tiering ticker period (sketch decay + demote scan)"),
+    # control/loop.py — round-17 overload controller (empty sweep grids:
+    # the control law is an SLO policy, not a latency/throughput trade
+    # the halving search can score; the gate (n) episode pins behavior)
+    KnobSpec("SENTINEL_CONTROL_INTERVAL_MS", "int", 1000, 50, 60_000,
+             SCOPE_RUNTIME, (),
+             "overload-controller tick cadence (control/loop.py)"),
+    KnobSpec("SENTINEL_CONTROL_P99_HI_MS", "float", 20.0, 1.0, 60_000.0,
+             SCOPE_RUNTIME, (),
+             "interval p99 above which the controller sheds (AIMD MD)"),
+    KnobSpec("SENTINEL_CONTROL_P99_LO_MS", "float", 10.0, 0.5, 60_000.0,
+             SCOPE_RUNTIME, (),
+             "interval p99 below which admission recovers (AIMD AI)"),
+    KnobSpec("SENTINEL_CONTROL_MIN_ADMIT", "float", 0.05, 0.01, 1.0,
+             SCOPE_RUNTIME, (),
+             "admission-fraction floor (the shed never black-holes)"),
+    KnobSpec("SENTINEL_CONTROL_COOLDOWN_MS", "int", 2000, 100, 600_000,
+             SCOPE_RUNTIME, (),
+             "per-action repeat bound (anti-flap, with the hysteresis band)"),
+    KnobSpec("SENTINEL_CONTROL_DEGRADE_RT_MS", "float", 0.0, 0.0, 60_000.0,
+             SCOPE_RUNTIME, (),
+             "per-resource device-RT bound forcing breaker arcs (0 = off)"),
 )
 
 KNOB_BY_ENV: Dict[str, KnobSpec] = {k.env: k for k in KNOBS}
@@ -193,6 +214,7 @@ OPERATIONAL_ENVS: Dict[str, Optional[type]] = {
     "SENTINEL_FLIGHT_BLOCK_BURST": int,
     "SENTINEL_TELEMETRY_K": int,
     "SENTINEL_TELEMETRY_DISABLE": None,
+    "SENTINEL_CONTROL_DISABLE": None,
     "SENTINEL_TIERING_DISABLE": None,
     "SENTINEL_TIER_COLD_MAX": int,
     "SENTINEL_FIRST_LOAD_TIMEOUT_S": float,

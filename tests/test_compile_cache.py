@@ -26,6 +26,10 @@ e = sph.entry("x"); e.exit()          # first verdict = first step compile
 from sentinel_tpu.core.compile_cache import active_cache_dir
 print(json.dumps({"secs": time.perf_counter() - t0,
                   "cache": active_cache_dir()}))
+# tear the engine down BEFORE interpreter exit: without this the
+# daemon executors race jax's atexit teardown and the warm child
+# occasionally dies with SIGSEGV after printing its (valid) result
+sph.close()
 """
 
 

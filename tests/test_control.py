@@ -1,0 +1,282 @@
+"""Round 17 controller tests: the pure policy core under explicit
+timestamps (no engine, no wall clock), the deterministic admission
+gate, and the actuator seams against a real engine under ManualClock.
+Parity targets: BBR's windowed-filter unit tests and the reference
+SystemRule/degrade controller tiers."""
+
+import pytest
+
+import sentinel_tpu as stpu
+from sentinel_tpu.control import (
+    Actuators, ControlLoop, Degrade, HistDeltaP99, Observation,
+    OverloadPolicy, PolicyConfig, RetuneBatcher, ShedRate, WindowedFilter,
+)
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.frontend.batcher import AdaptiveBatcher, IngestQueue
+from sentinel_tpu.obs.hist import BASE_NS, NUM_BUCKETS
+
+pytestmark = pytest.mark.quick
+
+
+def obs(ts_ms, *, p99_ms=0.0, queue_depth=0, queue_max=0, pass_per_s=0.0,
+        block_per_s=0.0, rt_avg_ms=0.0, resource_rt=()):
+    return Observation(ts_ms=ts_ms, pass_per_s=pass_per_s,
+                       block_per_s=block_per_s, rt_avg_ms=rt_avg_ms,
+                       p99_ms=p99_ms, queue_depth=queue_depth,
+                       queue_max=queue_max, resource_rt=resource_rt)
+
+
+# ------------------------------------------------------------ estimators
+
+def test_windowed_filter_max_and_expiry():
+    f = WindowedFilter(1000, "max")
+    assert f.update(0, 5.0) == 5.0
+    assert f.update(100, 3.0) == 5.0      # smaller sample shielded by max
+    assert f.update(1100, 1.0) == 3.0     # (0, 5.0) aged out of the window
+    assert f.update(1200, 1.0) == 1.0     # (100, 3.0) aged out too
+    assert f.value == 1.0
+
+
+def test_windowed_filter_min_mode():
+    f = WindowedFilter(1000, "min")
+    assert f.value is None
+    assert f.update(0, 5.0) == 5.0
+    assert f.update(100, 2.0) == 2.0
+    assert f.update(900, 9.0) == 2.0
+    assert f.update(1500, 9.0) == 9.0     # the min sample expired
+
+
+def test_hist_delta_p99_isolates_the_interval():
+    est = HistDeltaP99()
+    # first snapshot: lifetime history entirely in bucket 5 (sub-ms)
+    snap1 = [0] * NUM_BUCKETS
+    snap1[5] = 1000
+    first = est.update(snap1)             # cumulative treated as delta
+    assert 0.0 < first < 1.0
+    # second snapshot adds 100 requests landing in bucket 16
+    # ([33.55, 67.1) ms) — the interval p99 must come from THAT bucket,
+    # not the 1000 stale sub-ms samples a lifetime percentile would see
+    snap2 = list(snap1)
+    snap2[16] = 100
+    p99 = est.update(snap2)
+    lo = (BASE_NS << 15) / 1e6
+    hi = (BASE_NS << 16) / 1e6
+    assert lo < p99 <= hi
+    assert p99 > 60.0                     # 99th of 100 → near the top
+    # nothing landed since → idle interval reads 0.0
+    assert est.update(snap2) == 0.0
+
+
+# ---------------------------------------------------------- control law
+
+def test_aimd_backoff_ramps_to_floor_with_one_retune():
+    cfg = PolicyConfig(p99_hi_ms=20.0, p99_lo_ms=10.0, min_admit=0.3,
+                       cooldown_ms=0, shed_backoff=0.5,
+                       retune_budget_ms=0, retune_cap_frac=0.5)
+    pol = OverloadPolicy(cfg, base_budget_ms=3, base_batch_cap=256)
+    a1 = pol.observe(obs(1000, p99_ms=50.0))
+    # first overloaded tick: shed AND the one-time batcher degrade
+    # (budget defaults to 2×base, cap to base×frac)
+    assert a1 == [ShedRate(0.5), RetuneBatcher(6, 128)]
+    a2 = pol.observe(obs(2000, p99_ms=50.0))
+    assert a2 == [ShedRate(0.3)]          # 0.25 clamped up to the floor
+    a3 = pol.observe(obs(3000, p99_ms=50.0))
+    assert a3 == []                       # at the floor: nothing to emit
+    assert pol.admit_frac == 0.3
+    assert pol.snapshot()["degraded_batcher"] is True
+
+
+def test_recovery_restores_operator_batcher_tuning():
+    cfg = PolicyConfig(p99_hi_ms=20.0, p99_lo_ms=10.0, min_admit=0.3,
+                       cooldown_ms=0, shed_backoff=0.5, shed_recover=0.5)
+    pol = OverloadPolicy(cfg, base_budget_ms=3, base_batch_cap=256)
+    pol.observe(obs(1000, p99_ms=50.0))   # → 0.5, degraded batcher
+    acts = pol.observe(obs(2000, p99_ms=5.0))
+    # additive step lands exactly at 1.0 → base tuning restored with it
+    assert acts == [ShedRate(1.0), RetuneBatcher(3, 256)]
+    assert pol.admit_frac == 1.0
+    assert pol.degraded_batcher is False
+
+
+def test_hysteresis_band_holds():
+    cfg = PolicyConfig(p99_hi_ms=20.0, p99_lo_ms=10.0, cooldown_ms=0)
+    pol = OverloadPolicy(cfg)
+    pol.observe(obs(1000, p99_ms=50.0))   # shed once
+    frac = pol.admit_frac
+    assert frac < 1.0
+    # p99 inside [lo, hi): neither overloaded nor healthy — no flapping
+    for ts in (2000, 3000, 4000):
+        assert pol.observe(obs(ts, p99_ms=15.0)) == []
+    assert pol.admit_frac == frac
+
+
+def test_cooldown_bounds_action_repeat_rate():
+    cfg = PolicyConfig(p99_hi_ms=20.0, cooldown_ms=2000)
+    pol = OverloadPolicy(cfg)
+    sheds = []
+    for ts in range(0, 5000, 500):        # overloaded every 500ms tick
+        sheds += [a for a in pol.observe(obs(ts, p99_ms=50.0))
+                  if isinstance(a, ShedRate)]
+    # 0 / 2000 / 4000 are the only ticks past the 2s cooldown
+    assert len(sheds) == 3
+
+
+def test_queue_depth_alone_triggers_shed():
+    cfg = PolicyConfig(p99_hi_ms=20.0, p99_lo_ms=10.0, cooldown_ms=0,
+                       queue_hi_frac=0.75)
+    pol = OverloadPolicy(cfg)
+    # p99 reads idle (0.0) but the ingest queue crossed 75% of its
+    # bound — the queue signal must fire without waiting on latency
+    acts = pol.observe(obs(1000, p99_ms=0.0, queue_depth=80,
+                           queue_max=100))
+    assert any(isinstance(a, ShedRate) for a in acts)
+    frac = pol.admit_frac
+    assert frac < 1.0
+    # a hot queue also vetoes "healthy" recovery: idle p99 would
+    # otherwise step the fraction back up
+    pol.observe(obs(2000, p99_ms=0.0, queue_depth=80, queue_max=100))
+    held = pol.admit_frac
+    assert held <= frac
+    # queue drained → recovery resumes
+    pol.observe(obs(3000, p99_ms=0.0, queue_depth=0, queue_max=100))
+    assert pol.admit_frac > held
+
+
+def test_degrade_tracker_full_cycle():
+    cfg = PolicyConfig(cooldown_ms=0, degrade_rt_ms=50.0,
+                       degrade_bad_ticks=2, degrade_hold_ms=1000)
+    pol = OverloadPolicy(cfg)
+    bad = (("svc", 100.0),)
+    good = (("svc", 10.0),)
+    idle = (("svc", 0.0),)
+    assert pol.observe(obs(0, resource_rt=bad)) == []       # 1 bad tick
+    assert pol.observe(obs(100, resource_rt=bad)) == \
+        [Degrade("svc", "open")]                            # 2nd trips it
+    assert pol.snapshot()["degrade"] == {"svc": "open"}
+    assert pol.observe(obs(500, resource_rt=bad)) == []     # holding open
+    assert pol.observe(obs(1200, resource_rt=idle)) == \
+        [Degrade("svc", "half_open")]                       # hold elapsed
+    assert pol.observe(obs(1300, resource_rt=idle)) == []   # no probe yet
+    assert pol.observe(obs(1400, resource_rt=good)) == \
+        [Degrade("svc", "close")]                           # good probe
+    # re-trip, then a BAD probe re-opens instead of closing
+    pol.observe(obs(1500, resource_rt=bad))
+    assert pol.observe(obs(1600, resource_rt=bad)) == \
+        [Degrade("svc", "open")]
+    assert pol.observe(obs(2700, resource_rt=idle)) == \
+        [Degrade("svc", "half_open")]
+    assert pol.observe(obs(2800, resource_rt=bad)) == \
+        [Degrade("svc", "open")]
+
+
+# ------------------------------------------------------- admission gate
+
+def test_admission_gate_is_deterministic_and_proportional():
+    q = IngestQueue(batch_max=16)
+    q.set_admission(0.5, seed=42)
+    run1 = [q.admitted("api") for _ in range(400)]
+    q.set_admission(0.5, seed=42)         # same seed resets the stream
+    run2 = [q.admitted("api") for _ in range(400)]
+    assert run1 == run2                   # replays shed identically
+    frac = sum(run1) / len(run1)
+    assert 0.4 < frac < 0.6               # ≈ the requested fraction
+    q.set_admission(0.5, seed=43)
+    run3 = [q.admitted("api") for _ in range(400)]
+    assert run3 != run1                   # a new seed is a new pattern
+
+
+def test_admission_wide_open_is_zero_state():
+    q = IngestQueue(batch_max=16)
+    q.set_admission(1.0, seed=7)
+    assert all(q.admitted("api") for _ in range(10))
+    # the open gate must not consume arrival indices: engaging the gate
+    # later starts from index 0, bit-identical to a fresh queue
+    assert q._admit_idx == 0
+
+
+# ------------------------------------------------- actuators (real engine)
+
+@pytest.fixture
+def engine():
+    cfg = stpu.load_config(max_resources=32, max_flow_rules=8,
+                           max_degrade_rules=8, max_authority_rules=8,
+                           host_fast_path=False)
+    sph = stpu.Sentinel(config=cfg,
+                        clock=ManualClock(start_ms=1_785_000_000_000))
+    yield sph
+    sph.close()
+
+
+def test_actuators_retune_matches_construction(engine):
+    act = Actuators(engine)
+    assert act.apply(ShedRate(0.5)) is None        # no frontend bound yet
+    assert act.apply(RetuneBatcher(6, 4)) is None
+    b = AdaptiveBatcher(engine, batch_max=8, budget_ms=3, queue_max=64)
+    ref = AdaptiveBatcher(engine, batch_max=4, budget_ms=6, queue_max=64)
+    try:
+        act.bind_batcher(b)
+        note = act.apply(ShedRate(0.5))
+        assert note == "admit_frac=0.500 seed=0"
+        assert b.queue.admit_frac == 0.5
+        note = act.apply(RetuneBatcher(6, 4))
+        assert note == "budget_ms=6 batch_cap=4"
+        # the retuned batcher's flush policy equals one CONSTRUCTED with
+        # those values — retune is pure policy state, not new geometry
+        assert (b.queue.batch_max, b.queue.budget_ms) == \
+            (ref.queue.batch_max, ref.queue.budget_ms)
+        assert b.batch_max == 8           # provisioned width preserved
+        act.apply(RetuneBatcher(6, 100))
+        assert b.queue.batch_max == 8     # clamped to construction cap
+        with pytest.raises(TypeError):
+            act.apply("not-an-action")
+    finally:
+        b.close()
+        ref.close()
+
+
+def test_idle_controller_is_zero_state(engine):
+    """Bit-parity by construction: a healthy system draws NO actions,
+    so the admission gate stays wide open — and the open gate's early
+    return consumes no arrival indices, leaving the request stream
+    (and every downstream verdict) identical to a controller-less
+    engine."""
+    b = AdaptiveBatcher(engine, batch_max=8, budget_ms=3, queue_max=64)
+    try:
+        ctl = ControlLoop(engine, b, interval_ms=100)
+        assert engine.control is ctl          # scheduler attachment point
+        for _ in range(5):
+            engine.clock.advance_ms(150)
+            ctl.poll()
+        assert ctl.snapshot()["ticks"] == 5
+        assert ctl.total_actions == 0
+        assert b.queue.admit_frac == 1.0
+        assert (b.budget_ms, b.queue.batch_max) == (3, 8)
+        assert all(b.queue.admitted("api") for _ in range(8))
+        assert b.queue._admit_idx == 0        # zero state consumed
+    finally:
+        b.close()
+
+
+def test_disable_env_kills_the_loop(engine, monkeypatch):
+    monkeypatch.setenv("SENTINEL_CONTROL_DISABLE", "1")
+    ctl = ControlLoop(engine)
+    assert ctl.enabled is False
+    assert ctl.tick() == 0 and ctl.poll() == 0
+    assert ctl.snapshot()["ticks"] == 0
+
+
+def test_actuators_degrade_forces_real_breaker(engine):
+    engine.load_degrade_rules([stpu.DegradeRule(
+        resource="svc", grade=stpu.GRADE_EXCEPTION_COUNT, count=100,
+        time_window=5)])
+    with engine.entry("svc"):
+        pass                              # healthy before the force
+    act = Actuators(engine)
+    assert act.apply(Degrade("svc", "open")) == "svc->open"
+    with pytest.raises(stpu.DegradeException):
+        engine.entry("svc")
+    assert act.apply(Degrade("svc", "close")) == "svc->close"
+    with engine.entry("svc"):
+        pass                              # breaker released
+    # a resource with no degrade slot has no seam → counted, not pinned
+    assert act.apply(Degrade("nope", "open")) is None

@@ -327,11 +327,57 @@ def test_staging_reuse_parity(clk):
     assert not off_s._staging
 
 
-def test_staging_ring_rotates_slots(clk):
+def test_staging_ring_settlement_freelist(clk):
+    """Slot reuse is settlement-tied (ROADMAP issue 5): a held slot is
+    never handed out again, acquire grows the pool past its depth, and
+    released slots are recycled."""
     from sentinel_tpu.runtime import _StagingRing
     ring = _StagingRing(1024, 4)
-    seen = [id(ring.next()["rows"]) for _ in range(8)]
-    assert len(set(seen)) == 4 and seen[:4] == seen[4:]
+    held = [ring.acquire() for _ in range(4)]
+    assert len({id(s["rows"]) for s in held}) == 4
+    extra = ring.acquire()     # pool exhausted: fresh slot, never reuse
+    assert ring.grown == 1
+    assert id(extra["rows"]) not in {id(s["rows"]) for s in held}
+    ring.release(held[0])
+    assert id(ring.acquire()["rows"]) == id(held[0]["rows"])
+
+
+def test_staging_inflight_slots_never_rewritten(clk, monkeypatch):
+    """ROADMAP issue 5 regression: with MORE unsettled dispatches in
+    flight than the ring has slots, the old round-robin ring handed an
+    in-flight slot out again (silently corrupting that dispatch's
+    operands on backends with deferred host→device copies). The
+    settlement-tied ring must instead grow — no two in-flight batches
+    may alias a staging buffer — and recycle every slot after settle.
+    Verdicts must stay bit-identical to a staging-off twin."""
+    import sentinel_tpu.runtime as rt
+    monkeypatch.setattr(rt.Sentinel, "_STAGING_MIN_B", 8)
+    clk2 = ManualClock(start_ms=T0)
+    on_s = make(clk)
+    off_s = make(clk2)
+    off_s._staging_on = False
+    for s in (on_s, off_s):
+        s.load_flow_rules(RULES)
+    depth = on_s._staging_depth
+    rng_a, rng_b = (np.random.default_rng(1602) for _ in range(2))
+    handles, expected = [], []
+    for step in range(depth + 3):   # strictly deeper than the free list
+        names = [f"r{int(i)}" for i in rng_a.integers(0, 4, 12)]
+        handles.append(on_s.entry_batch_nowait(names))
+        expected.append(off_s.entry_batch_nowait(
+            [f"r{int(i)}" for i in rng_b.integers(0, 4, 12)]).result())
+    (ring,) = on_s._staging.values()
+    assert ring.grown >= 3          # grew instead of reusing in-flight
+    assert not ring._free           # every slot owned by a live handle
+    for h, want in zip(handles, expected):
+        got = h.result()
+        assert np.array_equal(np.asarray(got.allow),
+                              np.asarray(want.allow))
+        assert np.array_equal(np.asarray(got.wait_ms),
+                              np.asarray(want.wait_ms))
+    assert len(ring._free) == depth + ring.grown   # all recycled
+    on_s.close()
+    off_s.close()
 
 
 def test_donation_escape_hatch(clk, monkeypatch):

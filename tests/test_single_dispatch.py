@@ -99,13 +99,10 @@ def test_parity_on_vs_off_bitwise(monkeypatch, origins):
     reload, ~25% prioritized (the origins variant drives the general /
     split side so the sketch threads through multi-program steps).
 
-    ``SENTINEL_HOST_STAGING=0``: the staging ring's in-place slot reuse
-    can corrupt an operand of a still-in-flight dispatch under tiering
-    churn (a pre-existing, process-history-sensitive race — see ROADMAP
-    known issues); these are bit-parity tests, so take it out of the
-    picture."""
+    Staging stays ON: round 17 tied staging-slot reuse to dispatch
+    settlement, so bit-parity holds with the ring engaged (the old
+    ``SENTINEL_HOST_STAGING=0`` pin is gone — ROADMAP issue 5)."""
     monkeypatch.setenv("SENTINEL_TPU_NATIVE", "0")
-    monkeypatch.setenv("SENTINEL_HOST_STAGING", "0")
     monkeypatch.setenv("SENTINEL_SINGLE_DISPATCH", "1")
     on, _snap_on, sk_on, c_on = _run_engine(
         24, 32, 12, KEYS, RULES, RELOAD, 1601, origins=origins)
@@ -125,10 +122,9 @@ def test_parity_on_vs_off_bitwise(monkeypatch, origins):
 def test_parity_tiered_vs_resident_single_dispatch(monkeypatch):
     """tests/test_tiering.py's load-bearing property survives the fused
     observe: a 24-row tiered engine == a 512-row resident engine, bit
-    for bit, with both on the single-dispatch route. Staging off — same
-    reason as test_parity_on_vs_off_bitwise."""
+    for bit, with both on the single-dispatch route. Staging stays ON
+    (settlement-tied slot reuse — see test_parity_on_vs_off_bitwise)."""
     monkeypatch.setenv("SENTINEL_TPU_NATIVE", "0")
-    monkeypatch.setenv("SENTINEL_HOST_STAGING", "0")
     monkeypatch.setenv("SENTINEL_SINGLE_DISPATCH", "1")
     small, ssnap, _sk, sc = _run_engine(24, 32, 12, KEYS, RULES, RELOAD,
                                         1602)

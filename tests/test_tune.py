@@ -322,6 +322,10 @@ def test_registry_matches_runtime_clamps(monkeypatch):
     from sentinel_tpu.tiering.manager import (
         tier_hot_rows, tier_sketch_bits, tier_sketch_rows, tier_tick_ms,
     )
+    from sentinel_tpu.control.loop import (
+        control_cooldown_ms, control_degrade_rt_ms, control_interval_ms,
+        control_min_admit, control_p99_hi_ms, control_p99_lo_ms,
+    )
     numeric = {
         "SENTINEL_PIPELINE_DEPTH": pipeline_depth,
         "SENTINEL_FRONTEND_BATCH": frontend_batch_max,
@@ -334,6 +338,12 @@ def test_registry_matches_runtime_clamps(monkeypatch):
         "SENTINEL_SKETCH_BITS": tier_sketch_bits,
         "SENTINEL_SKETCH_ROWS": tier_sketch_rows,
         "SENTINEL_TIER_TICK_MS": tier_tick_ms,
+        "SENTINEL_CONTROL_INTERVAL_MS": control_interval_ms,
+        "SENTINEL_CONTROL_P99_HI_MS": control_p99_hi_ms,
+        "SENTINEL_CONTROL_P99_LO_MS": control_p99_lo_ms,
+        "SENTINEL_CONTROL_MIN_ADMIT": control_min_admit,
+        "SENTINEL_CONTROL_COOLDOWN_MS": control_cooldown_ms,
+        "SENTINEL_CONTROL_DEGRADE_RT_MS": control_degrade_rt_ms,
     }
     for env, helper in numeric.items():
         spec = knobs_mod.KNOB_BY_ENV[env]
