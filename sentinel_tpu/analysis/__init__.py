@@ -14,6 +14,22 @@ reviewers (see ``docs/LINT.md`` for the catalog and rationale):
 * **LOCK001** — module-level mutable state mutated from both async and
   threaded contexts without a lock.
 
+v2 adds a two-pass project model (pass 1 builds a cross-module
+``ProjectIndex``: donation wrap sites, thread-reachability closure,
+counter/knob registries; pass 2 runs flow-sensitive per-function
+checks, parallelizable with ``--jobs``) and four whole-program rules
+for the races that actually shipped:
+
+* **LOCK002** — unlocked read of an inferred lock-guarded attribute
+  from a thread-reachable method (the PR 11 ``_seen_idx`` race).
+* **DONATE001** — use of a donated operand / staging slot after
+  dispatch to a ``donate_argnums`` callable (the PR 16/17 bug shape).
+* **ORDER001** — resource freed before the intent record inside a
+  locked region (the PR 15 demote TOCTOU).
+* **CAT001** — registry drift: counter keys vs ``CATALOG`` and its
+  wire-order manifest; ``SENTINEL_*`` env reads and read-site clamps
+  vs the knob/config registries.
+
 Usage::
 
     python -m sentinel_tpu.analysis sentinel_tpu/

@@ -45,10 +45,16 @@ class Finding:
     message: str
     suppressed: bool = False
     suppress_reason: str = ""
+    baselined: bool = False        # matched a --baseline entry
 
     @property
     def sort_key(self) -> Tuple[str, int, int, str]:
         return (self.path, self.line, self.col, self.rule_id)
+
+    @property
+    def active(self) -> bool:
+        """Counts against the zero-unsuppressed CI gate."""
+        return not self.suppressed and not self.baselined
 
     def to_dict(self) -> dict:
         return {
@@ -59,10 +65,15 @@ class Finding:
             "message": self.message,
             "suppressed": self.suppressed,
             "suppress_reason": self.suppress_reason,
+            "baselined": self.baselined,
         }
 
     def format(self) -> str:
-        tag = " [suppressed: %s]" % self.suppress_reason if self.suppressed else ""
+        tag = ""
+        if self.suppressed:
+            tag = " [suppressed: %s]" % self.suppress_reason
+        elif self.baselined:
+            tag = " [baselined]"
         return "%s:%d:%d: %s %s%s" % (
             self.path, self.line, self.col, self.rule_id, self.message, tag)
 
@@ -264,6 +275,15 @@ def analyze_source(path: str, source: str,
     return _check_module(ctx, rules)
 
 
+def _registry_rule_ids() -> List[str]:
+    """Every registered rule id + the meta ids — suppression comments
+    are validated against the FULL registry, not the (possibly
+    ``--rule``-filtered) active set, so a subset run never misreads a
+    valid suppression as naming an unknown rule."""
+    from sentinel_tpu.analysis.rules import RULES_BY_ID
+    return list(RULES_BY_ID)
+
+
 def _check_module(ctx: ModuleContext,
                   rules: Sequence[Rule]) -> List[Finding]:
     """Per-module rule run + suppression application + meta-findings
@@ -273,7 +293,7 @@ def _check_module(ctx: ModuleContext,
         findings.extend(rule.check(ctx))
 
     sups, meta = parse_suppressions(ctx.path, ctx.source,
-                                    [r.id for r in rules])
+                                    _registry_rule_ids())
     by_line: Dict[int, List[Suppression]] = {}
     for s in sups:
         by_line.setdefault(s.target_line, []).append(s)
@@ -283,8 +303,11 @@ def _check_module(ctx: ModuleContext,
                 f.suppressed = True
                 f.suppress_reason = s.reason
                 s.used = True
+    active_ids = {r.id for r in rules}
     for s in sups:
-        if not s.used:
+        # a suppression whose rules were all filtered out this run
+        # (``--rule`` subset) cannot have been consumed — not "unused"
+        if not s.used and set(s.rule_ids) & active_ids:
             meta.append(Finding(
                 UNUSED_SUPPRESSION, ctx.path, s.comment_line, 0,
                 "unused suppression for %s (finding fixed? delete the "
@@ -310,26 +333,46 @@ def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
             yield p
 
 
-def analyze_paths(paths: Iterable[str],
-                  rules: Sequence[Rule]) -> List[Finding]:
-    """Whole-run analysis: parse every module first, give each rule its
-    cross-module ``prepare`` pass over all of them, then check each."""
-    out: List[Finding] = []
-    contexts: List[ModuleContext] = []
-    for fp in iter_python_files(paths):
+def parse_contexts(files: Iterable[str]):
+    """Parse every file into a ModuleContext. Returns ``(contexts,
+    errors)`` where errors are GL999 findings for unreadable/unparsable
+    files. The context list is a :class:`~.project.ContextSet` so the
+    pass-1 project index built by the first rule's ``prepare`` is
+    shared by the rest (and by parallel workers, per process)."""
+    from sentinel_tpu.analysis.project import ContextSet
+    errors: List[Finding] = []
+    contexts = ContextSet()
+    for fp in files:
         try:
             with open(fp, "r", encoding="utf-8") as fh:
                 source = fh.read()
         except (OSError, UnicodeDecodeError) as exc:
-            out.append(Finding(PARSE_ERROR, fp, 1, 0, "unreadable: %s" % exc))
+            errors.append(Finding(PARSE_ERROR, fp, 1, 0,
+                                  "unreadable: %s" % exc))
             continue
         try:
             tree = ast.parse(source, filename=fp)
         except SyntaxError as exc:
-            out.append(Finding(PARSE_ERROR, fp, exc.lineno or 1,
-                               exc.offset or 0, "syntax error: %s" % exc.msg))
+            errors.append(Finding(PARSE_ERROR, fp, exc.lineno or 1,
+                                  exc.offset or 0,
+                                  "syntax error: %s" % exc.msg))
             continue
         contexts.append(ModuleContext(fp, source, tree))
+    return contexts, errors
+
+
+def check_context(ctx: ModuleContext,
+                  rules: Sequence[Rule]) -> List[Finding]:
+    """Pass-2 for one already-prepared module (the per-file unit the
+    ``--jobs`` worker pool distributes)."""
+    return _check_module(ctx, rules)
+
+
+def analyze_paths(paths: Iterable[str],
+                  rules: Sequence[Rule]) -> List[Finding]:
+    """Whole-run analysis: parse every module first, give each rule its
+    cross-module ``prepare`` pass over all of them, then check each."""
+    contexts, out = parse_contexts(iter_python_files(paths))
     for rule in rules:
         rule.prepare(contexts)
     for ctx in contexts:

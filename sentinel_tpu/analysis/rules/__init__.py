@@ -14,6 +14,10 @@ from sentinel_tpu.analysis.rules.device import DeviceImportRule
 from sentinel_tpu.analysis.rules.trace import TraceHygieneRule
 from sentinel_tpu.analysis.rules.async_block import AsyncBlockingRule
 from sentinel_tpu.analysis.rules.locks import SharedStateRule
+from sentinel_tpu.analysis.rules.lockdiscipline import LockDisciplineRule
+from sentinel_tpu.analysis.rules.donate import UseAfterDispatchRule
+from sentinel_tpu.analysis.rules.order import IntentBeforeFreeRule
+from sentinel_tpu.analysis.rules.registry import RegistryDriftRule
 
 ALL_RULES: List[Rule] = [
     SpmdRule(),
@@ -21,6 +25,10 @@ ALL_RULES: List[Rule] = [
     TraceHygieneRule(),
     AsyncBlockingRule(),
     SharedStateRule(),
+    LockDisciplineRule(),
+    UseAfterDispatchRule(),
+    IntentBeforeFreeRule(),
+    RegistryDriftRule(),
 ]
 
 RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in ALL_RULES}

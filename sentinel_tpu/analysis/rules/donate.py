@@ -1,0 +1,331 @@
+"""DONATE001 — use of a donated operand / staged slot after dispatch.
+
+Two historical shapes, one contract — *an array handed to the device
+is not yours until the dispatch settles*:
+
+* **Donated operands.** The engine's jitted steps donate their state
+  operand (``donate_argnums``): after ``out = step(state, x)`` the
+  buffers behind ``state`` are the device's scratch. Reading ``state``
+  again observes freed/aliased memory (JAX raises on CPU, silently
+  corrupts under some async backends). Every legitimate call site
+  rebinds (``state = step(state, x)``).
+* **Staging slots.** ``_StagingRing.acquire()`` hands out preallocated
+  host buffers that a dispatch reads *asynchronously* (deferred
+  host→device copy). Rewriting a slot (``pad_into(slot[...], ...)`` or
+  a subscript store) after it was passed into a dispatch but before
+  ``release(slot)`` / a settle is the PR 16/17 staging-ring bug: the
+  in-flight program reads the new batch's bytes.
+
+Detection is flow-sensitive per function over a straight-line
+approximation (statements ordered by source line, branches treated as
+sequential):
+
+1. Donation provenance comes from pass 1 (:mod:`..project`): every
+   ``jax.jit(f, donate_argnums=...)`` wrap site — including the
+   ``**kw_d1`` splat-dict idiom inside ``_build_sd_steps`` /
+   ``_jitted_steps_cached`` — maps both the wrapped function and the
+   assignment target (``self._jit_decide``) to its donated positions,
+   propagated through simple re-binds.
+2. A call to a donating callable consumes the Name / ``self.attr``
+   passed at each donated position — unless the same statement rebinds
+   it (the ``state = step(state, ...)`` idiom). Any later read of a
+   consumed name before a rebind flags.
+3. A slot from ``<ring/staging>.acquire()`` becomes in-flight when it
+   (or a view of it: ``v = pad_into(slot[...], ...)`` / ``v =
+   slot[...]``) is passed to a donating or dispatch-named callable;
+   any later write into the slot before ``release(slot)`` flags.
+
+Any settle-like call (``.settle()`` / ``.result()`` /
+``.block_until_ready()`` / ``sync_global_devices``) conservatively
+clears all tracked state — after a settle the device has consumed the
+operands, so the rule never flags past one. Cross-function settles
+(caller settles the returned handle) therefore never false-positive:
+the rule only flags *uses*, never a missing settle.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from sentinel_tpu.analysis import project
+from sentinel_tpu.analysis.core import Finding, ModuleContext, Rule
+from sentinel_tpu.analysis.rules import _shared
+
+_SETTLE_METHODS = frozenset({
+    "settle", "result", "block_until_ready", "join", "wait",
+})
+_SETTLE_CALLS = frozenset({
+    "jax.block_until_ready",
+    "jax.experimental.multihost_utils.sync_global_devices",
+})
+#: Callee-name fragments that mark a call as a device dispatch for
+#: staged-slot purposes even without known donation provenance.
+_DISPATCH_FRAGMENTS = ("step", "decide", "dispatch", "_jit")
+#: Writers that fill a buffer in place.
+_FILL_CALLS = frozenset({"pad_into", "copyto", "numpy.copyto"})
+_RINGISH = ("ring", "staging", "slab")
+
+
+class UseAfterDispatchRule(Rule):
+    id = "DONATE001"
+    name = "use-after-dispatch-of-donated-buffer"
+    rationale = (
+        "a donated operand or acquired staging slot belongs to the "
+        "in-flight dispatch until settle/release; touching it early is "
+        "the staging-ring rewrite bug (freed/aliased device memory)")
+
+    def prepare(self, contexts) -> None:
+        self._index = project.shared_index(contexts)
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        index = getattr(self, "_index", None)
+        if index is None:
+            index = project.shared_index([ctx])
+        donating = dict(index.donating)
+        donating.update(project._donating_callables(ctx))
+        for fn in _shared.iter_functions(ctx.tree):
+            yield from _FunctionScan(self, ctx, donating).run(fn)
+
+
+class _FunctionScan:
+    """One function's straight-line scan. Tracks consumed (donated)
+    names, staged slots, slot views, and in-flight slots."""
+
+    def __init__(self, rule: UseAfterDispatchRule, ctx: ModuleContext,
+                 donating: Dict[str, Tuple[int, ...]]):
+        self.rule = rule
+        self.ctx = ctx
+        self.donating = donating
+        self.consumed: Dict[str, Tuple[str, int]] = {}  # name -> (callee, line)
+        self.staged: Set[str] = set()
+        self.views: Dict[str, str] = {}                 # view -> slot
+        self.inflight: Dict[str, Tuple[str, int]] = {}  # slot -> (callee, line)
+
+    def run(self, fn: ast.AST) -> Iterator[Finding]:
+        stmts = sorted(
+            (n for n in _shared.walk_without_nested_functions(fn)
+             if isinstance(n, ast.stmt)),
+            key=lambda n: (n.lineno, n.col_offset))
+        for stmt in stmts:
+            yield from self._scan_stmt(stmt)
+
+    @staticmethod
+    def _own_nodes(stmt: ast.stmt) -> Iterator[ast.AST]:
+        """The nodes this statement itself evaluates. Compound statements
+        contribute only their header expressions — their body statements
+        are scanned individually (each with its own rebind exemptions),
+        so walking the whole subtree here would double-process them."""
+        if isinstance(stmt, (ast.If, ast.While)):
+            roots: List[ast.AST] = [stmt.test]
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            roots = [stmt.target, stmt.iter]
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            roots = [i.context_expr for i in stmt.items]
+            roots += [i.optional_vars for i in stmt.items
+                      if i.optional_vars is not None]
+        elif isinstance(stmt, (ast.Try, ast.ClassDef) + _shared.FUNC_NODES):
+            roots = []
+        else:
+            roots = [stmt]
+        for r in roots:
+            yield from ast.walk(r)
+
+    # ------------------------------------------------------------------
+    def _scan_stmt(self, stmt: ast.stmt) -> Iterator[Finding]:
+        rebinds = self._rebound_names(stmt)
+        # 1. flag reads of consumed names (before processing new events,
+        #    but a same-statement rebind of that name is the safe idiom)
+        yield from self._flag_uses(stmt, rebinds)
+        # 2. rebinds kill stale tracking BEFORE this statement's calls
+        #    are processed — ``slot = ring.acquire()`` must end with the
+        #    fresh staging, not have it killed by its own rebind
+        for name in rebinds:
+            self.consumed.pop(name, None)
+            if name in self.views:
+                del self.views[name]
+            if name in self.staged:
+                self.staged.discard(name)
+                self.inflight.pop(name, None)
+        # 3. process calls in this statement: settles, releases,
+        #    dispatches, acquires, view bindings
+        for node in self._own_nodes(stmt):
+            if isinstance(node, ast.Call):
+                self._process_call(node, stmt)
+
+    def _flag_uses(self, stmt: ast.stmt,
+                   rebinds: Set[str]) -> Iterator[Finding]:
+        for node in self._own_nodes(stmt):
+            key = _ref_key(node)
+            if key is None:
+                continue
+            if key in self.consumed and key not in rebinds:
+                callee, line = self.consumed[key]
+                # the consuming call itself re-walks here; skip nodes on
+                # the consuming line
+                if node.lineno == line:
+                    continue
+                yield self.rule.finding(
+                    self.ctx, node,
+                    "'%s' was donated to '%s' (line %d) and is %s here "
+                    "before any settle — the buffer belongs to the "
+                    "in-flight dispatch; use the returned value or "
+                    "settle first" % (
+                        key, callee, line,
+                        "written" if isinstance(
+                            getattr(node, "ctx", None), ast.Store)
+                        else "read"))
+                del self.consumed[key]        # one finding per donation
+        # slot rewrites: subscript store into an in-flight slot, or an
+        # in-place fill call targeting it
+        for node in self._own_nodes(stmt):
+            slot = self._written_slot(node)
+            if slot is not None and slot in self.inflight:
+                callee, line = self.inflight[slot]
+                yield self.rule.finding(
+                    self.ctx, node,
+                    "staging slot '%s' is rewritten here while the "
+                    "dispatch through '%s' (line %d) may still read it "
+                    "— release the slot on settlement first (the "
+                    "PR 16/17 staging-ring bug)" % (slot, callee, line))
+                del self.inflight[slot]
+
+    # ------------------------------------------------------------------
+    def _process_call(self, call: ast.Call, stmt: ast.stmt) -> None:
+        name = self.ctx.call_name(call)
+        attr = call.func.attr if isinstance(call.func, ast.Attribute) \
+            else None
+        # settle: conservatively clears everything
+        if attr in _SETTLE_METHODS or name in _SETTLE_CALLS:
+            self.consumed.clear()
+            self.inflight.clear()
+            return
+        # release(slot)
+        if attr == "release" or (isinstance(call.func, ast.Name)
+                                 and call.func.id == "release"):
+            for arg in call.args:
+                key = _ref_key(arg)
+                if key is not None:
+                    self.inflight.pop(key, None)
+                    self.staged.discard(key)
+            return
+        # slot = ring.acquire()
+        if attr == "acquire" and self._ringish_receiver(call.func.value):
+            target = _assign_target(stmt, call)
+            if target is not None:
+                self.staged.add(target)
+                self.inflight.pop(target, None)
+            return
+        # view = pad_into(slot[...], ...) / plain slot subscript binding
+        if name is not None and name.rsplit(".", 1)[-1] in _FILL_CALLS:
+            slot = self._slot_of_args(call.args[:1])
+            if slot is not None:
+                target = _assign_target(stmt, call)
+                if target is not None:
+                    self.views[target] = slot
+            return
+        # donation / dispatch
+        bare = (attr or (call.func.id if isinstance(call.func, ast.Name)
+                         else None))
+        positions = None
+        if bare is not None and bare in self.donating:
+            positions = self.donating[bare]
+        if positions is not None:
+            rebinds = self._rebound_names(stmt)
+            for pos in positions:
+                if pos < len(call.args):
+                    key = _ref_key(call.args[pos])
+                    if key is not None and key not in rebinds:
+                        self.consumed[key] = (bare, call.lineno)
+        if positions is not None or self._dispatchish(bare):
+            slot = self._slot_of_args(call.args) or \
+                self._slot_of_args(kw.value for kw in call.keywords)
+            if slot is not None:
+                self.inflight.setdefault(slot, (bare or "<call>",
+                                                call.lineno))
+
+    # ------------------------------------------------------------------
+    def _ringish_receiver(self, recv: ast.AST) -> bool:
+        dotted = self.ctx.dotted(recv)
+        if dotted is None:
+            return False
+        low = dotted.lower()
+        return any(tok in low for tok in _RINGISH)
+
+    def _dispatchish(self, bare: Optional[str]) -> bool:
+        if bare is None:
+            return False
+        low = bare.lower()
+        return any(tok in low for tok in _DISPATCH_FRAGMENTS)
+
+    def _slot_of_args(self, args) -> Optional[str]:
+        """First staged slot referenced by these argument expressions
+        (directly, via subscript, or via a recorded view name)."""
+        for arg in args:
+            for node in ast.walk(arg if isinstance(arg, ast.AST) else arg):
+                if isinstance(node, ast.Name):
+                    if node.id in self.staged:
+                        return node.id
+                    if node.id in self.views:
+                        return self.views[node.id]
+        return None
+
+    def _written_slot(self, node: ast.AST) -> Optional[str]:
+        """slot for ``slot[...] = x`` / ``slot["c"][...] = x`` stores and
+        in-place fill calls (``pad_into(slot[...], ...)``)."""
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript):
+                    base = t
+                    while isinstance(base, ast.Subscript):
+                        base = base.value
+                    if isinstance(base, ast.Name) and base.id in self.staged:
+                        return base.id
+        elif isinstance(node, ast.Call):
+            name = self.ctx.call_name(node)
+            if name is not None and \
+                    name.rsplit(".", 1)[-1] in _FILL_CALLS and node.args:
+                return self._slot_of_args(node.args[:1])
+        return None
+
+    def _rebound_names(self, stmt: ast.stmt) -> Set[str]:
+        out: Set[str] = set()
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.Delete):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            targets = [stmt.target]
+        for t in targets:
+            key = _ref_key(t)
+            if key is not None:
+                out.add(key)
+            for n in ast.walk(t):
+                k = _ref_key(n)
+                if k is not None:
+                    out.add(k)
+        return out
+
+
+def _ref_key(node: ast.AST) -> Optional[str]:
+    """Canonical tracking key: bare Name → ``x``; ``self.attr`` →
+    ``self.attr``. Other expressions don't track."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return "self." + node.attr
+    return None
+
+
+def _assign_target(stmt: ast.stmt, call: ast.Call) -> Optional[str]:
+    """Name the statement binds the call's result to, if any."""
+    if isinstance(stmt, ast.Assign) and stmt.value is call and \
+            len(stmt.targets) == 1:
+        return _ref_key(stmt.targets[0])
+    return None

@@ -136,7 +136,7 @@ class ClusterCoordinator:
     def info(self) -> dict:
         # lock-free snapshot: a mode change can hold the lock for seconds
         # (engine compile) and getClusterMode must not block behind it
-        out = {"effectiveMode": self.mode}
+        out = {"effectiveMode": self.mode}  # graftlint: disable=LOCK002 -- lock-free snapshot by design; a mode swap holds the lock for seconds and info() must not block
         server, client = self.server, self.client
         if server is not None:
             out["serverPort"] = server.port

@@ -49,12 +49,12 @@ class StatisticCallbackRegistry:
 
     @property
     def empty(self) -> bool:
-        return not (self._on_pass or self._on_blocked or self._on_exit)
+        return not (self._on_pass or self._on_blocked or self._on_exit)  # graftlint: disable=LOCK002 -- copy-on-write lists: writers swap whole lists under the lock; lock-free reads see one coherent snapshot
 
     # dispatch (copy-on-write lists: iteration is lock-free)
     def fire_pass(self, resource: str, origin: str, acquire: int,
                   args: Sequence = ()) -> None:
-        for fn in self._on_pass:
+        for fn in self._on_pass:  # graftlint: disable=LOCK002 -- copy-on-write list swap under the lock; lock-free iteration is the documented dispatch contract
             try:
                 fn(resource, origin, acquire, args)
             except Exception as exc:
@@ -70,7 +70,7 @@ class StatisticCallbackRegistry:
 
     def fire_exit(self, resource: str, rt_ms: int, error: bool,
                   acquire: int) -> None:
-        for fn in self._on_exit:
+        for fn in self._on_exit:  # graftlint: disable=LOCK002 -- copy-on-write list swap under the lock; lock-free iteration is the documented dispatch contract
             try:
                 fn(resource, rt_ms, error, acquire)
             except Exception as exc:

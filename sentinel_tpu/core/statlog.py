@@ -121,7 +121,7 @@ class AsyncRollingAppender:
         after close() only reach disk via an explicit flush()."""
         self._stop.set()
         self._wake.set()
-        t = self._thread
+        t = self._thread  # graftlint: disable=LOCK002 -- benign: _stop is set before the read; joining a stale thread handle is harmless
         if t is not None and t.is_alive():
             t.join(timeout=5)
         self._drain()
@@ -129,7 +129,7 @@ class AsyncRollingAppender:
             _all_appenders.discard(self)
 
     def _ensure_daemon(self) -> None:
-        if self._thread is not None and self._thread.is_alive():
+        if self._thread is not None and self._thread.is_alive():  # graftlint: disable=LOCK002 -- double-checked locking: this lock-free check is re-verified under _q_lock before spawning
             return
         with self._q_lock:
             if self._thread is not None and self._thread.is_alive():

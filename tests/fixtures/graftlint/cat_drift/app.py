@@ -1,0 +1,27 @@
+"""CAT001 drift fixture call sites: an undeclared counter key (the
+silent-aggregation-drop bug), an undeclared SENTINEL_* env read (the
+``SENTINEL_PIPLINE_DEPTH`` typo class), and a read-site clamp that
+disagrees with the KnobSpec. Parsed, never imported."""
+
+import os
+
+
+def _env_int(env, default, lo, hi):
+    raw = os.environ.get(env)
+    return default if raw is None else min(hi, max(lo, int(raw)))
+
+
+class App:
+
+    def __init__(self, obs):
+        self._obs = obs
+        # BAD: KnobSpec says [1, 64]; this site clamps to [1, 128]
+        self.depth = _env_int("SENTINEL_CAT_DEPTH", 4, 1, 128)
+        # BAD: never declared anywhere (typo ships silently)
+        if os.environ.get("SENTINEL_CAT_MISSING"):
+            self.depth = 0
+
+    def tick(self):
+        counters = self._obs.counters
+        counters.add("entry.typo")     # BAD: not in CATALOG
+        counters.add("entry.debug")  # graftlint: disable=CAT001 -- fixture: scratch key, reviewed

@@ -1,0 +1,11 @@
+"""CAT001 drift fixture: ``tier.promoted`` was appended to CATALOG but
+never landed in the manifest — the reviewed wire order is behind."""
+
+ENTRY_PASS = "entry.pass"
+ENTRY_BLOCK = "entry.block"
+
+CATALOG = (
+    ENTRY_PASS,
+    ENTRY_BLOCK,
+    "tier.promoted",
+)

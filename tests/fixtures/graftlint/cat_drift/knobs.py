@@ -1,0 +1,13 @@
+"""Knob registry for the CAT001 drift fixture."""
+
+from collections import namedtuple
+
+KnobSpec = namedtuple("KnobSpec", "env kind default lo hi")
+
+KNOBS = (
+    KnobSpec("SENTINEL_CAT_DEPTH", "int", 4, 1, 64),
+)
+
+OPERATIONAL_ENVS = {
+    "SENTINEL_CAT_DISABLE": None,
+}

@@ -1,0 +1,28 @@
+"""CAT001 clean twin: every counter key is in CATALOG (or under a
+declared dynamic prefix), every SENTINEL_* env read is declared, and
+the read-site clamp matches the KnobSpec. Parsed, never imported."""
+
+import os
+
+ENTRY_PASS = "entry.pass"
+BLOCK_REASON_PREFIX = "block_reason."
+
+
+def _env_int(env, default, lo, hi):
+    raw = os.environ.get(env)
+    return default if raw is None else min(hi, max(lo, int(raw)))
+
+
+class App:
+
+    def __init__(self, obs):
+        self._obs = obs
+        self.depth = _env_int("SENTINEL_CAT_DEPTH", 4, 1, 64)
+        if os.environ.get("SENTINEL_CAT_DISABLE"):
+            self.depth = 0
+
+    def tick(self, reason):
+        counters = self._obs.counters
+        counters.add(ENTRY_PASS)
+        counters.add("entry.block")
+        counters.add(BLOCK_REASON_PREFIX + reason)

@@ -1,0 +1,12 @@
+"""Mini counter registry (CAT001 clean twin) — basename convention:
+``counters.py`` with a top-level ``CATALOG`` tuple. Parsed, never
+imported."""
+
+ENTRY_PASS = "entry.pass"
+ENTRY_BLOCK = "entry.block"
+BLOCK_REASON_PREFIX = "block_reason."
+
+CATALOG = (
+    ENTRY_PASS,
+    ENTRY_BLOCK,
+)

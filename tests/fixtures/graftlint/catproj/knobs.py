@@ -1,0 +1,16 @@
+"""Mini knob registry (CAT001 clean twin) — basename convention:
+``knobs.py`` with a ``KNOBS`` tuple of ``KnobSpec`` calls and an
+``OPERATIONAL_ENVS`` dict. Parsed, never imported."""
+
+from collections import namedtuple
+
+KnobSpec = namedtuple("KnobSpec", "env kind default lo hi")
+
+KNOBS = (
+    KnobSpec("SENTINEL_CAT_DEPTH", "int", 4, 1, 64),
+    KnobSpec("SENTINEL_CAT_GAIN", "float", 0.5, 0.0, 1.0),
+)
+
+OPERATIONAL_ENVS = {
+    "SENTINEL_CAT_DISABLE": None,
+}

@@ -1,0 +1,68 @@
+"""DONATE001 fixture — donated-operand reuse and the PR 16/17
+staging-slot rewrite, with the rebind / settle / release clean twins.
+
+``step`` donates position 0 directly; ``step_kw`` donates position 1
+through the ``**kw_d1`` splat-dict idiom the engine uses. Parsed by
+tests, never imported (``pad_into`` and the ring are stand-ins).
+"""
+
+import jax
+
+
+def _decide(state, batch):
+    return state
+
+
+step = jax.jit(_decide, donate_argnums=(0,))
+
+kw_d1 = {"donate_argnums": (1,)}
+step_kw = jax.jit(_decide, **kw_d1)
+
+
+def use_after_donate(state, batch):
+    out = step(state, batch)
+    stale = state.counts               # BAD: state belongs to the dispatch
+    return out, stale
+
+
+def use_after_donate_suppressed(state, batch):
+    out = step(state, batch)
+    stale = state.counts  # graftlint: disable=DONATE001 -- fixture: reviewed copy-on-host before dispatch
+    return out, stale
+
+
+def splat_donation_fires(ruleset, state, batch):
+    out = step_kw(ruleset, state, batch)
+    peek = state.counts                # BAD: position 1 donated via **kw_d1
+    return out, peek
+
+
+def rebind_is_clean(state, batch):
+    state = step(state, batch)
+    state, aux = step_kw(None, state, batch)
+    for _ in range(2):
+        state, aux = step_kw(None, state, batch)
+    return state.counts, aux
+
+
+def settle_is_clean(state, batch):
+    out = step(state, batch)
+    out.block_until_ready()
+    return state.counts                # OK: dispatch settled
+
+
+def ring_rewrite(ring, batch, extra):
+    slot = ring.acquire()
+    view = pad_into(slot[:64], batch)
+    handle = step(view, extra)
+    slot[:8] = 0                       # BAD: in-flight slot rewritten
+    return handle
+
+
+def ring_release_is_clean(ring, batch, extra):
+    slot = ring.acquire()
+    view = pad_into(slot[:64], batch)
+    handle = step(view, extra)
+    ring.release(slot)                 # settlement path freed the slot
+    slot[:8] = 0
+    return handle

@@ -1,0 +1,66 @@
+"""LOCK002 fixture — the PR 11 ``_seen_idx`` race shape.
+
+``DispatchTracker`` writes ``_seen_idx`` under ``_lock`` at two sites
+(which infers the lock discipline) and reads it lock-free from a
+telemetry thread — the exact staleness-stamp race the serving tick
+shipped with. The clean twins exercise every escape hatch: lock held,
+``*_locked`` contract name, docstring contract, construction writes,
+suppression, and the below-threshold single-write class.
+
+Parsed by tests, never imported.
+"""
+
+import threading
+
+
+class DispatchTracker:
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._seen_idx = -1            # construction write: exempt
+        self._thread = threading.Thread(target=self._poll, daemon=True)
+
+    def observe(self, idx):
+        with self._lock:
+            self._seen_idx = idx
+
+    def restamp(self, idx):
+        with self._lock:
+            self._seen_idx = idx + 1
+
+    def _poll(self):
+        stale = self._seen_idx         # BAD: unlocked read on the thread
+        self._audit()
+        return stale
+
+    def _audit(self):
+        return self._seen_idx  # graftlint: disable=LOCK002 -- fixture: reviewed stale-tolerant audit read
+
+    def peek_locked(self):
+        return self._seen_idx          # OK: *_locked contract name
+
+    def restamp_if_stale(self, idx):
+        """Callers hold ``_lock`` (the decide path restamps in place)."""
+        if self._seen_idx < idx:       # OK: docstring lock contract
+            self._seen_idx = idx
+
+    def read_under_lock(self):
+        with self._lock:
+            return self._seen_idx      # OK: lock held
+
+
+class SingleWriterIsClean:
+    """One locked write site is below the inference threshold — the
+    discipline is never inferred, so the lock-free read is silent."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._mark = 0
+        self._thread = threading.Thread(target=self._show, daemon=True)
+
+    def set_mark(self, v):
+        with self._lock:
+            self._mark = v
+
+    def _show(self):
+        return self._mark              # OK: no inferred discipline

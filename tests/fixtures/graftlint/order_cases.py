@@ -1,0 +1,43 @@
+"""ORDER001 fixture — the PR 15 demote TOCTOU: inside a locked region,
+the pending-intent record must precede the free/evict, or a concurrent
+decide between the evict and the record sees neither the row nor the
+intent. Covers the ``getattr`` free-alias idiom, the suppressed case,
+and the intent-first clean twin. Parsed by tests, never imported.
+"""
+
+import threading
+
+
+class Demoter:
+
+    def __init__(self, registry):
+        self._lock = threading.Lock()
+        self._registry = registry
+        self._pending_demote = {}
+        self._shadow = {}
+
+    def demote_bad(self, name, payload):
+        with self._lock:
+            evict = getattr(self._registry, "evict_name", None)
+            evict(name)                          # BAD: free precedes intent
+            self._pending_demote[name] = payload
+
+    def demote_bad_direct(self, name, payload):
+        with self._lock:
+            self._registry.evict_name(name)      # BAD: free precedes intent
+            self._shadow[name] = payload
+
+    def demote_suppressed(self, name, payload):
+        with self._lock:
+            self._registry.evict_name(name)  # graftlint: disable=ORDER001 -- fixture: reviewed, decide path drains under this lock
+            self._pending_demote[name] = payload
+
+    def demote_good(self, name, payload):
+        with self._lock:
+            self._pending_demote[name] = payload
+            self._shadow[name] = payload
+            self._registry.evict_name(name)      # OK: intent recorded first
+
+    def unlocked_is_silent(self, name, payload):
+        self._registry.evict_name(name)
+        self._pending_demote[name] = payload     # OK: not a locked region

@@ -54,8 +54,26 @@ def source_line(name, lineno):
 # The acceptance gate: the real package is clean
 # ----------------------------------------------------------------------
 
-def test_package_is_clean():
-    findings = analyze_paths([PACKAGE_DIR], ALL_RULES)
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: The CI lint scope. LOCK002's thread-reachability closure is
+#: whole-program, so the zero-findings gate is defined over THIS scope
+#: (linting a subset can report suppressions as unused — see
+#: docs/LINT.md).
+REPO_SCOPE = [os.path.join(REPO_ROOT, p)
+              for p in ("sentinel_tpu", "benchmarks", "bench.py",
+                        "demos", "tests")
+              if os.path.exists(os.path.join(REPO_ROOT, p))]
+
+
+def repo_scope_files():
+    from sentinel_tpu.analysis.core import iter_python_files
+    frag = os.path.join("tests", "fixtures", "graftlint")
+    return [f for f in iter_python_files(REPO_SCOPE) if frag not in f]
+
+
+def test_repo_is_clean_at_ci_scope():
+    findings = analyze_paths(repo_scope_files(), ALL_RULES)
     assert active(findings) == [], "\n".join(
         f.format() for f in active(findings))
 
@@ -240,9 +258,10 @@ def test_json_report_shape():
     assert rec["line"] > 0 and not rec["suppressed"]
 
 
-def test_cli_gate_green_on_package():
+def test_cli_gate_green_at_ci_scope():
     proc = subprocess.run(
-        [sys.executable, "-m", "sentinel_tpu.analysis", PACKAGE_DIR],
+        [sys.executable, "-m", "sentinel_tpu.analysis", *REPO_SCOPE,
+         "--exclude", os.path.join("tests", "fixtures", "graftlint")],
         capture_output=True, text=True, timeout=300,
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stdout + proc.stderr
@@ -265,6 +284,224 @@ def test_cli_gate_red_on_regression_fixture(tmp_path):
 
 def test_rule_catalog_is_stable():
     assert set(RULES_BY_ID) == {
-        "SPMD001", "DEV001", "TRACE001", "ASYNC001", "LOCK001"}
+        "SPMD001", "DEV001", "TRACE001", "ASYNC001", "LOCK001",
+        "LOCK002", "DONATE001", "ORDER001", "CAT001"}
     for rule in ALL_RULES:
         assert rule.name and rule.rationale
+
+
+# ----------------------------------------------------------------------
+# LOCK002 — the PR 11 _seen_idx lock-discipline race shape
+# ----------------------------------------------------------------------
+
+def test_lock002_flags_unlocked_read_in_thread_reachable_method():
+    findings = lint_fixture("lock_discipline_cases.py")
+    hits = active(findings, "LOCK002")
+    assert len(hits) == 1
+    assert "_seen_idx" in hits[0].message
+    assert "_poll" in hits[0].message
+    assert "self._seen_idx" in source_line(
+        "lock_discipline_cases.py", hits[0].line)
+
+
+def test_lock002_escape_hatches_stay_silent():
+    # *_locked names, docstring lock contracts, construction writes,
+    # reads under the lock, and the below-threshold single-write class
+    # must all be silent — only _poll (active) and _audit (suppressed)
+    # may report.
+    findings = lint_fixture("lock_discipline_cases.py")
+    sup = suppressed(findings, "LOCK002")
+    assert len(sup) == 1 and "_audit" in sup[0].message
+    all_lock002 = [f for f in findings if f.rule_id == "LOCK002"]
+    assert len(all_lock002) == 2
+    assert not any("SingleWriterIsClean" in f.message for f in all_lock002)
+
+
+# ----------------------------------------------------------------------
+# DONATE001 — donated operands + the PR 16/17 staging-slot rewrite
+# ----------------------------------------------------------------------
+
+def test_donate001_flags_use_after_donate_and_splat_idiom():
+    findings = lint_fixture("donate_cases.py")
+    hits = active(findings, "DONATE001")
+    msgs = [f.message for f in hits]
+    assert any("donated to 'step'" in m and "read here" in m for m in msgs)
+    # position-1 donation through the **kw_d1 splat-dict wrap idiom
+    assert any("donated to 'step_kw'" in m for m in msgs)
+
+
+def test_donate001_flags_staging_slot_rewrite():
+    findings = lint_fixture("donate_cases.py")
+    slot_hits = [f for f in active(findings, "DONATE001")
+                 if "staging slot" in f.message]
+    assert len(slot_hits) == 1
+    assert "slot[:8] = 0" in source_line(
+        "donate_cases.py", slot_hits[0].line)
+
+
+def test_donate001_rebind_settle_release_twins_are_clean():
+    findings = lint_fixture("donate_cases.py")
+    hits = active(findings, "DONATE001")
+    lines = {source_line("donate_cases.py", f.line) for f in hits}
+    for fragment in ("rebind_is_clean", "settle_is_clean",
+                     "ring_release_is_clean"):
+        # no finding may anchor inside a clean-twin function
+        assert not any(fragment in ln for ln in lines)
+    assert len(hits) == 3                     # two donations + one slot
+    assert len(suppressed(findings, "DONATE001")) == 1
+
+
+# ----------------------------------------------------------------------
+# ORDER001 — the PR 15 demote intent-before-free TOCTOU shape
+# ----------------------------------------------------------------------
+
+def test_order001_flags_free_before_intent_in_locked_region():
+    findings = lint_fixture("order_cases.py")
+    hits = active(findings, "ORDER001")
+    assert len(hits) == 2                     # alias form + direct form
+    for f in hits:
+        assert "evict_name" in f.message
+        assert "record intent BEFORE freeing" in f.message
+    assert len(suppressed(findings, "ORDER001")) == 1
+
+
+def test_order001_intent_first_and_unlocked_are_silent():
+    findings = lint_fixture("order_cases.py")
+    lines = {source_line("order_cases.py", f.line)
+             for f in active(findings, "ORDER001")}
+    assert not any("intent recorded first" in ln for ln in lines)
+    assert not any("not a locked region" in ln for ln in lines)
+
+
+# ----------------------------------------------------------------------
+# CAT001 — registry drift (counter catalog + env knob declarations)
+# ----------------------------------------------------------------------
+
+def test_cat001_clean_mini_project_is_silent():
+    findings = lint_fixture("catproj")
+    assert active(findings, "CAT001") == [], "\n".join(
+        f.format() for f in active(findings, "CAT001"))
+
+
+def test_cat001_flags_all_four_drift_shapes():
+    findings = lint_fixture("cat_drift")
+    msgs = [f.message for f in active(findings, "CAT001")]
+    assert len(msgs) == 4
+    assert any("'entry.typo' is not in counters.CATALOG" in m
+               for m in msgs)
+    assert any("'tier.promoted' is not in the manifest" in m for m in msgs)
+    assert any("'SENTINEL_CAT_MISSING' is read here but declared nowhere"
+               in m for m in msgs)
+    assert any("clamp [1, 128]" in m and "KnobSpec [1, 64]" in m
+               for m in msgs)
+    assert len(suppressed(findings, "CAT001")) == 1
+
+
+def test_cat001_real_catalog_matches_checked_in_manifest():
+    # the repo's own registry must satisfy the rule it ships
+    from sentinel_tpu.obs.counters import CATALOG
+    manifest_path = os.path.join(PACKAGE_DIR, "obs", "counters_catalog.txt")
+    keys = [ln.strip() for ln in open(manifest_path)
+            if ln.strip() and not ln.startswith("#")]
+    assert list(CATALOG) == keys
+
+
+# ----------------------------------------------------------------------
+# SARIF reporter + baseline ratchet (satellite coverage)
+# ----------------------------------------------------------------------
+
+def test_sarif_report_shape_and_suppressions():
+    findings = lint_fixture("order_cases.py")
+    doc = json.loads(reporting.render_sarif(findings, ALL_RULES))
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftlint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert "ORDER001" in rule_ids and "CAT001" in rule_ids
+    levels = {r["ruleId"]: r["level"] for r in run["results"]}
+    assert levels.get("ORDER001") in ("error", "note")
+    sup = [r for r in run["results"] if r.get("suppressions")]
+    assert len(sup) == 1
+    assert sup[0]["suppressions"][0]["kind"] == "inSource"
+    assert sup[0]["level"] == "note"
+    for r in run["results"]:
+        loc = r["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uriBaseId"] == "%SRCROOT%"
+        assert loc["region"]["startLine"] >= 1
+
+
+def test_baseline_roundtrip_matches_and_ratchets(tmp_path):
+    findings = lint_fixture("order_cases.py")
+    path = str(tmp_path / "baseline.json")
+    n = reporting.write_baseline(findings, path)
+    assert n == 2                              # active findings only
+    fresh = lint_fixture("order_cases.py")
+    matched, stale = reporting.apply_baseline(fresh, path)
+    assert (matched, stale) == (2, 0)
+    assert all(f.baselined for f in active_or_baselined(fresh, "ORDER001"))
+    act, muted = reporting.split_findings(fresh)
+    assert act == []                           # baselined gate passes
+    # a fixed finding leaves a stale entry (the ratchet)
+    clean = lint_fixture("lock_discipline_cases.py")
+    matched2, stale2 = reporting.apply_baseline(clean, path)
+    assert matched2 == 0 and stale2 == 2
+
+
+def active_or_baselined(findings, rule_id):
+    return [f for f in findings
+            if f.rule_id == rule_id and not f.suppressed]
+
+
+# ----------------------------------------------------------------------
+# CLI satellites: --rule, --exclude, --jobs parity, --budget-s
+# ----------------------------------------------------------------------
+
+def _run_cli(*args, timeout=300):
+    return subprocess.run(
+        [sys.executable, "-m", "sentinel_tpu.analysis", *args],
+        capture_output=True, text=True, timeout=timeout,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+def test_cli_rule_filter_runs_only_selected_rule():
+    proc = _run_cli(os.path.join(FIXTURES, "order_cases.py"),
+                    "--rule", "CAT001")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    proc = _run_cli(os.path.join(FIXTURES, "order_cases.py"),
+                    "--rule", "ORDER001")
+    assert proc.returncode == 1
+    assert "ORDER001" in proc.stdout
+
+
+def test_cli_exclude_drops_matching_paths():
+    proc = _run_cli(FIXTURES, "--rule", "ORDER001",
+                    "--exclude", "order_cases")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_jobs_output_parity():
+    target = os.path.join(FIXTURES, "catproj")
+    one = _run_cli(target, "--jobs", "1")
+    two = _run_cli(target, "--jobs", "2")
+    assert one.stdout == two.stdout
+    assert one.returncode == two.returncode == 0
+
+
+def test_cli_budget_overrun_exits_3():
+    proc = _run_cli(os.path.join(FIXTURES, "order_cases.py"),
+                    "--rule", "CAT001", "--budget-s", "0")
+    assert proc.returncode == 3
+    assert "exceeded" in proc.stderr
+
+
+def test_cli_write_then_apply_baseline(tmp_path):
+    base = str(tmp_path / "b.json")
+    proc = _run_cli(os.path.join(FIXTURES, "order_cases.py"),
+                    "--write-baseline", base)
+    assert proc.returncode == 0
+    doc = json.loads(open(base).read())
+    assert len(doc["entries"]) == 2
+    proc = _run_cli(os.path.join(FIXTURES, "order_cases.py"),
+                    "--baseline", base)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "2 baselined" in proc.stdout
