@@ -373,7 +373,10 @@ def measure_obs_overhead() -> dict:
     disabled (two otherwise-identical runtimes, the disabled one built
     under SENTINEL_OBS_DISABLE=1). Mixed 10%-origin batches above the
     4096-row threshold so the split path — the most-instrumented route —
-    is the one being timed."""
+    is the one being timed. Both runtimes build under the default env,
+    so from round 20 BOTH carry the per-resource RT histogram scatter
+    in record_exits — the band therefore re-verifies with histograms
+    enabled, and the scatter itself is exercised on the timed path."""
     import time as _time
 
     import numpy as np
@@ -1805,6 +1808,12 @@ def measure_single_dispatch() -> dict:
 # flight record in the <app>-trace log — interventions are evidence,
 # not just counters (the force=True trigger path bypasses the per-kind
 # rate limiter precisely so no action goes unpinned).
+# Round 20 adds the deterministic tail probe (measure_control_tail):
+# a ManualClock slow-consumer episode whose per-tick mean sits under
+# SENTINEL_CONTROL_DEGRADE_RT_MS while its interval p99 sits over it —
+# the tail-aware degrade path must open the victim's breaker, the
+# mean fallback (SENTINEL_RESOURCE_HIST_DISABLE=1) must NOT, and a
+# histograms-on/off parity leg pins verdicts + dispatch count equal.
 # CI_GATE_CONTROL=0 skips the whole gate.
 CONTROL_ENV_FLAG = "CI_GATE_CONTROL"
 CONTROL_MIN_RATIO = 0.5
@@ -1939,6 +1948,149 @@ def measure_control() -> dict:
     return out
 
 
+def measure_control_tail() -> dict:
+    """Gate (n) round-20 extension: the slow-consumer episode the MEAN
+    degrade signal provably cannot catch. Deterministic ManualClock
+    probe (no replay, no wall clock): a victim resource serves a
+    bimodal mix — 40 × 1 ms + 2 × 200 ms per controller tick, mean
+    ≈ 10 ms, interval p99 ≈ 230 ms — against a 100 ms degrade bound,
+    next to an all-fast steady resource. Four legs:
+
+      tail:   histograms ON — the tail-aware controller must force-open
+              the VICTIM's breaker (and only the victim's) while every
+              per-tick mean stays under the bound;
+      mean:   ``SENTINEL_RESOURCE_HIST_DISABLE=1`` — the same episode
+              through the pre-r20 mean fallback must decide NOTHING
+              (if it trips, the scenario doesn't discriminate and the
+              tail leg proves nothing);
+      parity: a controller-free mixed pass/block stream, histograms on
+              vs off — verdict-for-verdict identical AND the SAME
+              ``pipeline.dispatches`` count (the table may not cost a
+              dispatch: ``dispatches_per_batch`` is pinned unchanged
+              from round 16 by gate (m); this is the same invariant
+              from the feature side).
+    """
+    sys.path.insert(0, str(HERE.parent))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import sentinel_tpu as stpu
+    from sentinel_tpu.control import ControlLoop
+    from sentinel_tpu.core.clock import ManualClock
+    from sentinel_tpu.core.errors import BlockException
+    from sentinel_tpu.obs import counters as obs_keys
+    from sentinel_tpu.tune.knobs import env_overrides
+
+    BOUND_MS = 100.0
+
+    def _cfg():
+        return stpu.load_config(
+            max_resources=64, max_flow_rules=16, max_degrade_rules=16,
+            max_authority_rules=16, host_fast_path=False)
+
+    def _timed(s, name, rt_ms):
+        e = s.entry(name)
+        if rt_ms:
+            s.clock.advance_ms(rt_ms)
+        e.exit()
+
+    def _episode() -> dict:
+        """One slow-consumer episode under the current env; returns the
+        per-leg evidence."""
+        s = stpu.Sentinel(_cfg(),
+                          clock=ManualClock(start_ms=1_785_000_000_000))
+        try:
+            s.load_degrade_rules([
+                stpu.DegradeRule(resource=r,
+                                 grade=stpu.GRADE_EXCEPTION_COUNT,
+                                 count=10_000, time_window=5)
+                for r in ("victim", "steady")])
+            ctl = ControlLoop(s, interval_ms=50)
+            mean_max, p99_min = 0.0, float("inf")
+            for _ in range(ctl.policy.cfg.degrade_bad_ticks):
+                for _i in range(40):
+                    _timed(s, "victim", 1)
+                    _timed(s, "steady", 1)
+                for _i in range(2):
+                    _timed(s, "victim", 200)
+                s.telemetry.poll()
+                hot = {h["resource"]: h
+                       for h in s.telemetry.hot_entries()}
+                v = hot.get("victim", {})
+                mean_max = max(mean_max, float(v.get("rt_ms", 0.0)))
+                p99_min = min(p99_min,
+                              float(v.get("rt_p99_ms", float("inf"))))
+                ctl.tick()
+                ctl.drain()
+            deg = ctl.policy.snapshot().get("degrade", {})
+            victim_open = deg.get("victim") == "open"
+            steady_open = "steady" in deg
+            victim_blocked = False
+            try:
+                s.entry("victim")
+            except stpu.DegradeException:
+                victim_blocked = True
+            steady_serves = True
+            try:
+                with s.entry("steady"):
+                    pass
+            except Exception:
+                steady_serves = False
+            return {
+                "victim_open": victim_open and victim_blocked,
+                "steady_open": steady_open or not steady_serves,
+                "victim_mean_ms_max": mean_max,
+                "victim_p99_ms_min": (None if p99_min == float("inf")
+                                      else p99_min),
+                "tail_signal_ticks":
+                    s.obs.counters.get(obs_keys.CONTROL_TAIL_SIGNAL),
+            }
+        finally:
+            s.close()
+
+    def _verdicts() -> tuple:
+        """Controller-free mixed stream against a tight flow rule;
+        returns (verdict bits, dispatch count) for the parity leg."""
+        s = stpu.Sentinel(_cfg(),
+                          clock=ManualClock(start_ms=1_785_000_000_000))
+        try:
+            s.load_flow_rules([stpu.FlowRule(resource="lim", count=3)])
+            bits = []
+            for i in range(150):
+                name = "lim" if i % 3 else "free"
+                try:
+                    e = s.entry(name)
+                    s.clock.advance_ms(1 + (i % 7))
+                    e.exit()
+                    bits.append(True)
+                except BlockException:
+                    bits.append(False)
+            return bits, int(s.obs.counters.get(obs_keys.PIPE_DISPATCH))
+        finally:
+            s.close()
+
+    out: dict = {}
+    with env_overrides({"SENTINEL_CONTROL_DEGRADE_RT_MS": BOUND_MS}):
+        tail = _episode()
+        with env_overrides({"SENTINEL_RESOURCE_HIST_DISABLE": True}):
+            mean = _episode()
+    out["tail_degrade_opened"] = tail["victim_open"]
+    out["tail_steady_open"] = tail["steady_open"]
+    out["victim_mean_ms_max"] = tail["victim_mean_ms_max"]
+    out["victim_p99_ms_min"] = tail["victim_p99_ms_min"]
+    out["tail_signal_ticks"] = tail["tail_signal_ticks"]
+    out["mean_under_bound"] = tail["victim_mean_ms_max"] < BOUND_MS
+    out["mean_fallback_opened"] = mean["victim_open"]
+    v_on, d_on = _verdicts()
+    with env_overrides({"SENTINEL_RESOURCE_HIST_DISABLE": True}):
+        v_off, d_off = _verdicts()
+    out["verdict_parity"] = bool(np.array_equal(v_on, v_off))
+    out["dispatches_on"] = d_on
+    out["dispatches_off"] = d_off
+    return out
+
+
 def main() -> int:
     best = max(measure_once() for _ in range(3))
     cal = calibrate()
@@ -1965,6 +2117,12 @@ def main() -> int:
               else None)
     control = (measure_control()
                if os.environ.get(CONTROL_ENV_FLAG, "1") != "0" else None)
+    if control is not None:
+        # round 20: the deterministic slow-consumer tail probe rides the
+        # same gate flag — binary mechanism legs, nothing re-baselined
+        control["tail"] = {
+            k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in measure_control_tail().items()}
     ratios = {k.replace("_s_per_step", "_ratio"): v / cal
               for k, v in prep.items()}
     if "--update" in sys.argv:
@@ -2412,6 +2570,43 @@ def main() -> int:
                   f"the force-pin path (flight.trigger force=True) or "
                   f"the <app>-trace persistence is dropping them",
                   file=sys.stderr)
+            rc = 1
+        tail = control.get("tail") or {}
+        if not tail.get("mean_under_bound", False):
+            print(f"CONTROL-TAIL REGRESSION: the slow-consumer probe's "
+                  f"per-tick victim MEAN peaked at "
+                  f"{tail.get('victim_mean_ms_max')} ms (>= the 100 ms "
+                  f"bound) — the bimodal mix degenerated and the tail "
+                  f"leg below discriminates nothing", file=sys.stderr)
+            rc = 1
+        if not tail.get("tail_degrade_opened", False) \
+                or tail.get("tail_steady_open", True):
+            print(f"CONTROL-TAIL REGRESSION: tail-aware degrade did not "
+                  f"isolate the slow consumer (victim opened: "
+                  f"{tail.get('tail_degrade_opened')}, steady touched: "
+                  f"{tail.get('tail_steady_open')}; victim interval p99 "
+                  f"{tail.get('victim_p99_ms_min')} ms, mean "
+                  f"{tail.get('victim_mean_ms_max')} ms, tail-signal "
+                  f"ticks {tail.get('tail_signal_ticks')}) — the device "
+                  f"histogram → ResourceTailTracker → degrade tracker → "
+                  f"force_breaker chain is broken", file=sys.stderr)
+            rc = 1
+        if tail.get("mean_fallback_opened", True):
+            print("CONTROL-TAIL REGRESSION: the mean-RT fallback "
+                  "(SENTINEL_RESOURCE_HIST_DISABLE=1) ALSO opened the "
+                  "victim on the bimodal episode — the scenario no "
+                  "longer separates tail from mean, so the tail leg "
+                  "proves nothing; re-tune the probe's mix",
+                  file=sys.stderr)
+            rc = 1
+        if not tail.get("verdict_parity", False) \
+                or tail.get("dispatches_on") != tail.get("dispatches_off"):
+            print(f"CONTROL-TAIL PARITY REGRESSION: histograms on vs "
+                  f"off diverged (verdict parity "
+                  f"{tail.get('verdict_parity')}, dispatches "
+                  f"{tail.get('dispatches_on')} vs "
+                  f"{tail.get('dispatches_off')}) — the table must be "
+                  f"verdict-free and dispatch-free", file=sys.stderr)
             rc = 1
     if trace["pinned_records"] == 0 or "deadline_miss" not in trace["kinds"]:
         print(f"TRACE-CAPTURE REGRESSION: {trace['induced_misses']} induced "

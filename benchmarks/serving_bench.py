@@ -64,6 +64,7 @@ KNOB_ENVS = (
     "SENTINEL_CONTROL_P99_HI_MS", "SENTINEL_CONTROL_P99_LO_MS",
     "SENTINEL_CONTROL_MIN_ADMIT", "SENTINEL_CONTROL_COOLDOWN_MS",
     "SENTINEL_CONTROL_DEGRADE_RT_MS",
+    "SENTINEL_RESOURCE_HIST_DISABLE", "SENTINEL_RESOURCE_HIST_BUCKETS",
     "SERVING_DURATION_MS", "SERVING_RATE", "SERVING_SEED",
 )
 

@@ -24,6 +24,13 @@ Env knobs (tune/knobs.py registry; constructor kwargs override):
 * ``SENTINEL_CONTROL_COOLDOWN_MS`` — per-action repeat bound, 2000.
 * ``SENTINEL_CONTROL_DEGRADE_RT_MS`` — per-resource device-RT bound
   driving forced breaker transitions; 0 (default) disables the lever.
+  Round 20: with the per-resource RT histogram table live, the bound
+  applies to each hot resource's INTERVAL p99 (cumulative histogram
+  deltas between controller ticks, obs/resource_hist.py
+  ``ResourceTailTracker``) — a tail bound, which catches the
+  slow-consumer pathology the old hot-set mean could never see. With
+  ``SENTINEL_RESOURCE_HIST_DISABLE`` the signal falls back to the
+  pre-r20 per-second mean RT.
 """
 
 from __future__ import annotations
@@ -37,6 +44,7 @@ from sentinel_tpu.control.actuators import Actuators
 from sentinel_tpu.control.policy import (
     HistDeltaP99, Observation, OverloadPolicy, PolicyConfig, action_kind)
 from sentinel_tpu.obs import counters as obs_keys
+from sentinel_tpu.obs.resource_hist import ResourceTailTracker
 
 CONTROL_DISABLE_ENV = "SENTINEL_CONTROL_DISABLE"
 CONTROL_INTERVAL_ENV = "SENTINEL_CONTROL_INTERVAL_MS"
@@ -125,6 +133,7 @@ class ControlLoop:
         if batcher is not None:
             self.bind_batcher(batcher)
         self._hist_p99 = HistDeltaP99()
+        self._res_tails = ResourceTailTracker()
         self._lock = threading.Lock()
         self._pending: List = []            # (Observation, actions)
         self._log: "collections.deque" = collections.deque(
@@ -182,14 +191,23 @@ class ControlLoop:
         depth = b.pending if b is not None else 0
         qmax = b.queue.queue_max if b is not None else 0
         res_rt = ()
+        res_p99 = ()
         if self.policy.cfg.degrade_rt_ms > 0:
             res_rt = tuple((h["resource"], float(h.get("rt_ms", 0.0)))
                            for h in hot if h.get("rt_ms", 0.0) > 0)
+            # round 20: per-resource interval p99 from the cumulative
+            # device histogram vectors the telemetry hot set carries —
+            # the tail signal the degrade trackers prefer over the mean
+            res_p99 = self._res_tails.update(
+                (h["resource"], h["rt_hist"]) for h in hot
+                if h.get("rt_hist") is not None)
         ob = Observation(now, pass_s, block_s, rt_avg, p99,
-                         depth, qmax, res_rt)
+                         depth, qmax, res_rt, res_p99)
         actions = self.policy.observe(ob)
         if sn.obs.enabled:
             sn.obs.counters.add(obs_keys.CONTROL_TICK)
+            if res_p99:
+                sn.obs.counters.add(obs_keys.CONTROL_TAIL_SIGNAL)
         with self._lock:
             self._ticks += 1
             self._last_obs = ob

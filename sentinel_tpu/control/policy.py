@@ -25,11 +25,18 @@ Control law (BBR-flavored AIMD):
   its own ``cooldown_ms`` stamp, so a decision cannot repeat faster
   than the system can respond to it.
 * **Degrade** — per-resource three-state trackers over device-measured
-  mean RT (``rt_ms`` of the telemetry hot set): ``degrade_bad_ticks``
-  consecutive bad intervals force the resource's breaker OPEN,
-  ``degrade_hold_ms`` later it is probed HALF_OPEN, and one good
-  interval closes it (one bad re-opens). Disabled unless
-  ``degrade_rt_ms`` > 0.
+  RT: ``degrade_bad_ticks`` consecutive bad intervals force the
+  resource's breaker OPEN, ``degrade_hold_ms`` later it is probed
+  HALF_OPEN, and one good interval closes it (one bad re-opens).
+  Disabled unless ``degrade_rt_ms`` > 0. Round 20: the tracked signal
+  is the per-resource **interval p99** recovered from the
+  device-resident RT histogram table (``Observation.resource_p99``,
+  built by the loop's :class:`~sentinel_tpu.obs.resource_hist.\
+ResourceTailTracker`); ``degrade_rt_ms`` is therefore a TAIL bound. A
+  mean hides the slow-consumer pathology — 2 stuck calls at 500 ms
+  among 98 fast ones average ~12 ms but p99 ≈ 500 ms. When histograms
+  are disabled the policy falls back to the pre-r20 hot-set mean RT
+  (``Observation.resource_rt``), preserving bit-parity.
 """
 
 from __future__ import annotations
@@ -56,6 +63,10 @@ class Observation(NamedTuple):
     queue_depth: int                # frontend pending (queued + inflight)
     queue_max: int                  # frontend backpressure bound (0=none)
     resource_rt: Tuple[Tuple[str, float], ...] = ()   # hot-set mean RT
+    # round 20: hot-set interval p99 from the device RT histogram
+    # deltas; when non-empty it supersedes resource_rt in the degrade
+    # trackers (resource_rt stays as the hist-disabled fallback)
+    resource_p99: Tuple[Tuple[str, float], ...] = ()
 
 
 class ShedRate(NamedTuple):
@@ -148,7 +159,7 @@ class PolicyConfig(NamedTuple):
     p99_lo_ms: float = 10.0         # recover below this; [lo,hi] = hold
     min_admit: float = 0.05         # shed floor (never black-hole)
     cooldown_ms: int = 2000         # per-action-key repeat bound
-    degrade_rt_ms: float = 0.0      # per-resource RT bound (0 = off)
+    degrade_rt_ms: float = 0.0      # per-resource RT tail bound (0 = off)
     queue_hi_frac: float = 0.75     # queue-depth overload trigger
     shed_backoff: float = 0.7       # multiplicative decrease factor
     shed_recover: float = 0.05      # additive increase step
@@ -247,7 +258,10 @@ class OverloadPolicy:
     def _degrade_actions(self, obs: Observation) -> List[Degrade]:
         cfg = self.cfg
         out: List[Degrade] = []
-        for resource, rt_ms in obs.resource_rt:
+        # tail-first: per-resource interval p99 when the histogram table
+        # is live, hot-set mean RT otherwise (pre-r20 behavior)
+        signals = obs.resource_p99 or obs.resource_rt
+        for resource, rt_ms in signals:
             tr = self._trackers.get(resource)
             if tr is None:
                 tr = self._trackers[resource] = _DegradeTracker()

@@ -567,14 +567,21 @@ async function viewHotKeys(c) {
       `k=${d.k} · ${d.n_shards} shard(s) × ${d.rows_per_shard} rows · ` +
       `ticks ${d.ticks} · readback drops ${d.drops}`));
     const hot = d.hot || [];
+    // per-resource RT quantile columns from the device-resident histogram
+    // table — absent when SENTINEL_RESOURCE_HIST_DISABLE is set
+    const hasHist = hot.some(r => r.rt_p99_ms !== undefined);
+    const hotCols = ["resource", "row", "qps", "load", "pass", "block",
+                     "success", "exception"]
+      .concat(hasHist ? ["p50 ms", "p95 ms", "p99 ms"] : []);
     body.appendChild(h("div", { class: "card" }, [
       h("h3", {}, [h("span", {}, "Top-K by rolling QPS"),
         h("span", { class: "sub" },
-          "device-side lax.top_k merged across row shards (exact)")]),
+          "device-side lax.top_k merged across row shards (exact)" +
+          (hasHist ? " · RT quantiles from the cumulative device histogram"
+                   : ""))]),
       hot.length
         ? h("table", {}, [h("thead", {}, h("tr", {},
-            ["resource", "row", "qps", "load", "pass", "block", "success",
-             "exception"].map(t => h("th", {}, t)))),
+            hotCols.map(t => h("th", {}, t)))),
             h("tbody", {}, hot.map(r => h("tr", {}, [
               h("td", {}, r.resource),
               h("td", { class: "num" }, String(r.row)),
@@ -584,7 +591,14 @@ async function viewHotKeys(c) {
               h("td", { class: "num" }, String(r.block)),
               h("td", { class: "num" }, String(r.success)),
               h("td", { class: "num" }, String(r.exception)),
-            ])))])
+            ].concat(hasHist ? [
+              h("td", { class: "num" },
+                r.rt_p50_ms !== undefined ? String(r.rt_p50_ms) : "—"),
+              h("td", { class: "num" },
+                r.rt_p95_ms !== undefined ? String(r.rt_p95_ms) : "—"),
+              h("td", { class: "num" },
+                r.rt_p99_ms !== undefined ? String(r.rt_p99_ms) : "—"),
+            ] : []))))])
         : h("span", { class: "dim" }, "no hot resources yet"),
     ]));
     const tl = d.timeline || [];

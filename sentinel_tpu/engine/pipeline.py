@@ -37,6 +37,7 @@ from sentinel_tpu.core.errors import BlockReason
 from sentinel_tpu.core.registry import ENTRY_NODE_ROW
 from sentinel_tpu.rules import authority as auth_mod
 from sentinel_tpu.rules import degrade as deg_mod
+from sentinel_tpu.obs import resource_hist
 from sentinel_tpu.rules import flow as flow_mod
 from sentinel_tpu.rules import param_flow as pf_mod
 from sentinel_tpu.rules import system as sys_mod
@@ -60,6 +61,10 @@ class EngineSpec:
     param_keys: int = 0       # PK — hot-key rows (0 = param flow disabled)
     param_pairs: int = 0      # PV — (rule, value) checks per event
     occupy_timeout_ms: int = 500   # OccupyTimeoutProperty default (0 = off)
+    # HB — per-resource RT histogram buckets (obs/resource_hist.py);
+    # 0 = table disabled: state.rt_hist is None and every consumer
+    # compiles the feature away (round-20 bit-parity switch)
+    hist_buckets: int = 0
 
 
 class SentinelState(NamedTuple):
@@ -77,6 +82,11 @@ class SentinelState(NamedTuple):
     # positionally aligned with the custom_slots tuple the steps were
     # compiled with; () when no custom slots are registered
     custom: Tuple = ()
+    # int32[R, HB] cumulative per-resource RT histogram (round 20) —
+    # counts only grow (they ride tier demote/promote and geometry
+    # changes) and reset on row invalidation. None ⇔ spec.hist_buckets
+    # == 0, so the leaf's absence keeps old programs byte-identical.
+    rt_hist: Optional[jnp.ndarray] = None
 
 
 class RuleSet(NamedTuple):
@@ -183,6 +193,8 @@ def _init_state_traced(spec: EngineSpec, nf: int, nd: int) -> SentinelState:
         flow_dyn=flow_mod.init_flow_dyn(nf, spec.second.buckets, spec.rows),
         breakers=deg_mod.init_breaker_state(nd),
         param_dyn=pf_mod.init_param_dyn(spec.param_keys),
+        rt_hist=(jnp.zeros((spec.rows, spec.hist_buckets), jnp.int32)
+                 if spec.hist_buckets else None),
     )
 
 
@@ -240,6 +252,8 @@ def _init_state_np(spec: EngineSpec, nf: int, nd: int) -> SentinelState:
             latest_passed_ms=np.full((pk + 1,), never, np.int32),
             threads=np.zeros((pk + 1,), np.int32),
             override=np.full((pk + 1,), -1.0, np.float32)),
+        rt_hist=(np.zeros((spec.rows, spec.hist_buckets), np.int32)
+                 if spec.hist_buckets else None),
     )
 
 
@@ -689,7 +703,7 @@ def decide_entries(
         second=second, minute=minute, alt_second=alt_second,
         threads=threads, alt_threads=alt_threads,
         flow_dyn=flow_dyn, breakers=breakers, param_dyn=param_dyn,
-        custom=custom_states)
+        custom=custom_states, rt_hist=state.rt_hist)
     return new_state, Verdicts(allow=allow, reason=reason, wait_ms=wait_ms,
                                sf_overflow=sf_ovf if sortfree else None)
 
@@ -813,11 +827,22 @@ def record_exits(
             rules.param_table, param_dyn, batch.param_rules, batch.param_keys,
             batch.valid, -1)
 
+    rt_hist = state.rt_hist
+    if spec.hist_buckets:
+        # round 20: cumulative per-resource RT histogram — one +1 per
+        # valid exit at [row, log2 ms bucket]; invalid lanes ride the
+        # pad row and drop. Not acquire-scaled: the table counts
+        # completions (the tail shape), one sample per exit like the
+        # entry-node rt aggregate, not acquire-weighted like rt_sum.
+        bidx = resource_hist.bucket_index(rt1, spec.hist_buckets)
+        rt_hist = rt_hist.at[main_rows, bidx].add(
+            jnp.where(batch.valid, 1, 0), mode="drop")
+
     return SentinelState(
         second=second, minute=minute, alt_second=alt_second,
         threads=threads, alt_threads=alt_threads,
         flow_dyn=state.flow_dyn, breakers=breakers, param_dyn=param_dyn,
-        custom=state.custom)
+        custom=state.custom, rt_hist=rt_hist)
 
 
 def decide_and_record_exits(
@@ -943,6 +968,10 @@ def invalidate_resource_rows(spec: EngineSpec, state: SentinelState,
     threads = state.threads.at[rows].set(0, mode="drop")
     alt_second = invalidate_rows(spec.second, state.alt_second, alt_rows)
     alt_threads = state.alt_threads.at[alt_rows].set(0, mode="drop")
+    rt_hist = state.rt_hist
+    if rt_hist is not None:
+        # the ONLY reset path for the cumulative RT histogram (round 20)
+        rt_hist = rt_hist.at[rows].set(0, mode="drop")
     # occupy bookings are keyed by resource ROW — a recycled row must not
     # inherit the evicted resource's pre-booked next-window budget
     flow_dyn = state.flow_dyn._replace(
@@ -952,7 +981,7 @@ def invalidate_resource_rows(spec: EngineSpec, state: SentinelState,
             -(2 ** 30), mode="drop"))
     return state._replace(second=second, minute=minute, threads=threads,
                           alt_second=alt_second, alt_threads=alt_threads,
-                          flow_dyn=flow_dyn)
+                          flow_dyn=flow_dyn, rt_hist=rt_hist)
 
 
 class ResourceRowSlice(NamedTuple):
@@ -973,6 +1002,8 @@ class ResourceRowSlice(NamedTuple):
     occ_win: jnp.ndarray           # int32[K, B+1]
     alt_second: WindowState        # [KA, ...] alt-window slices
     alt_threads: jnp.ndarray       # int32[KA]
+    rt_hist: Optional[jnp.ndarray] = None   # int32[K, HB] (round 20;
+    # None when the engine has no histogram table — see EngineSpec)
 
 
 def extract_resource_rows(spec: EngineSpec, state: SentinelState,
@@ -996,7 +1027,8 @@ def extract_resource_rows(spec: EngineSpec, state: SentinelState,
         occ_cnt=state.flow_dyn.occupied_count[r],
         occ_win=state.flow_dyn.occupied_window[r],
         alt_second=extract_rows(spec.second, state.alt_second, alt_rows),
-        alt_threads=state.alt_threads[ra])
+        alt_threads=state.alt_threads[ra],
+        rt_hist=state.rt_hist[r] if state.rt_hist is not None else None)
 
 
 def restore_resource_rows(spec: EngineSpec, state: SentinelState,
@@ -1024,6 +1056,9 @@ def restore_resource_rows(spec: EngineSpec, state: SentinelState,
             payload.occ_cnt, mode="drop"),
         occupied_window=state.flow_dyn.occupied_window.at[rows].set(
             payload.occ_win, mode="drop"))
+    rt_hist = state.rt_hist
+    if rt_hist is not None and payload.rt_hist is not None:
+        rt_hist = rt_hist.at[rows].set(payload.rt_hist, mode="drop")
     return state._replace(
         second=second, minute=minute,
         threads=state.threads.at[rows].set(payload.threads, mode="drop"),
@@ -1031,4 +1066,4 @@ def restore_resource_rows(spec: EngineSpec, state: SentinelState,
                                 payload.alt_second),
         alt_threads=state.alt_threads.at[alt_rows].set(
             payload.alt_threads, mode="drop"),
-        flow_dyn=flow_dyn)
+        flow_dyn=flow_dyn, rt_hist=rt_hist)

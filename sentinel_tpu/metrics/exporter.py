@@ -41,7 +41,14 @@ Self-telemetry families (from ``Sentinel.obs`` — obs/; absent while
                                            trial/parity_fail
     sentinel_resource_qps{resource=...}    hot-resource rolling QPS — top-K
                                            labels ONLY (obs/telemetry.py)
+    sentinel_resource_rt_ms{resource=...,quantile=...}
+                                           per-resource RT quantiles (p50/
+                                           p95/p99) from the device-resident
+                                           cumulative histogram table — top-K
+                                           labels only; absent when
+                                           SENTINEL_RESOURCE_HIST_DISABLE set
     sentinel_telemetry_total{event=...}    telemetry health: tick/readback_drop
+                                           /hist_tick
     sentinel_exporter_label_overflow_total samples dropped at the label cap
 
 Label-cardinality guard: the per-resource gauge families cap the number
@@ -175,10 +182,18 @@ class SentinelCollector:
             "Hot-resource rolling pass+block QPS — top-K labels only "
             "(the device-merged hot set, obs/telemetry.py)",
             labels=["resource"])
+        res_rt = GaugeMetricFamily(
+            f"{ns}_resource_rt_ms",
+            "Per-resource RT quantiles (ms) from the device-resident "
+            "cumulative log-bucket histogram — top-K labels only "
+            "(obs/resource_hist.py; absent when "
+            "SENTINEL_RESOURCE_HIST_DISABLE is set)",
+            labels=["resource", "quantile"])
         telem = CounterMetricFamily(
             f"{ns}_telemetry",
             "Hot-resource telemetry health: tick (device reads "
-            "dispatched) / readback_drop (async readback fell behind)",
+            "dispatched) / readback_drop (async readback fell behind) / "
+            "hist_tick (hot sets landed with histogram quantiles)",
             labels=["event"])
         label_ovf = CounterMetricFamily(
             f"{ns}_exporter_label_overflow",
@@ -194,8 +209,9 @@ class SentinelCollector:
             f"{ns}_control_total",
             "Overload-controller activity: tick (control cycles), "
             "shed_rate / retune_batcher / degrade (actions applied), "
-            "admission_dropped (requests shed at the admission gate) "
-            "(control/loop.py)",
+            "admission_dropped (requests shed at the admission gate), "
+            "tail_signal (ticks where per-resource p99 deltas fed the "
+            "degrade policy) (control/loop.py)",
             labels=["action"])
         if not describe_only and obs is not None and obs.enabled:
             from sentinel_tpu.obs import counters as ck
@@ -260,7 +276,8 @@ class SentinelCollector:
                             (ck.TUNE_PARITY_FAIL, "parity_fail")):
                 tune.add_metric([ev], counts.get(key, 0))
             for key, ev in ((ck.TELEMETRY_TICK, "tick"),
-                            (ck.TELEMETRY_DROP, "readback_drop")):
+                            (ck.TELEMETRY_DROP, "readback_drop"),
+                            (ck.TELEMETRY_HIST_TICK, "hist_tick")):
                 telem.add_metric([ev], counts.get(key, 0))
             label_ovf.add_metric(
                 [], counts.get(ck.EXPORTER_LABEL_OVERFLOW, 0))
@@ -274,17 +291,25 @@ class SentinelCollector:
                             (ck.CONTROL_SHED_ACTION, "shed_rate"),
                             (ck.CONTROL_RETUNE_ACTION, "retune_batcher"),
                             (ck.CONTROL_DEGRADE_ACTION, "degrade"),
-                            (ck.CONTROL_DROPPED, "admission_dropped")):
+                            (ck.CONTROL_DROPPED, "admission_dropped"),
+                            (ck.CONTROL_TAIL_SIGNAL, "tail_signal")):
                 control.add_metric([ev], counts.get(key, 0))
             # bounded by construction: at most telemetry.k ≤ MAX_K labels
+            # (×3 quantile labels for res_rt — still top-K-bounded)
             telemetry = getattr(self.sentinel, "telemetry", None)
             if telemetry is not None and telemetry.enabled:
                 for h in telemetry.hot_entries():
                     res_qps.add_metric([h["resource"]], float(h["qps"]))
+                    for q, fld in (("0.5", "rt_p50_ms"),
+                                   ("0.95", "rt_p95_ms"),
+                                   ("0.99", "rt_p99_ms")):
+                        if fld in h:
+                            res_rt.add_metric([h["resource"], q],
+                                              float(h[fld]))
         yield from (p99, quant, req_quant, route, hits, misses, retries,
                     blocks, occupy, pipeline, frontend, fe_flush, wraps,
                     flight_pinned, flight_trig, sf_ovf, tune,
-                    res_qps, telem, label_ovf, tier, control)
+                    res_qps, res_rt, telem, label_ovf, tier, control)
 
     def collect(self):
         ns = self.namespace

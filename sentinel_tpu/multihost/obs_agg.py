@@ -19,6 +19,13 @@ allgather (padded utf-8 names + int64 load/pass/block) and merges by
 resource name into a cluster-wide hot view — the first concrete piece of
 the ROADMAP cluster health view.
 
+:func:`aggregate_resource_hist` extends that merge to the per-resource
+RT histogram table (obs/resource_hist.py): cumulative log-bucket count
+vectors are pure sums, so summing each resource's vector across hosts
+and re-extracting quantiles host-side yields the FLEET-WIDE tail — the
+true cluster p99, not a mean of per-host p99s (quantiles don't average;
+histograms do).
+
 These are COLLECTIVES: every process in the mesh must call them the same
 number of times, in the same order relative to other collectives (the
 multihost SPMD rule — see multihost/ingest.py). Never call them from
@@ -141,6 +148,100 @@ def aggregate_topk(sentinel, k: Optional[int] = None) -> Dict[str, object]:
         "hot": [{"resource": name, "load": s[0], "pass": s[1],
                  "block": s[2], "hosts": hosts[name]}
                 for name, s in ranked],
+    }
+
+
+def _resource_hist_payload(sentinel, k: int, hb: int):
+    """This process's hot set + histogram rows as fixed-shape allgather
+    payload: ``(uint8[k, TOPK_NAME_BYTES] names, int64[k, hb] counts)``,
+    empty slots marked by ``counts[i, 0] == -1`` (real bucket counts are
+    never negative)."""
+    names = np.zeros((k, TOPK_NAME_BYTES), np.uint8)
+    hists = np.full((k, hb), -1, np.int64)
+    telemetry = getattr(sentinel, "telemetry", None)
+    entries = telemetry.hot_entries(k) if telemetry is not None else []
+    for i, h in enumerate(entries[:k]):
+        vec = h.get("rt_hist")
+        if vec is None or len(vec) != hb:
+            continue
+        raw = h["resource"].encode("utf-8")[:TOPK_NAME_BYTES]
+        names[i, :len(raw)] = np.frombuffer(raw, np.uint8)
+        hists[i] = np.asarray(vec, np.int64)
+    return names, hists
+
+
+def aggregate_resource_hist(sentinel,
+                            k: Optional[int] = None) -> Dict[str, object]:
+    """Allgather-merge every host's per-resource RT histogram rows into
+    ONE fleet-wide tail view (collective — call on ALL processes, with
+    the same ``k``; the bucket count comes from this engine's spec and
+    must be fleet-uniform, which SENTINEL_RESOURCE_HIST_BUCKETS being a
+    uniform env guarantees).
+
+    Cumulative bucket counts sum exactly across hosts (the same merge
+    the row-shard gather does device-side in obs/telemetry.py), so the
+    quantiles extracted from the summed vectors are the TRUE fleet
+    quantiles. Returns ``{"process_count", "process_index", "k",
+    "hist_buckets", "hot": [{resource, total, hosts, rt_hist,
+    rt_p50_ms, rt_p95_ms, rt_p99_ms}, ...]}`` ranked by total count
+    (identical on every process). Empty when the histogram table is
+    disabled (``hist_buckets == 0``)."""
+    import jax
+
+    from sentinel_tpu.obs import resource_hist
+
+    telemetry = getattr(sentinel, "telemetry", None)
+    if k is None:
+        k = telemetry.k if telemetry is not None else 16
+    k = max(1, int(k))
+    spec = getattr(sentinel, "spec", None)
+    hb = int(getattr(spec, "hist_buckets", 0) or 0)
+    if hb <= 0:
+        return {"process_count": int(jax.process_count()),
+                "process_index": int(jax.process_index()),
+                "k": k, "hist_buckets": 0, "hot": []}
+    names, hists = _resource_hist_payload(sentinel, k, hb)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        g_names = np.asarray(multihost_utils.process_allgather(
+            names, tiled=False)).reshape(-1, k, TOPK_NAME_BYTES)
+        g_hists = np.asarray(multihost_utils.process_allgather(
+            hists, tiled=False)).reshape(-1, k, hb)
+    else:
+        g_names, g_hists = names[None], hists[None]
+    merged: Dict[str, np.ndarray] = {}
+    hosts: Dict[str, int] = {}
+    for p in range(g_hists.shape[0]):
+        for i in range(k):
+            if g_hists[p, i, 0] < 0:
+                continue
+            raw = bytes(g_names[p, i]).rstrip(b"\x00")
+            name = raw.decode("utf-8", errors="replace")
+            if name in merged:
+                merged[name] = merged[name] + g_hists[p, i]
+            else:
+                merged[name] = g_hists[p, i].copy()
+            hosts[name] = hosts.get(name, 0) + 1
+    ranked = sorted(merged.items(),
+                    key=lambda it: (-int(it[1].sum()), it[0]))[:k]
+    hot = []
+    for name, vec in ranked:
+        qs = resource_hist.np_quantiles(vec.astype(np.int64))
+        hot.append({
+            "resource": name,
+            "total": int(vec.sum()),
+            "hosts": hosts[name],
+            "rt_hist": [int(c) for c in vec],
+            "rt_p50_ms": round(float(qs[0]), 3),
+            "rt_p95_ms": round(float(qs[1]), 3),
+            "rt_p99_ms": round(float(qs[2]), 3),
+        })
+    return {
+        "process_count": int(g_hists.shape[0]),
+        "process_index": int(jax.process_index()),
+        "k": k,
+        "hist_buckets": hb,
+        "hot": hot,
     }
 
 

@@ -210,6 +210,20 @@ CONTROL_RETUNE_ACTION = "control.action.retune_batcher"
 CONTROL_DEGRADE_ACTION = "control.action.degrade"
 CONTROL_DROPPED = "control.admission_dropped"
 
+# PR 20 — device-resident per-resource RT histograms
+# (sentinel_tpu/obs/resource_hist.py): ``telemetry.hist_tick`` counts
+# telemetry landings that carried per-resource histogram vectors and
+# quantiles (0 while ``SENTINEL_RESOURCE_HIST_DISABLE`` drops the
+# table — the delta against ``telemetry.tick`` shows the feature
+# switch state from the scrape alone); ``control.tail_signal`` counts
+# controller ticks whose degrade evaluation ran on per-resource
+# interval p99 deltas rather than the pre-r20 hot-set mean RT
+# fallback. Exported under the existing ``sentinel_telemetry_total``
+# / ``sentinel_control_total`` families; see docs/OBSERVABILITY.md
+# "Per-resource RT histograms (round 20)".
+TELEMETRY_HIST_TICK = "telemetry.hist_tick"
+CONTROL_TAIL_SIGNAL = "control.tail_signal"
+
 #: Fixed aggregation catalog (order is the wire format of the multihost
 #: counter vector — append only, never reorder).
 CATALOG = (
@@ -240,6 +254,7 @@ CATALOG = (
     PIPE_DISPATCH, ROUTE_SINGLE_DISPATCH,
     CONTROL_TICK, CONTROL_SHED_ACTION, CONTROL_RETUNE_ACTION,
     CONTROL_DEGRADE_ACTION, CONTROL_DROPPED,
+    TELEMETRY_HIST_TICK, CONTROL_TAIL_SIGNAL,
 )
 
 

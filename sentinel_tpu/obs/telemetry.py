@@ -62,6 +62,7 @@ from jax.sharding import PartitionSpec as P
 
 from sentinel_tpu.core.registry import ENTRY_NODE_ROW
 from sentinel_tpu.obs import counters as obs_keys
+from sentinel_tpu.obs import resource_hist
 from sentinel_tpu.stats import events as ev
 from sentinel_tpu.stats import window
 from sentinel_tpu.parallel.local_shard import MESH_AXIS, topk_layout
@@ -160,12 +161,22 @@ def telemetry_tick(second_spec: window.WindowSpec,
                    k: int, mesh, rows_per_shard: int,
                    second: window.WindowState,
                    minute: window.WindowState,
+                   rt_hist,
                    ring: TelemetryRing,
                    now_idx_s: jnp.ndarray, sec_idx_m: jnp.ndarray,
                    append: jnp.ndarray):
     """ONE fused telemetry read over the live state (pure; jitted by
     :class:`HotTelemetry`). Returns fresh output buffers only — safe to
-    read back asynchronously while later steps donate the state."""
+    read back asynchronously while later steps donate the state.
+
+    ``rt_hist`` is the round-20 per-resource cumulative RT histogram
+    table (``SentinelState.rt_hist``; None when the engine has no
+    table). When present, the hot set's histogram rows gather alongside
+    the rolling lanes (disjoint row shards — same GSPMD pattern as
+    ``rolling_totals``) and the jitted quantile extraction
+    (:func:`sentinel_tpu.obs.resource_hist.quantiles_from_counts`)
+    rides the same dispatch; when None both extra outputs are
+    zero-width, keeping every downstream tuple shape static."""
     rows_total = second.stamps.shape[0]
     load = window.rolling_load(second_spec, second, now_idx_s)
     # the global ENTRY aggregate row receives every inbound event — it is
@@ -188,6 +199,12 @@ def telemetry_tick(second_spec: window.WindowSpec,
         sec_rt = jnp.zeros((k,), jnp.float32)
         entry_lanes = jnp.zeros((ring.lanes.shape[1],), jnp.int32)
         entry_rt = jnp.zeros((), jnp.float32)
+    if rt_hist is not None:
+        hist_k = rt_hist[rows]                       # [k, HB] cumulative
+        q_k = resource_hist.quantiles_from_counts(hist_k)   # [k, 3] ms
+    else:
+        hist_k = jnp.zeros((k, 0), jnp.int32)
+        q_k = jnp.zeros((k, 0), jnp.float32)
     slots = ring.seconds.shape[0]
     slot = ring.cursor % slots
     keep = append > 0
@@ -200,7 +217,7 @@ def telemetry_tick(second_spec: window.WindowSpec,
         cursor=ring.cursor + keep.astype(jnp.int32),
     )
     return (vals, rows, roll_lanes, sec_lanes, sec_rt,
-            entry_lanes, entry_rt), ring
+            entry_lanes, entry_rt, hist_k, q_k), ring
 
 
 class HotTelemetry:
@@ -313,8 +330,8 @@ class HotTelemetry:
             if self._ring is None:
                 self._ring = init_ring(self.ring_slots)
             outs, self._ring = self._tick_fn(
-                sn._state.second, sn._state.minute, self._ring,
-                idx_s, sec_idx_m, np.int32(append))
+                sn._state.second, sn._state.minute, sn._state.rt_hist,
+                self._ring, idx_s, sec_idx_m, np.int32(append))
         if append:
             self._last_sec = sec
         with self._lock:
@@ -424,7 +441,8 @@ class HotTelemetry:
 
     def _land(self, now_ms: int, sec: int, append: int, outs) -> None:
         (vals, rows, roll_lanes, sec_lanes, sec_rt,
-         entry_lanes, entry_rt) = outs
+         entry_lanes, entry_rt, hist_k, q_k) = outs
+        has_hist = hist_k.shape[1] > 0
         names = dict((row, name)
                      for name, row in self._sentinel.resources.items())
         rtypes = dict(self._sentinel.resource_types)
@@ -440,17 +458,28 @@ class HotTelemetry:
                 continue
             lanes = roll_lanes[i]
             succ_s = int(sec_lanes[i][ev.SUCCESS])
-            hot.append({
+            entry = {
                 "resource": name, "row": row, "load": load,
                 "qps": round(load / interval_s, 3),
                 "pass": int(lanes[ev.PASS]), "block": int(lanes[ev.BLOCK]),
                 "success": int(lanes[ev.SUCCESS]),
                 "exception": int(lanes[ev.EXCEPTION]),
                 # device-measured mean RT over the landed second — the
-                # overload controller's per-resource degrade signal
+                # pre-r20 degrade signal, kept as the hist-off fallback
                 "rt_ms": round(float(sec_rt[i]) / succ_s, 3) if succ_s
                          else 0.0,
-            })
+            }
+            if has_hist:
+                # round 20: lifetime-cumulative tail view (display /
+                # Prometheus); the controller differences the raw
+                # vector itself for interval tails
+                entry["rt_p50_ms"] = round(float(q_k[i][0]), 3)
+                entry["rt_p95_ms"] = round(float(q_k[i][1]), 3)
+                entry["rt_p99_ms"] = round(float(q_k[i][2]), 3)
+                entry["rt_hist"] = hist_k[i].tolist()
+            hot.append(entry)
+        if has_hist and hot:
+            self._obs.counters.add(obs_keys.TELEMETRY_HIST_TICK)
         timeline_entry = None
         nodes = []
         if append and self._sentinel.spec.minute is not None:

@@ -39,6 +39,7 @@ flow_dyn (pacing)    [NF+1]                      replicated
 breakers             [ND+1]                      replicated
 param_dyn            [PK+1]                      replicated
 custom               user DeviceSlot pytrees     replicated
+rt_hist              int32[R, HB] (or absent)    P("rows") on axis 0
 ==================  ==========================  =====================
 """
 
@@ -135,6 +136,8 @@ def state_shardings(spec: EngineSpec, mesh: Mesh,
         breakers=replicated(state.breakers),
         param_dyn=replicated(state.param_dyn),
         custom=replicated(state.custom),
+        # round 20: [R, HB] RT histogram rows live with their resource
+        rt_hist=(row if state.rt_hist is not None else None),
     )
 
 

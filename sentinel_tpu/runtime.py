@@ -346,23 +346,27 @@ def _build_sd_steps(spec: EngineSpec, custom_slots: tuple, shardings=None,
                 sortfree=sortfree)
 
             def tel_run(op):
-                second, minute, rg = op
+                second, minute, rt_hist, rg = op
                 return telemetry_tick(
                     spec.second, spec.minute, tel_k, mesh,
-                    tel_rows_per_shard, second, minute, rg,
+                    tel_rows_per_shard, second, minute, rt_hist, rg,
                     epi[1], epi[2], epi[3])
 
             def tel_skip(op):
-                _second, _minute, rg = op
+                _second, _minute, _rt_hist, rg = op
+                hb = spec.hist_buckets       # 0 → zero-width hist outputs
                 zk = jnp.zeros((tel_k,), jnp.int32)
                 zl = jnp.zeros((tel_k, n_ev), jnp.int32)
                 return (zk, zk, zl, zl, jnp.zeros((tel_k,), jnp.float32),
                         jnp.zeros((n_ev,), jnp.int32),
-                        jnp.zeros((), jnp.float32)), rg
+                        jnp.zeros((), jnp.float32),
+                        jnp.zeros((tel_k, hb), jnp.int32),
+                        jnp.zeros((tel_k, 3 if hb else 0),
+                                  jnp.float32)), rg
 
             tel_outs, ring2 = jax.lax.cond(
                 (epi[0] & _EPI_TELEMETRY) > 0, tel_run, tel_skip,
-                (state.second, state.minute, ring))
+                (state.second, state.minute, state.rt_hist, ring))
 
             def tier_run(sc):
                 return sk_mod.tick_read(sc, spec.rows)
@@ -669,6 +673,7 @@ class Sentinel:
         self.contexts = make_registry(2048,
                                       reserved=("sentinel_default_context",))
 
+        from sentinel_tpu.obs.resource_hist import engine_hist_buckets
         self.spec = EngineSpec(
             rows=cfg.max_resources,
             alt_rows=max(2 * cfg.max_resources, 1024),
@@ -679,6 +684,10 @@ class Sentinel:
             param_keys=cfg.param_table_slots,
             param_pairs=cfg.param_pairs_per_event,
             occupy_timeout_ms=cfg.occupy_timeout_ms,
+            # round 20 — per-resource RT histograms (0 = disabled; a
+            # trace-time knob: the value is baked into the state pytree
+            # and every jitted step program's cache key)
+            hist_buckets=engine_hist_buckets(),
         )
         self.param_key_registry = pf_mod.make_param_key_registry(cfg.param_table_slots)
         self._user_param_rules: List[pf_mod.ParamFlowRule] = []

@@ -60,6 +60,12 @@ class ColdEntry:
     alts: Dict[Tuple[int, int], tuple] = field(default_factory=dict)
     reload_gen: int = 0            # flow reloads seen BEFORE demotion
     demoted_ms: int = 0
+    # round 20: cumulative per-resource RT histogram row (int32 [HB]);
+    # None when the engine has no histogram table or the entry predates
+    # the feature. Time-portable by construction (no stamps): it rides
+    # demote→promote untouched, and reset_entry_geometry_np deliberately
+    # carries it over — the table is cumulative-forever, not windowed.
+    rt_hist: Optional[np.ndarray] = None
 
 
 def settle_entry_np(buckets: int, entry: ColdEntry, now_idx: int,

@@ -505,6 +505,11 @@ class TierManager:
         thr = np.zeros(kp, np.int32)
         occ_c = np.zeros((kp, B + 1), np.float32)
         occ_w = np.full((kp, B + 1), NEVER, np.int32)
+        hb = spec.hist_buckets
+        # zeros for entries that predate the histogram table (a cold
+        # entry demoted before the feature was enabled restores with an
+        # empty — not stale — tail view)
+        rt_h = np.zeros((kp, hb), np.int32) if hb else None
         rows_arr = np.full(kp, spec.rows, np.int32)
         alt_rows: List[int] = []
         alt_payload: List[tuple] = []
@@ -517,6 +522,9 @@ class TierManager:
                 min_rt[i], min_mr[i] = e.min_rt_sum, e.min_min_rt
             thr[i] = e.threads
             occ_c[i], occ_w[i] = e.occ_cnt, e.occ_win
+            if rt_h is not None and e.rt_hist is not None \
+                    and e.rt_hist.shape[0] == hb:
+                rt_h[i] = e.rt_hist
             for (kind, key_id), alt in e.alts.items():
                 slot = _alt_hash(row, kind, key_id, spec.alt_rows)
                 slots = sn._alt_rows_by_row.setdefault(row, {})
@@ -545,7 +553,8 @@ class TierManager:
             occ_cnt=jnp.asarray(occ_c), occ_win=jnp.asarray(occ_w),
             alt_second=WindowState(jnp.asarray(alt_c), jnp.asarray(alt_s),
                                    jnp.asarray(alt_rt), jnp.asarray(alt_mr)),
-            alt_threads=jnp.asarray(alt_thr))
+            alt_threads=jnp.asarray(alt_thr),
+            rt_hist=jnp.asarray(rt_h) if rt_h is not None else None)
         sn._state = _jit_restore(spec)(
             sn._state, jnp.asarray(rows_arr), payload, jnp.asarray(alt_arr))
 
@@ -615,6 +624,7 @@ class TierManager:
         occ_c, occ_w = np.asarray(p.occ_cnt), np.asarray(p.occ_win)
         alt_sec = tuple(np.asarray(x) for x in p.alt_second)
         alt_thr = np.asarray(p.alt_threads)
+        rh = np.asarray(p.rt_hist) if p.rt_hist is not None else None
         for vi, (name, _row) in enumerate(rec["victims"]):
             alts = {}
             for j, (avi, kind, key_id) in enumerate(rec["alt_ids"]):
@@ -630,7 +640,8 @@ class TierManager:
                 min_rt_sum=mnt[2][vi].copy(), min_min_rt=mnt[3][vi].copy(),
                 threads=int(threads[vi]),
                 occ_cnt=occ_c[vi].copy(), occ_win=occ_w[vi].copy(),
-                alts=alts, reload_gen=rec["gen"], demoted_ms=rec["now_ms"])
+                alts=alts, reload_gen=rec["gen"], demoted_ms=rec["now_ms"],
+                rt_hist=rh[vi].copy() if rh is not None else None)
             self.cold.put(name, entry)
             with self._lock:
                 if self._pending_land.get(name) is rec:

@@ -281,11 +281,14 @@ def register_default_handlers(
 
     def cmd_topk(req: CommandRequest) -> CommandResponse:
         """Hot-resource telemetry snapshot (obs/telemetry.py): the last
-        drained device top-K (per-resource rolling pass/block/qps) plus
-        the engine-wide per-second timeline tail. Params: ``timeline``
-        (max timeline entries, default 60), ``tick`` (``1`` → run one
-        poll inline first — the pull-only path for agents without the
-        telemetry ticker running)."""
+        drained device top-K (per-resource rolling pass/block/qps, plus
+        ``rt_p50_ms``/``rt_p95_ms``/``rt_p99_ms`` and the raw
+        ``rt_hist`` bucket vector when the device-resident RT histogram
+        table is enabled — obs/resource_hist.py) plus the engine-wide
+        per-second timeline tail. Params: ``timeline`` (max timeline
+        entries, default 60), ``tick`` (``1`` → run one poll inline
+        first — the pull-only path for agents without the telemetry
+        ticker running)."""
         telemetry = getattr(s, "telemetry", None)
         if telemetry is None:
             return CommandResponse.of_failure("telemetry unavailable", 404)

@@ -185,7 +185,17 @@ KNOBS: Tuple[KnobSpec, ...] = (
              "per-action repeat bound (anti-flap, with the hysteresis band)"),
     KnobSpec("SENTINEL_CONTROL_DEGRADE_RT_MS", "float", 0.0, 0.0, 60_000.0,
              SCOPE_RUNTIME, (),
-             "per-resource device-RT bound forcing breaker arcs (0 = off)"),
+             "per-resource RT tail (p99) bound forcing breaker arcs (0 = off)"),
+    # obs/resource_hist.py — round-20 device-resident per-resource RT
+    # histograms. Both trace-scope: they size the ``rt_hist`` state leaf
+    # and are baked into the fused step programs. Empty sweep grids —
+    # observability switches, not latency/throughput trades.
+    KnobSpec("SENTINEL_RESOURCE_HIST_DISABLE", "bool", False, None, None,
+             SCOPE_TRACE, (),
+             "drop the per-resource RT histogram table (pre-r20 programs)"),
+    KnobSpec("SENTINEL_RESOURCE_HIST_BUCKETS", "int", 32, 8, 32,
+             SCOPE_TRACE, (),
+             "RT histogram bucket count (log2 ms buckets, int32-safe cap)"),
 )
 
 KNOB_BY_ENV: Dict[str, KnobSpec] = {k.env: k for k in KNOBS}

@@ -447,29 +447,34 @@ def test_catalog_vector_roundtrip():
     assert ck.vector_counts(longer) == back
 
 
-def test_catalog_is_append_only_with_r17_keys_last():
+def test_catalog_is_append_only_with_r20_keys_last():
     """The multihost allgather aggregates CATALOG by POSITION (prefix
     compatibility with older peers), so the catalog may only ever grow at
-    the tail. Pin the newest (round-17 overload-controller) keys to the
-    end, with the round-16 single-dispatch, round-15 tiering, round-12
-    telemetry/exporter, round-11 tune, round-10 sortfree and round-9 mesh
-    keys immediately above them — an insertion above any group (or a
-    re-ordering) would silently mis-attribute every counter on a
-    mixed-version fleet."""
-    assert ck.CATALOG[-5:] == (ck.CONTROL_TICK, ck.CONTROL_SHED_ACTION,
-                               ck.CONTROL_RETUNE_ACTION,
-                               ck.CONTROL_DEGRADE_ACTION, ck.CONTROL_DROPPED)
-    assert ck.CATALOG[-7:-5] == (ck.PIPE_DISPATCH, ck.ROUTE_SINGLE_DISPATCH)
-    assert ck.CATALOG[-12:-7] == (ck.TIER_HOT_HIT, ck.TIER_COLD_MISS,
+    the tail. Pin the newest (round-20 resource-histogram) keys to the
+    end, with the round-17 overload-controller, round-16 single-dispatch,
+    round-15 tiering, round-12 telemetry/exporter, round-11 tune,
+    round-10 sortfree and round-9 mesh keys immediately above them — an
+    insertion above any group (or a re-ordering) would silently
+    mis-attribute every counter on a mixed-version fleet."""
+    assert ck.CATALOG[-2:] == (ck.TELEMETRY_HIST_TICK,
+                               ck.CONTROL_TAIL_SIGNAL)
+    assert ck.CATALOG[-7:-2] == (ck.CONTROL_TICK, ck.CONTROL_SHED_ACTION,
+                                 ck.CONTROL_RETUNE_ACTION,
+                                 ck.CONTROL_DEGRADE_ACTION,
+                                 ck.CONTROL_DROPPED)
+    assert ck.CATALOG[-9:-7] == (ck.PIPE_DISPATCH, ck.ROUTE_SINGLE_DISPATCH)
+    assert ck.CATALOG[-14:-9] == (ck.TIER_HOT_HIT, ck.TIER_COLD_MISS,
                                   ck.TIER_PROMOTED, ck.TIER_DEMOTED,
                                   ck.TIER_SKETCH_OVERFLOW)
-    assert ck.CATALOG[-15:-12] == (ck.TELEMETRY_TICK, ck.TELEMETRY_DROP,
+    assert ck.CATALOG[-17:-14] == (ck.TELEMETRY_TICK, ck.TELEMETRY_DROP,
                                    ck.EXPORTER_LABEL_OVERFLOW)
-    assert ck.CATALOG[-20:-15] == (ck.TUNE_LOADED, ck.TUNE_FALLBACK,
+    assert ck.CATALOG[-22:-17] == (ck.TUNE_LOADED, ck.TUNE_FALLBACK,
                                    ck.TUNE_KNOB_REJECTED, ck.TUNE_TRIAL,
                                    ck.TUNE_PARITY_FAIL)
-    assert ck.CATALOG[-22:-20] == (ck.ROUTE_SORTFREE, ck.SORTFREE_OVERFLOW)
-    assert ck.CATALOG[-24:-22] == (ck.ROUTE_MESHED, ck.PIPE_MESHED)
+    assert ck.CATALOG[-24:-22] == (ck.ROUTE_SORTFREE, ck.SORTFREE_OVERFLOW)
+    assert ck.CATALOG[-26:-24] == (ck.ROUTE_MESHED, ck.PIPE_MESHED)
+    assert ck.TELEMETRY_HIST_TICK == "telemetry.hist_tick"
+    assert ck.CONTROL_TAIL_SIGNAL == "control.tail_signal"
     assert ck.CONTROL_TICK == "control.tick"
     assert ck.CONTROL_SHED_ACTION == "control.action.shed_rate"
     assert ck.CONTROL_RETUNE_ACTION == "control.action.retune_batcher"

@@ -326,6 +326,9 @@ def test_registry_matches_runtime_clamps(monkeypatch):
         control_cooldown_ms, control_degrade_rt_ms, control_interval_ms,
         control_min_admit, control_p99_hi_ms, control_p99_lo_ms,
     )
+    from sentinel_tpu.obs.resource_hist import (
+        resource_hist_buckets, resource_hist_disabled,
+    )
     numeric = {
         "SENTINEL_PIPELINE_DEPTH": pipeline_depth,
         "SENTINEL_FRONTEND_BATCH": frontend_batch_max,
@@ -344,6 +347,7 @@ def test_registry_matches_runtime_clamps(monkeypatch):
         "SENTINEL_CONTROL_MIN_ADMIT": control_min_admit,
         "SENTINEL_CONTROL_COOLDOWN_MS": control_cooldown_ms,
         "SENTINEL_CONTROL_DEGRADE_RT_MS": control_degrade_rt_ms,
+        "SENTINEL_RESOURCE_HIST_BUCKETS": resource_hist_buckets,
     }
     for env, helper in numeric.items():
         spec = knobs_mod.KNOB_BY_ENV[env]
@@ -362,6 +366,7 @@ def test_registry_matches_runtime_clamps(monkeypatch):
         "SENTINEL_HOST_STAGING": host_staging_enabled,
         "SENTINEL_SORTFREE": sortfree_enabled,
         "SENTINEL_SINGLE_DISPATCH": single_dispatch_enabled,
+        "SENTINEL_RESOURCE_HIST_DISABLE": resource_hist_disabled,
     }
     for env, helper in booleans.items():
         spec = knobs_mod.KNOB_BY_ENV[env]
